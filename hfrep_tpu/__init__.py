@@ -1,0 +1,25 @@
+"""hfrep_tpu — TPU-native hedge-fund strategy-replication framework.
+
+A ground-up JAX/Flax/optax/pjit re-design of the capabilities of
+``kaiwenShen/Do-You-Really-Need-to-Pay-2-20-Hedge-Fund-Strategy-Replication-via-Machine-Learning``
+(reference mounted read-only at ``/root/reference``):
+
+* six time-series GAN families (GAN, WGAN, WGAN-GP, MTSS-GAN, MTSS-WGAN,
+  MTSS-WGAN-GP) for synthesizing multivariate monthly-return windows,
+* a 12-metric distributional evaluation suite (the acceptance oracle),
+* the linear-autoencoder replication engine with rolling-OLS ex-ante
+  strategy construction, transaction-cost ex-post adjustment, turnover,
+  performance statistics and spanning tests,
+* an experiment driver replicating the latent-dim sweep and the
+  GAN-augmentation study.
+
+Everything on the compute path is pure-functional JAX: jitted alternating
+G/D steps with on-device PRNG, `lax.fori_loop` critic inner loops,
+`shard_map` data parallelism over a `jax.sharding.Mesh`, and vmapped
+whole-sweep autoencoder training (all 21 latent dims in one batched
+program instead of 21 serial Keras fits).
+"""
+
+__version__ = "0.1.0"
+
+from hfrep_tpu import config  # noqa: F401

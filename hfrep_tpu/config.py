@@ -1,0 +1,131 @@
+"""Typed configuration system.
+
+The reference hard-codes every hyperparameter as a literal scattered over
+six near-identical scripts (window=48 / n_sample=1000 at
+``GAN/MTSS_WGAN_GP.py:101``, epochs=5000 / batch=32 at ``:292``,
+n_critic=5 at ``:127``, lr=5e-5 at ``:128``, clip=0.01 at
+``GAN/WGAN.py:98``, GP weight 10 at ``GAN/WGAN_GP.py:171``, AE
+epochs=1000/batch=48/val=0.25/patience=5 at
+``Autoencoder_encapsulate.py:83-96``, OLS window=24 at ``:133``).  Here
+they are frozen dataclasses; the five BASELINE.json configs are named
+presets in :data:`PRESETS`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Windowed-panel dataset construction (``GAN/MTSS_WGAN_GP.py:97-101``)."""
+
+    cleaned_dir: str = "/root/reference/cleaned_data"
+    n_sample: int = 1000
+    window: int = 48
+    include_rf: bool = False      # production artifact used 36 features (22+13+1)
+    seed: int = 123
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """GAN architecture knobs shared by all six variants."""
+
+    family: str = "gan"            # gan | wgan | wgan_gp | mtss_gan | mtss_wgan | mtss_wgan_gp
+    hidden: int = 100              # Dense/LSTM width used everywhere in the reference
+    leaky_slope: float = 0.2
+    features: int = 35
+    window: int = 48
+    dtype: str = "float32"         # compute dtype; "bfloat16" for MXU throughput
+    param_dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimization schedule (reference defaults cited per field)."""
+
+    epochs: int = 5000             # GAN/MTSS_WGAN_GP.py:292
+    batch_size: int = 32           # GAN/MTSS_WGAN_GP.py:292
+    n_critic: int = 5              # GAN/MTSS_WGAN_GP.py:127
+    adam_lr: float = 2e-4          # GAN/GAN.py:100  Adam(2e-4, beta1=0.5)
+    adam_b1: float = 0.5
+    rmsprop_lr: float = 5e-5       # GAN/WGAN.py:99
+    clip_value: float = 0.01       # GAN/WGAN.py:98
+    gp_weight: float = 10.0        # GAN/WGAN_GP.py:171 loss_weights=[1,1,10]
+    seed: int = 123
+    log_every: int = 50
+    checkpoint_every: int = 1000   # reference saves only at end (GAN/MTSS_WGAN_GP.py:285-287)
+    checkpoint_dir: Optional[str] = None
+    steps_per_call: int = 25       # host↔device round-trips amortized via lax.scan
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for the data-parallel trainer (SURVEY §5.8)."""
+
+    dp: int = -1                   # -1: use all devices on the data axis
+    axis_name: str = "dp"
+
+
+@dataclasses.dataclass(frozen=True)
+class AEConfig:
+    """Autoencoder replication engine (``Autoencoder_encapsulate.py``)."""
+
+    n_factors: int = 22            # input dim (Autoencoder_encapsulate.py:24)
+    latent_dim: int = 21
+    epochs: int = 1000             # :86
+    batch_size: int = 48           # :88
+    val_split: float = 0.25        # :89
+    patience: int = 5              # :72 EarlyStopping(patience=5)
+    leaky_slope: float = 0.2       # :25,:29
+    ols_window: int = 24           # :133
+    lr: float = 2e-3               # keras Nadam() default lr=0.002 (:80)
+    seed: int = 123
+    beta_mode: str = "first"       # "first" replicates ante()'s use of ae_ols_beta[0]
+                                   # for every window (Autoencoder_encapsulate.py:167);
+                                   # "rolling" is the corrected per-window beta.
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = DataConfig()
+    model: ModelConfig = ModelConfig()
+    train: TrainConfig = TrainConfig()
+    mesh: MeshConfig = MeshConfig()
+    ae: AEConfig = AEConfig()
+    name: str = "default"
+
+
+def _preset(family: str, name: str, **train_kw) -> ExperimentConfig:
+    return ExperimentConfig(
+        model=ModelConfig(family=family),
+        train=TrainConfig(**train_kw),
+        name=name,
+    )
+
+
+#: The five BASELINE.json configs as named presets.
+PRESETS = {
+    # "vanilla GAN on cleaned_data/factor_etf_data.csv — 1k steps"
+    "gan_1k": _preset("gan", "gan_1k", epochs=1000),
+    "wgan": _preset("wgan", "wgan"),
+    "wgan_gp": _preset("wgan_gp", "wgan_gp"),
+    "mtss_gan": _preset("mtss_gan", "mtss_gan"),
+    "mtss_wgan": _preset("mtss_wgan", "mtss_wgan"),
+    "mtss_wgan_gp": _preset("mtss_wgan_gp", "mtss_wgan_gp"),
+    # production artifact configuration: window 168, 36 features (SURVEY §2 tail)
+    "mtss_wgan_gp_prod": ExperimentConfig(
+        data=DataConfig(window=168, include_rf=True),
+        model=ModelConfig(family="mtss_wgan_gp", window=168, features=36),
+        train=TrainConfig(),
+        name="mtss_wgan_gp_prod",
+    ),
+    "ae_replication": ExperimentConfig(name="ae_replication"),
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {sorted(PRESETS)}")
+    return PRESETS[name]

@@ -1,0 +1,113 @@
+"""Transaction-cost and price-impact model, vectorized.
+
+Ports of ``helper.py:65-131``:
+
+* ``transaction_cost`` — quadratic cost ``0.5·Δx²·σ·param`` where σ is the
+  per-asset vol from the rolling covariance diagonal (``helper.py:65-80``);
+* ``price_impact`` — φ-model ``φ·x_new·σ·Δx − x_old·σ·Δx − 0.5·Δx²·σ``
+  (``helper.py:83-92``), with Δx = x_old − x_new in both;
+* ``ex_post_return`` — the reference's doubly-nested host loop
+  (13 strategies × 143 months × a fresh pandas ``.cov()`` each step,
+  ``helper.py:112-131``) becomes one vmapped program: rolling covariances
+  are computed once for all windows and the per-month penalty for every
+  strategy falls out of a single broadcasted expression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def transaction_cost(old_x, new_x, cov_diag_vol, param: float = 0.05):
+    """0.5·Δx²·(σ·param) per asset; σ = sqrt(diag(cov)).
+
+    ``cov_diag_vol`` is sqrt(diag(cov)) — pass vols, not the full matrix,
+    so the rolling path computes each window's diagonal once.
+    """
+    delta = jnp.asarray(old_x) - jnp.asarray(new_x)
+    return 0.5 * delta**2 * (cov_diag_vol * param)
+
+
+def price_impact(old_x, new_x, cov_diag_vol, param: float = 0.05, phi: float = 0.5):
+    """φ-model price impact (``helper.py:83-92``)."""
+    old_x = jnp.asarray(old_x)
+    new_x = jnp.asarray(new_x)
+    scaled_vol = cov_diag_vol * param
+    delta = old_x - new_x
+    return phi * new_x * scaled_vol * delta - old_x * scaled_vol * delta - 0.5 * delta**2 * scaled_vol
+
+
+def rolling_cov_diag_vol(panel: jnp.ndarray, window: int) -> jnp.ndarray:
+    """sqrt(diag(cov)) for every length-``window`` slice of a (T, F) panel.
+
+    Returns (T - window + 1, F); row ``i`` covers ``panel[i : i + window]``.
+    Only the diagonal is needed by the cost model, so this is an unbiased
+    rolling variance (ddof=1, matching pandas ``.cov()``), not a full F×F
+    covariance per window.
+    """
+    t, f = panel.shape
+    n_win = t - window + 1
+    starts = jnp.arange(n_win)
+
+    def one(start):
+        w = jax.lax.dynamic_slice(panel, (start, 0), (window, f))
+        return jnp.sqrt(jnp.var(w, axis=0, ddof=1))
+
+    return jax.vmap(one)(starts)
+
+
+def ex_post_return(ex_ante: jnp.ndarray, window: int, strat_weights: jnp.ndarray,
+                   factor_etf: jnp.ndarray, param: float = 0.05, phi: float = 0.5) -> jnp.ndarray:
+    """Ex-post returns: ex-ante plus the per-month cost penalty.
+
+    Vectorized port of ``helper.py:112-131``.  Shapes:
+
+    * ``ex_ante`` — (P, S): P months, S strategies;
+    * ``strat_weights`` — (S, P, A): each strategy's ETF weights per month
+      (the reference's ``reshape_cab`` output, ``helper.py:94-110``);
+    * ``factor_etf`` — (P + window, A): OOS factor/ETF panel *including*
+      the first covariance window (``Autoencoder_encapsulate.py:206``).
+
+    Reference loop semantics preserved exactly: month 0 carries no
+    penalty; month ``i >= 1`` adds the penalty computed from the weight
+    change between months ``i-1`` and ``i`` under the covariance of
+    ``factor_etf[i : i + window]``.  The loop range ``1..len(factor_etf)
+    - window`` (``helper.py:120``) produces P−1 penalties for P ex-ante
+    months.
+    """
+    p, s = ex_ante.shape
+    vols = rolling_cov_diag_vol(factor_etf, window)       # (P+1, A)
+    vols_i = vols[1:p]                                    # months 1..P-1
+
+    new_w = jnp.swapaxes(strat_weights, 0, 1)[1:p]        # (P-1, S, A)
+    old_w = jnp.swapaxes(strat_weights, 0, 1)[0:p - 1]    # (P-1, S, A)
+    v = vols_i[:, None, :]                                # (P-1, 1, A)
+    tc = transaction_cost(old_w, new_w, v, param)
+    pi = price_impact(old_w, new_w, v, param, phi)
+    penalty = jnp.sum(tc + pi, axis=-1)                   # (P-1, S)
+    return ex_ante.at[1:].add(penalty)
+
+
+def normalization(y: jnp.ndarray, x: jnp.ndarray, beta: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Volatility-matching normalization factor (``helper.py:10-17``):
+
+    sqrt(Var(Y)) / sqrt(Var(X @ beta)) per column, with the reference's
+    ``window - 1`` denominator.
+    """
+    r_hat = x @ beta
+    den = jnp.sum((r_hat - jnp.mean(r_hat, axis=0)) ** 2 / (window - 1), axis=0)
+    num = jnp.sum((y - jnp.mean(y, axis=0)) ** 2 / (window - 1), axis=0)
+    return jnp.sqrt(num) / jnp.sqrt(den)
+
+
+def turnover(strat_weights: jnp.ndarray) -> jnp.ndarray:
+    """Mean annualized Σ|w_t − w_{t+1}| per strategy.
+
+    Port of ``Autoencoder_encapsulate.py:210-224``: sum of absolute
+    weight changes over consecutive months, summed over assets, divided
+    by ``n_months / 12``.  ``strat_weights`` is (P, A, S) as stored by
+    ``ante`` (months × ETFs × strategies).
+    """
+    diffs = jnp.sum(jnp.abs(strat_weights[:-1] - strat_weights[1:]), axis=(0, 1))
+    return diffs / (strat_weights.shape[0] / 12.0)

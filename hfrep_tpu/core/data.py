@@ -1,0 +1,135 @@
+"""Panel ingestion: cleaned CSVs → device arrays.
+
+The reference re-reads ``cleaned_data/*.csv`` with a copy-pasted
+``read_csv`` in every script and joins/scales **at module import time**
+(``GAN/MTSS_WGAN_GP.py:88-101``) — a structural quirk this framework does
+not copy.  Here ingestion is an explicit function returning a
+:class:`Panel` of jnp arrays plus metadata; the scaler is pure params
+(:mod:`hfrep_tpu.core.scaler`) saved alongside checkpoints so generated
+samples can always be inverse-transformed.
+
+Data shapes (BASELINE.md): 337 months 1994-04-30 → 2022-04-30; 22
+factor/ETF columns, 13 hedge-fund indices, 1 risk-free column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from hfrep_tpu.config import DataConfig
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.core.sampling import sample_windows
+
+
+def read_csv(loc, date: bool = True) -> pd.DataFrame:
+    """CSV → DataFrame with a parsed ``Date`` index (``helper.py:18-23``)."""
+    df = pd.read_csv(loc)
+    if date:
+        df["Date"] = pd.to_datetime(df["Date"])
+        df.set_index("Date", inplace=True)
+    return df
+
+
+def dic_read(loc) -> dict:
+    """Pickle load (``helper.py:26-29``)."""
+    with open(loc, "rb") as f:
+        return pickle.load(f)
+
+
+def dic_save(dic: dict, loc) -> dict:
+    """Pickle dump with read-back verification (``helper.py:155-162``)."""
+    with open(loc, "wb") as f:
+        pickle.dump(dic, f)
+    return dic_read(loc)
+
+
+@dataclasses.dataclass
+class Panel:
+    """The joined monthly-return panel and its provenance."""
+
+    factors: jnp.ndarray            # (T, 22)
+    hf: jnp.ndarray                 # (T, 13)
+    rf: jnp.ndarray                 # (T, 1)
+    dates: np.ndarray               # (T,) datetime64 — host-side metadata
+    factor_names: List[str]
+    hf_names: List[str]
+    factor_fullnames: Dict[str, str]
+    hf_fullnames: Dict[str, str]
+
+    @property
+    def n_months(self) -> int:
+        return self.factors.shape[0]
+
+    def joined(self, include_rf: bool = False) -> jnp.ndarray:
+        """factor ⋈ hf (⋈ rf): the GAN training panel.
+
+        ``GAN/MTSS_WGAN_GP.py:97`` joins factors with hf (35 features);
+        the production artifact additionally included rf (36 features,
+        ``autoencoder_v4.ipynb`` cell 47 fits its inverse scaler on
+        factor ⋈ hfd ⋈ rf).
+        """
+        parts = [self.factors, self.hf] + ([self.rf] if include_rf else [])
+        return jnp.concatenate(parts, axis=1)
+
+    def train_test_split(self, test_size: float = 0.5):
+        """Chronological split, no shuffle (``autoencoder_v4.ipynb`` cell 5).
+
+        Matches sklearn's ``train_test_split(shuffle=False, test_size=.5)``:
+        the train block is ``floor(T * (1 - test_size))`` rows — for T=337
+        that is 168 train / 169 test months.
+        """
+        n_train = int(self.n_months * (1.0 - test_size))
+        return (
+            self.factors[:n_train], self.factors[n_train:],
+            self.hf[:n_train], self.hf[n_train:],
+        )
+
+
+def load_panel(cleaned_dir: str = "/root/reference/cleaned_data") -> Panel:
+    d = Path(cleaned_dir)
+    hfd = read_csv(d / "hfd.csv")
+    factor = read_csv(d / "factor_etf_data.csv")
+    rf = read_csv(d / "rf.csv")
+    hf_fullnames = dic_read(d / "hfd_fullname.pkl")
+    factor_fullnames = dic_read(d / "factor_etf_name.pkl")
+    return Panel(
+        factors=jnp.asarray(factor.values, dtype=jnp.float32),
+        hf=jnp.asarray(hfd.values, dtype=jnp.float32),
+        rf=jnp.asarray(rf.values, dtype=jnp.float32),
+        dates=hfd.index.values,
+        factor_names=list(factor.columns),
+        hf_names=list(hfd.columns),
+        factor_fullnames=factor_fullnames,
+        hf_fullnames=hf_fullnames,
+    )
+
+
+@dataclasses.dataclass
+class GanDataset:
+    """MinMax-scaled window cube plus the params to undo the scaling."""
+
+    windows: jnp.ndarray            # (N, W, F) in [0, 1]
+    scaler: mm.ScalerParams         # fit on the full joined panel
+    panel_scaled: jnp.ndarray       # (T, F) — kept for eval-suite "dataset" role
+    feature_names: List[str]
+
+
+def build_gan_dataset(cfg: DataConfig, key, panel: Optional[Panel] = None) -> GanDataset:
+    """Reproduce the reference dataset build (``GAN/MTSS_WGAN_GP.py:97-101``):
+
+    join → MinMax scale the whole panel → sample N random windows.
+    """
+    if panel is None:
+        panel = load_panel(cfg.cleaned_dir)
+    joined = panel.joined(include_rf=cfg.include_rf)
+    params, scaled = mm.fit_transform(joined)
+    windows = sample_windows(key, scaled, cfg.n_sample, cfg.window)
+    names = panel.factor_names + panel.hf_names + (["rf"] if cfg.include_rf else [])
+    return GanDataset(windows=windows, scaler=params, panel_scaled=scaled, feature_names=names)

@@ -1,0 +1,55 @@
+"""On-device window sampling.
+
+Port of ``helper.py:44-62`` (``random_sampling``): draw ``n_sample``
+random contiguous windows of length ``window`` from a (T, F) panel,
+"implicitly assuming there is no calendar effect".  The reference builds
+the (N, W, F) cube with a host Python loop of list appends; here the
+starts come from one `jax.random.randint` and the gather is a vmapped
+`lax.dynamic_slice`, so sampling can run jitted on device and be resampled
+per epoch for free.
+
+Start-index semantics match the reference: Python's
+``randint(0, T - window)`` is inclusive on both ends, so valid starts are
+``[0, T - window]`` — note the last start yields the window
+``data[T-window : T]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_windows(key: jax.Array, data: jnp.ndarray, n_sample: int, window: int) -> jnp.ndarray:
+    """Draw (n_sample, window, F) random contiguous windows from (T, F) data."""
+    t, f = data.shape
+    if window > t:
+        raise ValueError(f"window {window} longer than panel length {t}")
+    starts = jax.random.randint(key, (n_sample,), 0, t - window + 1)
+
+    def take(start):
+        return lax.dynamic_slice(data, (start, 0), (window, f))
+
+    return jax.vmap(take)(starts)
+
+
+def factor_hf_split(arr: jnp.ndarray, split_pos: int, reshape: bool = True):
+    """Split a (N, W, F) cube into leading-factor and trailing-HF blocks.
+
+    Port of ``helper.py:133-153`` — columns ``[:split_pos]`` are factors,
+    ``[split_pos:]`` hedge-fund (and optionally rf) returns; with
+    ``reshape`` the window axis is flattened into rows, as the notebook
+    does before vstacking synthetic rows with real ones
+    (``autoencoder_v4.ipynb`` cell 48).
+    """
+    if arr.ndim != 3:
+        raise ValueError("expected (N, W, F) cube")
+    if not 0 < split_pos < arr.shape[2]:
+        raise ValueError(f"split_pos {split_pos} outside (0, {arr.shape[2]})")
+    factor = arr[:, :, :split_pos]
+    hf = arr[:, :, split_pos:]
+    if reshape:
+        factor = factor.reshape(-1, factor.shape[2])
+        hf = hf.reshape(-1, hf.shape[2])
+    return factor, hf

@@ -1,0 +1,72 @@
+"""Pure-functional MinMax scaling.
+
+The reference leans on ``sklearn.preprocessing.MinMaxScaler`` in three
+places: the GAN dataset build (``GAN/MTSS_WGAN_GP.py:98-99``), AE
+training-set scaling (``Autoencoder_encapsulate.py:62-67``) and the
+per-step expanding OOS rescaling (``Autoencoder_encapsulate.py:115-131``).
+A stateful sklearn object cannot live inside a jitted program, so here the
+scaler is a pytree of parameters plus pure transform functions — the
+params ride along in checkpoints next to model weights.
+
+Semantics match sklearn's default ``feature_range=(0, 1)``: columns with
+zero range scale by 1.0 (sklearn's ``_handle_zeros_in_scale``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class ScalerParams(NamedTuple):
+    data_min: jnp.ndarray   # (F,)
+    data_max: jnp.ndarray   # (F,)
+
+    @property
+    def scale(self) -> jnp.ndarray:
+        rng = self.data_max - self.data_min
+        return jnp.where(rng == 0.0, 1.0, rng)
+
+
+def fit(x: jnp.ndarray) -> ScalerParams:
+    """Fit over axis 0 of a (T, F) panel."""
+    return ScalerParams(jnp.min(x, axis=0), jnp.max(x, axis=0))
+
+
+def transform(params: ScalerParams, x: jnp.ndarray) -> jnp.ndarray:
+    return (x - params.data_min) / params.scale
+
+
+def inverse_transform(params: ScalerParams, x: jnp.ndarray) -> jnp.ndarray:
+    return x * params.scale + params.data_min
+
+
+def fit_transform(x: jnp.ndarray) -> tuple[ScalerParams, jnp.ndarray]:
+    p = fit(x)
+    return p, transform(p, x)
+
+
+class MinMaxScaler:
+    """Thin object wrapper for host-side convenience; state is a pytree.
+
+    Inside jit, use the free functions on :class:`ScalerParams` directly.
+    """
+
+    def __init__(self) -> None:
+        self.params: ScalerParams | None = None
+
+    def fit(self, x) -> "MinMaxScaler":
+        self.params = fit(jnp.asarray(x))
+        return self
+
+    def transform(self, x):
+        assert self.params is not None, "fit first"
+        return transform(self.params, jnp.asarray(x))
+
+    def fit_transform(self, x):
+        return self.fit(x).transform(x)
+
+    def inverse_transform(self, x):
+        assert self.params is not None, "fit first"
+        return inverse_transform(self.params, jnp.asarray(x))

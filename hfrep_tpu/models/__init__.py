@@ -1,0 +1,7 @@
+from hfrep_tpu.models.generators import DenseGenerator, LSTMGenerator  # noqa: F401
+from hfrep_tpu.models.discriminators import (  # noqa: F401
+    DenseDiscriminator, DenseCritic, DenseFlatCritic,
+    LSTMDiscriminator, LSTMCritic, LSTMFlatCritic,
+)
+from hfrep_tpu.models.autoencoder import Autoencoder  # noqa: F401
+from hfrep_tpu.models.registry import build_gan, FAMILIES  # noqa: F401

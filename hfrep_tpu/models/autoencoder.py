@@ -1,0 +1,59 @@
+"""Linear autoencoder replication core.
+
+Port of ``Autoencoder_encapsulate.py:19-35``: a one-hidden-layer,
+bias-free autoencoder — encoder ``Dense(latent, use_bias=False) +
+LeakyReLU(0.2)``, decoder ``Dense(22, use_bias=False) + LeakyReLU(0.2)``.
+Two matmuls and two elementwise ops.
+
+The TPU-native twist is the **masked sweep**: the reference trains 21
+separate Keras models for latent dims 1..21 (``autoencoder_v4.ipynb``
+cell 6).  Here every member uses the same (F, max_latent) parameter shape
+and a binary mask zeroes latent columns beyond its latent_dim — masked
+columns produce identically-zero activations (LeakyReLU(0)=0) and hence
+zero gradients, so a masked model *is* the smaller model.  Identical
+shapes make the whole sweep one `vmap`: 21 trainings in a single batched
+program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from hfrep_tpu.ops.layers import leaky_relu
+
+
+class Autoencoder(nn.Module):
+    n_features: int = 22
+    latent_dim: int = 21
+    slope: float = 0.2
+
+    def setup(self):
+        self.encoder_kernel = self.param(
+            "encoder_kernel", nn.initializers.glorot_uniform(),
+            (self.n_features, self.latent_dim))
+        self.decoder_kernel = self.param(
+            "decoder_kernel", nn.initializers.glorot_uniform(),
+            (self.latent_dim, self.n_features))
+
+    def encode(self, x, latent_mask: Optional[jnp.ndarray] = None):
+        z = leaky_relu(x @ self.encoder_kernel, self.slope)
+        if latent_mask is not None:
+            z = z * latent_mask
+        return z
+
+    def decode(self, z):
+        return leaky_relu(z @ self.decoder_kernel, self.slope)
+
+    def __call__(self, x, latent_mask: Optional[jnp.ndarray] = None):
+        return self.decode(self.encode(x, latent_mask))
+
+
+def latent_mask(latent_dim, max_latent: int) -> jnp.ndarray:
+    """(max_latent,) mask with ones in the first ``latent_dim`` slots.
+
+    ``latent_dim`` may be a traced integer, so the sweep can vmap over it.
+    """
+    return (jnp.arange(max_latent) < latent_dim).astype(jnp.float32)

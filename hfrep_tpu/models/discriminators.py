@@ -1,0 +1,126 @@
+"""Discriminator / critic networks for the six GAN families.
+
+All emit **logits** (no output sigmoid): the BCE families apply the
+sigmoid inside the loss (`sigmoid_binary_cross_entropy`), mathematically
+identical to the reference's ``Dense(1, activation='sigmoid')`` +
+``binary_crossentropy`` but numerically stable.  Wasserstein critics are
+linear-output in the reference too (``GAN/WGAN.py:156``: "we dont do
+sigmoid activation").
+
+Per-timestep vs flattened heads, exactly as in the reference:
+
+* GAN D (``GAN/GAN.py:144-158``): ``Dense(100) → Dense(100) → Dense(1)``
+  applied per timestep → (B, W, 1) validity scores (Keras Dense on 3-D
+  input acts on the last axis; the scalar label broadcasts over W).
+* WGAN critic (``GAN/WGAN.py:146-163``): ``Dense(100) → LeakyReLU → LN →
+  Dense(100) → LeakyReLU → LN → Dense(1)`` → (B, W, 1).
+* WGAN-GP critic (``GAN/WGAN_GP.py:238-253``): ``Dense(100) → Dense(100)
+  → Flatten → Dense(1)`` → (B, 1).
+* MTSS-GAN D (``GAN/MTSS_GAN.py:143-157``): ``LSTM(100) → LSTM(100) →
+  Dense(1)`` → (B, W, 1), default tanh activation.
+* MTSS-WGAN critic (``GAN/MTSS_WGAN.py:146-163``): ``LSTM(100, act=None)
+  → LeakyReLU → LN → LSTM(100, act=None) → LeakyReLU → LN → Dense(1)``
+  → (B, W, 1) — note the *linear* LSTM activation.
+* MTSS-WGAN-GP critic (``GAN/MTSS_WGAN_GP.py:237-252``): ``LSTM(100) →
+  LSTM(100) → Flatten → Dense(1)`` → (B, 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
+from hfrep_tpu.ops.lstm import KerasLSTM
+
+
+class DenseDiscriminator(nn.Module):
+    """Vanilla GAN discriminator; logits of shape (B, W, 1)."""
+
+    hidden: int = 100
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype)(x)
+
+
+class DenseCritic(nn.Module):
+    """WGAN (weight-clipped) critic; scores of shape (B, W, 1)."""
+
+    hidden: int = 100
+    slope: float = 0.2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype)(x)
+
+
+class DenseFlatCritic(nn.Module):
+    """WGAN-GP critic; one score per window, (B, 1)."""
+
+    hidden: int = 100
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = x.reshape(x.shape[0], -1)
+        return KerasDense(1, dtype=self.dtype)(x)
+
+
+class LSTMDiscriminator(nn.Module):
+    """MTSS-GAN discriminator; logits (B, W, 1)."""
+
+    hidden: int = 100
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype)(x)
+
+
+class LSTMCritic(nn.Module):
+    """MTSS-WGAN critic; scores (B, W, 1); linear LSTM activations."""
+
+    hidden: int = 100
+    slope: float = 0.2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype)(x)
+
+
+class LSTMFlatCritic(nn.Module):
+    """MTSS-WGAN-GP critic; one score per window, (B, 1)."""
+
+    hidden: int = 100
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+        x = x.reshape(x.shape[0], -1)
+        return KerasDense(1, dtype=self.dtype)(x)

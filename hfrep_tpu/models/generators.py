@@ -1,0 +1,63 @@
+"""Generator networks for the six GAN families.
+
+Two generator bodies cover all six reference models:
+
+* Dense body (GAN / WGAN / WGAN-GP):
+  ``Dense(100, sigmoid) → LeakyReLU(0.2) → LayerNorm → Dense(100, sigmoid)
+  → LeakyReLU(0.2) → LayerNorm → Dense(F)`` (``GAN/GAN.py:127-142``,
+  identical at ``GAN/WGAN.py:128-144`` and ``GAN/WGAN_GP.py:221-235``).
+  Note the quirky sigmoid-then-LeakyReLU stacking is the reference's own.
+
+* LSTM body (MTSS-GAN / MTSS-WGAN / MTSS-WGAN-GP):
+  ``LSTM(100, act=sigmoid) → LayerNorm → LSTM(100, act=sigmoid)
+  → LeakyReLU(0.2) → LayerNorm → Dense(F)``
+  (``GAN/MTSS_WGAN_GP.py:221-235``, same at ``GAN/MTSS_GAN.py:127-141``).
+  The ``activation='sigmoid'`` replaces the LSTM's *tanh* path — see
+  :mod:`hfrep_tpu.ops.lstm`.
+
+Noise input has the same shape as the output window, (B, W, F)
+(``GAN/GAN.py:112``: latent_shape == ts_shape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
+from hfrep_tpu.ops.lstm import KerasLSTM
+
+
+class DenseGenerator(nn.Module):
+    features: int
+    hidden: int = 100
+    slope: float = 0.2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:
+        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype)(z)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        return KerasDense(self.features, dtype=self.dtype)(x)
+
+
+class LSTMGenerator(nn.Module):
+    features: int
+    hidden: int = 100
+    slope: float = 0.2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(z)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(x)
+        x = leaky_relu(x, self.slope)
+        x = KerasLayerNorm(dtype=self.dtype)(x)
+        return KerasDense(self.features, dtype=self.dtype)(x)

@@ -1,0 +1,55 @@
+"""Model-family registry: config → (generator, discriminator, loss kind).
+
+Resolves the reference's naming trap (SURVEY §2): file ``WGAN_GP.py``
+holds the *Dense* GP model (class ``MTTS_WGAN_GP``,
+``GAN/WGAN_GP.py:115``) while ``MTSS_WGAN_GP.py`` holds the *LSTM* GP
+model (class ``WGAN_GP``, ``GAN/MTSS_WGAN_GP.py:115``).  Families here
+are named for what they are, not what their files were called.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.models.discriminators import (
+    DenseCritic, DenseDiscriminator, DenseFlatCritic,
+    LSTMCritic, LSTMDiscriminator, LSTMFlatCritic,
+)
+from hfrep_tpu.models.generators import DenseGenerator, LSTMGenerator
+
+
+@dataclasses.dataclass(frozen=True)
+class GanPair:
+    generator: nn.Module
+    discriminator: nn.Module
+    loss: str            # "bce" | "wgan_clip" | "wgan_gp"
+    family: str
+
+
+FAMILIES = {
+    #        generator        discriminator      loss
+    "gan":          (DenseGenerator, DenseDiscriminator, "bce"),
+    "wgan":         (DenseGenerator, DenseCritic,        "wgan_clip"),
+    "wgan_gp":      (DenseGenerator, DenseFlatCritic,    "wgan_gp"),
+    "mtss_gan":     (LSTMGenerator,  LSTMDiscriminator,  "bce"),
+    "mtss_wgan":    (LSTMGenerator,  LSTMCritic,         "wgan_clip"),
+    "mtss_wgan_gp": (LSTMGenerator,  LSTMFlatCritic,     "wgan_gp"),
+}
+
+
+def build_gan(cfg: ModelConfig) -> GanPair:
+    if cfg.family not in FAMILIES:
+        raise KeyError(f"unknown GAN family {cfg.family!r}; available: {sorted(FAMILIES)}")
+    g_cls, d_cls, loss = FAMILIES[cfg.family]
+    dtype: Optional[jnp.dtype] = jnp.dtype(cfg.dtype) if cfg.dtype else None
+    gen = g_cls(features=cfg.features, hidden=cfg.hidden, slope=cfg.leaky_slope, dtype=dtype)
+    if d_cls in (DenseCritic, LSTMCritic):
+        disc = d_cls(hidden=cfg.hidden, slope=cfg.leaky_slope, dtype=dtype)
+    else:
+        disc = d_cls(hidden=cfg.hidden, dtype=dtype)
+    return GanPair(generator=gen, discriminator=disc, loss=loss, family=cfg.family)

@@ -1,0 +1,4 @@
+from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu  # noqa: F401
+from hfrep_tpu.ops.lstm import KerasLSTM  # noqa: F401
+from hfrep_tpu.ops.rolling import rolling_ols_beta  # noqa: F401
+from hfrep_tpu.ops.sqrtm import sqrtm_product_trace  # noqa: F401

@@ -1,0 +1,66 @@
+"""Keras-default layer primitives on Flax.
+
+The reference models are built from exactly four Keras layers — ``Dense``,
+``LSTM``, ``LayerNormalization``, ``LeakyReLU`` (e.g.
+``GAN/MTSS_WGAN_GP.py:221-252``).  Flax's defaults differ from Keras's in
+initializer (lecun_normal vs glorot_uniform) and LayerNorm epsilon (1e-6
+vs 1e-3); these wrappers pin the Keras defaults so a fresh model here is
+distributionally the same model as a fresh model there.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def leaky_relu(x: jnp.ndarray, slope: float = 0.2) -> jnp.ndarray:
+    """Keras ``LeakyReLU(alpha=.2)`` (``GAN/GAN.py:130`` et al.)."""
+    return jnp.where(x >= 0, x, slope * x)
+
+
+ACTIVATIONS: dict[Optional[str], Callable] = {
+    None: lambda x: x,
+    "linear": lambda x: x,
+    "sigmoid": nn.sigmoid,
+    "tanh": nn.tanh,
+    "relu": nn.relu,
+}
+
+
+class KerasDense(nn.Module):
+    """``keras.layers.Dense``: glorot_uniform kernel, zeros bias.
+
+    Applied to the trailing axis — on (B, W, F) inputs it acts
+    per-timestep, exactly as Keras ``Dense`` does on 3-D tensors (this is
+    why the reference's vanilla discriminator emits (B, W, 1) validity
+    scores, ``GAN/GAN.py:144-158``).
+    """
+
+    features: int
+    activation: Optional[str] = None
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            kernel_init=nn.initializers.glorot_uniform(),
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+        )(x)
+        return ACTIVATIONS[self.activation](y)
+
+
+class KerasLayerNorm(nn.Module):
+    """``keras.layers.LayerNormalization`` defaults: axis=-1, eps=1e-3."""
+
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(epsilon=1e-3, dtype=self.dtype)(x)

@@ -1,0 +1,81 @@
+"""Keras-semantics LSTM as a time-major `lax.scan` over one fused cell.
+
+Why not `flax.linen.LSTMCell`: the reference's generators are built with
+``LSTM(100, activation='sigmoid')`` (``GAN/MTSS_WGAN_GP.py:224-226``) and
+the MTSS-WGAN critic with ``LSTM(100, activation=None)``
+(``GAN/MTSS_WGAN.py:148-151``).  In Keras, ``activation=`` replaces the
+**tanh** used for the candidate cell state and the output transform — the
+three gates keep ``recurrent_activation`` (sigmoid).  Flax's cell
+hard-wires tanh, so distributional parity would silently fail (SURVEY §7
+hard part (a)).  This cell exposes both activations.
+
+TPU mapping: the input projection for *all* timesteps is hoisted out of
+the recurrence into a single (B·W, F) × (F, 4H) matmul — one large MXU
+op — leaving only the (B, H) × (H, 4H) recurrent matmul inside the scan.
+The scan is time-major and the compiler pipelines it; with W ≤ 168 and
+H = 100 the whole recurrence lives comfortably in VMEM.
+
+Parameter layout matches Keras: ``kernel`` (F, 4H), ``recurrent_kernel``
+(H, 4H), ``bias`` (4H,) with gate blocks ordered [input, forget,
+candidate, output] and a unit forget-gate bias.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from hfrep_tpu.ops.layers import ACTIVATIONS
+
+
+def _unit_forget_bias(key, shape, dtype=jnp.float32):
+    h = shape[0] // 4
+    return jnp.concatenate([
+        jnp.zeros((h,), dtype), jnp.ones((h,), dtype), jnp.zeros((2 * h,), dtype)
+    ])
+
+
+class KerasLSTM(nn.Module):
+    """``keras.layers.LSTM(features, return_sequences=True)`` equivalent."""
+
+    features: int
+    activation: Optional[str] = "tanh"            # candidate/output transform
+    recurrent_activation: str = "sigmoid"          # gates
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(B, W, F) → (B, W, H) full hidden-state sequence."""
+        b, w, f = x.shape
+        h = self.features
+        kernel = self.param("kernel", nn.initializers.glorot_uniform(), (f, 4 * h))
+        recurrent = self.param("recurrent_kernel", nn.initializers.orthogonal(), (h, 4 * h))
+        bias = self.param("bias", _unit_forget_bias, (4 * h,))
+
+        act = ACTIVATIONS[self.activation]
+        rec_act = ACTIVATIONS[self.recurrent_activation]
+
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        # One big MXU matmul for every timestep's input projection.
+        xz = (x.reshape(b * w, f) @ kernel.astype(dtype) + bias.astype(dtype)).reshape(b, w, 4 * h)
+        xz = jnp.swapaxes(xz, 0, 1)                # time-major (W, B, 4H)
+        rec = recurrent.astype(dtype)
+
+        def cell(carry, xz_t):
+            h_prev, c_prev = carry
+            z = xz_t + h_prev @ rec
+            zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+            i = rec_act(zi)
+            fgt = rec_act(zf)
+            c = fgt * c_prev + i * act(zc)
+            o = rec_act(zo)
+            h_t = o * act(c)
+            return (h_t, c), h_t
+
+        init = (jnp.zeros((b, h), dtype), jnp.zeros((b, h), dtype))
+        _, hs = lax.scan(cell, init, xz)
+        return jnp.swapaxes(hs, 0, 1)              # back to (B, W, H)

@@ -1,0 +1,63 @@
+"""Rolling-window linear algebra: batched OLS instead of host loops.
+
+The reference runs a 24-month rolling OLS as 143 sequential
+``statsmodels.OLS(Y, X).fit()`` calls (``Autoencoder_encapsulate.py:148-157``)
+and the OOS metric loop refits a MinMax scaler per expanding window
+(``:115-131``).  On TPU all windows are materialized as one batch and
+solved together — a single vmapped least-squares, one compiled program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _window_stack(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """(T, F) → (T - window + 1, window, F) sliding windows."""
+    t, f = x.shape
+    starts = jnp.arange(t - window + 1)
+    return jax.vmap(lambda s: lax.dynamic_slice(x, (s, 0), (window, f)))(starts)
+
+
+def rolling_ols_beta(y: jnp.ndarray, x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Rolling no-intercept OLS betas for every window start.
+
+    ``y`` (T, S), ``x`` (T, K) → betas (T - window + 1, K, S), where slice
+    ``i`` regresses ``y[i:i+window]`` on ``x[i:i+window]`` —
+    ``statsmodels.OLS(Y, X)`` includes no constant unless added, matching
+    ``Autoencoder_encapsulate.py:151``.
+
+    Solved via normal equations with a pseudoinverse (statsmodels also
+    uses pinv), batched over windows: two (N_win, W, K)-shaped einsums —
+    MXU-friendly — plus a vmapped solve.
+    """
+    xw = _window_stack(x, window)                  # (N, W, K)
+    yw = _window_stack(y, window)                  # (N, W, S)
+    xtx = jnp.einsum("nwk,nwl->nkl", xw, xw)
+    xty = jnp.einsum("nwk,nws->nks", xw, yw)
+    return jax.vmap(lambda a, b: jnp.linalg.pinv(a) @ b)(xtx, xty)
+
+
+def ols_beta(y: jnp.ndarray, x: jnp.ndarray, add_constant: bool = False) -> jnp.ndarray:
+    """Single OLS fit via pinv; with ``add_constant`` the intercept is
+    column 0, matching ``sm.add_constant`` (``autoencoder_v4.ipynb`` cell
+    23 ``OLS_alpha``)."""
+    if add_constant:
+        x = jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x], axis=1)
+    return jnp.linalg.pinv(x.T @ x) @ (x.T @ y)
+
+
+def expanding_minmax_scale(x: jnp.ndarray) -> jnp.ndarray:
+    """For each prefix length i, MinMax params fit on ``x[:i]``.
+
+    Vectorizes the reference's per-step ``MinMaxScaler().fit_transform
+    (x_test[:i])`` (``Autoencoder_encapsulate.py:115-131``): running
+    columnwise min/max via cumulative reductions gives every prefix's
+    scaler at once.  Returns (mins, maxs), each (T, F), where row i holds
+    the params of the prefix ending at (and including) row i.
+    """
+    mins = lax.associative_scan(jnp.minimum, x, axis=0)
+    maxs = lax.associative_scan(jnp.maximum, x, axis=0)
+    return mins, maxs

@@ -1,0 +1,30 @@
+"""Matrix square root pieces needed by FID, in pure jnp.
+
+``GAN/GAN_eval.py:55`` computes ``scipy.linalg.sqrtm(sigma1 @ sigma2)``
+and only ever uses its **trace** (``:60``).  The trace of the square root
+of a diagonalizable matrix is the sum of the square roots of its
+eigenvalues, so the Schur decomposition scipy performs is unnecessary:
+``trace(sqrtm(A@B)) = Σ sqrt(eig(A@B))``.  For covariance products the
+eigenvalues are real and non-negative up to roundoff; imaginary residue
+is discarded exactly as the reference discards ``covmean.imag`` (``:57-58``).
+
+A general eigendecomposition is not implemented on TPU backends for
+non-symmetric matrices, so we use the similarity trick: with
+``S1 = L @ L.T`` (Cholesky), ``eig(S1 @ S2) = eig(L.T @ S2 @ L)`` and the
+right-hand side is symmetric PSD → `eigh`, which is TPU-native.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def sqrtm_product_trace(sigma1: jnp.ndarray, sigma2: jnp.ndarray) -> jnp.ndarray:
+    """trace(sqrtm(sigma1 @ sigma2)) for symmetric PSD inputs."""
+    # Jitter for Cholesky on rank-deficient sample covariances.
+    eps = 1e-10 * jnp.trace(sigma1) / sigma1.shape[0]
+    c = jnp.linalg.cholesky(sigma1 + eps * jnp.eye(sigma1.shape[0], dtype=sigma1.dtype))
+    m = c.T @ sigma2 @ c
+    m = 0.5 * (m + m.T)
+    eig = jnp.linalg.eigvalsh(m)
+    return jnp.sum(jnp.sqrt(jnp.clip(eig, 0.0, None)))

@@ -1,0 +1,2 @@
+from hfrep_tpu.parallel.mesh import make_mesh  # noqa: F401
+from hfrep_tpu.parallel.data_parallel import make_dp_multi_step  # noqa: F401

@@ -1,0 +1,46 @@
+"""Device-mesh construction (SURVEY §5.8 — the reference has *no*
+parallelism; its one concurrency-relevant line pins TF to a single thread
+for reproducibility, ``helper.py:38``).
+
+The scaling axis for this workload is data parallelism over the batch:
+models are ~200k params, batches are (32, 48, 35) windows, so the right
+mesh is 1-D ``('dp',)`` across all chips with XLA collectives (`pmean`
+on gradients) riding ICI.  Multi-host pods extend the same mesh over DCN
+via ``jax.distributed.initialize`` — no code change, just more devices in
+`jax.devices()`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from hfrep_tpu.config import MeshConfig
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    cfg = cfg or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    n = cfg.dp if cfg.dp > 0 else len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested dp={n} but only {len(devices)} devices present")
+    return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host entry: join the pod-wide runtime before building meshes.
+
+    Thin wrapper over `jax.distributed.initialize` so experiment CLIs can
+    expose ``--coordinator`` flags; on single-host it is a no-op.
+    """
+    if coordinator is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

@@ -1,0 +1,56 @@
+"""Training state pytrees and optimizer construction.
+
+Optimizer hyperparameters mirror the reference with Keras's defaults made
+explicit: ``Adam(2e-4, beta_1=0.5)`` with eps=1e-7 for the BCE families
+(``GAN/GAN.py:100``), ``RMSprop(5e-5)`` with rho=0.9/eps=1e-7 for the
+Wasserstein families (``GAN/WGAN.py:99``, ``GAN/MTSS_WGAN_GP.py:128``).
+The reference passes one optimizer *object* to two ``compile`` calls,
+which in Keras means independent slot variables per model — here that is
+simply two independent optax states.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import GanPair, build_gan
+
+
+class GanState(flax.struct.PyTreeNode):
+    g_params: Any
+    d_params: Any
+    g_opt: Any
+    d_opt: Any
+    step: jnp.ndarray
+
+
+def make_optimizers(pair: GanPair, tcfg: TrainConfig) -> Tuple[optax.GradientTransformation, optax.GradientTransformation]:
+    if pair.loss == "bce":
+        opt = lambda: optax.adam(tcfg.adam_lr, b1=tcfg.adam_b1, b2=0.999, eps=1e-7)
+    else:
+        opt = lambda: optax.rmsprop(tcfg.rmsprop_lr, decay=0.9, eps=1e-7)
+    return opt(), opt()
+
+
+def init_gan_state(key: jax.Array, mcfg: ModelConfig, tcfg: TrainConfig,
+                   pair: GanPair | None = None) -> GanState:
+    if pair is None:
+        pair = build_gan(mcfg)
+    kg, kd = jax.random.split(key)
+    dummy = jnp.zeros((1, mcfg.window, mcfg.features), jnp.float32)
+    g_params = pair.generator.init(kg, dummy)["params"]
+    d_params = pair.discriminator.init(kd, dummy)["params"]
+    g_tx, d_tx = make_optimizers(pair, tcfg)
+    return GanState(
+        g_params=g_params,
+        d_params=d_params,
+        g_opt=g_tx.init(g_params),
+        d_opt=d_tx.init(d_params),
+        step=jnp.zeros((), jnp.int32),
+    )

@@ -1,0 +1,2 @@
+from hfrep_tpu.utils.logging import MetricLogger  # noqa: F401
+from hfrep_tpu.utils.profiling import StepTimer  # noqa: F401

@@ -1,0 +1,66 @@
+"""Checkpoint / resume (SURVEY §5.3-5.4 — near-absent in the reference).
+
+The reference saves only the generator, only once, after the full
+5000-epoch run (``GAN/MTSS_WGAN_GP.py:285-287``) — a crash loses
+everything, and resume is impossible because optimizer/critic state is
+discarded.  Here a checkpoint is the complete training pytree: G and D
+params, both optimizer states, the step counter, the PRNG key, and the
+MinMax scaler params needed to inverse-transform generated samples.
+
+Backed by orbax's PyTree checkpointer (async-capable, TPU-sharding
+aware); falls back to msgpack via flax.serialization if orbax is
+unavailable at runtime.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def save(path: str, pytree: Any, metadata: Optional[dict] = None) -> None:
+    p = Path(path).absolute()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    pytree = jax.device_get(pytree)
+    try:
+        ckptr = _ocp().PyTreeCheckpointer()
+        ckptr.save(p, pytree, force=True)
+    except Exception:
+        import flax.serialization as ser
+        p.mkdir(parents=True, exist_ok=True)
+        (p / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
+    if metadata is not None:
+        (p.parent / (p.name + ".meta.json")).write_text(json.dumps(metadata))
+
+
+def restore(path: str, target: Any = None) -> Any:
+    p = Path(path).absolute()
+    msgpack = p / "checkpoint.msgpack"
+    if msgpack.exists():
+        import flax.serialization as ser
+        if target is None:
+            raise ValueError("msgpack restore requires a target pytree")
+        return ser.from_bytes(target, msgpack.read_bytes())
+    ckptr = _ocp().PyTreeCheckpointer()
+    restored = ckptr.restore(p, item=jax.device_get(target) if target is not None else None)
+    return restored
+
+
+def latest(dirpath: str, prefix: str = "ckpt_") -> Optional[str]:
+    d = Path(dirpath)
+    if not d.exists():
+        return None
+    cands = sorted(
+        (p for p in d.iterdir() if p.name.startswith(prefix) and p.is_dir()),
+        key=lambda p: int(p.name[len(prefix):]),
+    )
+    return str(cands[-1]) if cands else None

@@ -1,0 +1,48 @@
+"""Step timing and profiler hooks (SURVEY §5.1 — absent in the reference).
+
+`StepTimer` measures device-synchronized wall time around jitted calls
+and reports steps/sec — BASELINE.json's primary runtime metric.
+`trace` wraps `jax.profiler.trace` for on-demand XLA profiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+import jax
+
+
+class StepTimer:
+    """Accumulates (steps, seconds) pairs; call ``sync()`` on a device
+    array before stopping the clock so XLA's async dispatch doesn't lie."""
+
+    def __init__(self) -> None:
+        self.samples: List[tuple[int, float]] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, n_steps: int, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        dt = time.perf_counter() - self._t0
+        self.samples.append((n_steps, dt))
+        return dt
+
+    @property
+    def steps_per_sec(self) -> float:
+        steps = sum(n for n, _ in self.samples)
+        secs = sum(t for _, t in self.samples)
+        return steps / secs if secs else float("nan")
+
+    def reset(self) -> None:
+        self.samples.clear()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    with jax.profiler.trace(log_dir):
+        yield

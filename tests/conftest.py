@@ -1,0 +1,33 @@
+"""Test harness: 8 virtual CPU devices so mesh/shard_map logic runs
+anywhere (SURVEY §4 implication); must set flags before jax initializes."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize pins JAX_PLATFORMS=axon (the tunneled TPU);
+# config.update is the override that actually wins for tests.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(123)
+
+
+@pytest.fixture(scope="session")
+def panel_arrays(rng):
+    """Synthetic (T, F) panels shaped like cleaned_data (337 months)."""
+    t = 120
+    factors = rng.normal(0, 0.03, (t, 22)).astype(np.float32)
+    hf = rng.normal(0, 0.02, (t, 13)).astype(np.float32)
+    rf = rng.normal(0.001, 0.0005, (t, 1)).astype(np.float32)
+    return factors, hf, rf
