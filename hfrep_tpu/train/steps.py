@@ -42,7 +42,6 @@ data parallelism (see :mod:`hfrep_tpu.parallel`).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -73,6 +72,18 @@ def _sample_real(key, dataset: jnp.ndarray, batch: int) -> jnp.ndarray:
     return jnp.take(dataset, idx, axis=0)
 
 
+def gradient_penalty(d_apply: Callable, d_params, interp: jnp.ndarray) -> jnp.ndarray:
+    """mean((1 − ‖∇_x̂ c(x̂)‖)²) over the batch of interpolates.
+
+    Exact-gradient port of ``gradient_penalty_loss``
+    (``GAN/MTSS_WGAN_GP.py:201-216``): per-sample L2 norm over all
+    non-batch axes of the critic's input gradient at x̂.
+    """
+    grads = jax.grad(lambda x: jnp.sum(d_apply(d_params, x)))(interp)
+    norms = jnp.sqrt(jnp.sum(grads**2, axis=tuple(range(1, grads.ndim))) + 1e-12)
+    return jnp.mean((1.0 - norms) ** 2)
+
+
 def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                     axis_name: Optional[str] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
     """Build ``step(state, key) -> (state, metrics)`` for one epoch."""
@@ -89,7 +100,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         updates, d_opt = d_tx.update(grads, d_opt, d_params)
         return optax.apply_updates(d_params, updates), d_opt, loss, aux
 
-    def g_update(state: GanState, noise, loss_fn):
+    def g_update(state: GanState, loss_fn):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.g_params)
         grads = _psum_if(axis_name, grads)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
@@ -117,7 +128,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         def loss_g(p):
             return _bce_logits(d_apply(state.d_params, g_apply(p, jax.random.normal(k_z2, noise_shape))), 1.0), None
 
-        state, g_loss = g_update(state, None, loss_g)
+        state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": 0.5 * (l_real + l_fake),
                        "d_acc": 0.5 * (acc_r + acc_f), "g_loss": g_loss}
 
@@ -154,7 +165,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             # reference reuses the final critic-loop noise (GAN/WGAN.py:203)
             return jnp.mean(-d_apply(state.d_params, g_apply(p, noise))), None
 
-        state, g_loss = g_update(state, noise, loss_g)
+        state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}
 
     # -------------------------------------------------------------- wgan_gp
@@ -163,13 +174,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     def gp_critic_loss(d_params, g_params, real, noise, alpha):
         fake = lax.stop_gradient(g_apply(g_params, noise))
         interp = alpha * real + (1.0 - alpha) * fake
-
-        def critic_scalar(x):
-            return jnp.sum(d_apply(d_params, x))
-
-        grads = jax.grad(critic_scalar)(interp)
-        norms = jnp.sqrt(jnp.sum(grads**2, axis=tuple(range(1, grads.ndim))) + 1e-12)
-        gp = jnp.mean((1.0 - norms) ** 2)
+        gp = gradient_penalty(d_apply, d_params, interp)
         w_loss = jnp.mean(-d_apply(d_params, real)) + jnp.mean(d_apply(d_params, fake))
         return w_loss + gp_w * gp, (w_loss, gp)
 
@@ -196,7 +201,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             # reference reuses the final critic-loop noise (GAN/MTSS_WGAN_GP.py:281)
             return jnp.mean(-d_apply(state.d_params, g_apply(p, noise))), None
 
-        state, g_loss = g_update(state, noise, loss_g)
+        state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}
 
     return {"bce": bce_step, "wgan_clip": wgan_step, "wgan_gp": wgan_gp_step}[pair.loss]

@@ -10,7 +10,6 @@ debugging via ``jax.config.update("jax_debug_nans", True)``.
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax
@@ -20,7 +19,6 @@ import numpy as np
 from hfrep_tpu.config import ExperimentConfig
 from hfrep_tpu.core.data import GanDataset
 from hfrep_tpu.models.registry import build_gan
-from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
 from hfrep_tpu.train.states import GanState, init_gan_state
 from hfrep_tpu.train.steps import make_multi_step
 from hfrep_tpu.utils import checkpoint as ckpt
@@ -40,6 +38,8 @@ class GanTrainer:
         self.key, init_key = jax.random.split(key)
         self.state = init_gan_state(init_key, cfg.model, cfg.train, self.pair)
         if mesh is not None:
+            # local import: parallel depends on train.states, avoid a cycle
+            from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
             self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
         else:
             self._multi = make_multi_step(self.pair, cfg.train, self.windows)
@@ -47,13 +47,17 @@ class GanTrainer:
         self.logger = logger or MetricLogger(echo=False, echo_style=style)
         self.timer = StepTimer()
         self.epoch = 0
+        #: per-epoch metric history (host numpy), kept even with a null logger
+        self.history: list[dict] = []
+        self._single_step = None
+        self._generate_fn = None
 
     # ------------------------------------------------------------ training
     def train(self, epochs: Optional[int] = None) -> GanState:
         tcfg = self.cfg.train
         epochs = epochs if epochs is not None else tcfg.epochs
-        n_calls = math.ceil(epochs / tcfg.steps_per_call)
-        for _ in range(n_calls):
+        n_full, remainder = divmod(epochs, tcfg.steps_per_call)
+        for _ in range(n_full):
             self.key, sub = jax.random.split(self.key)
             self.timer.start()
             self.state, metrics = self._multi(self.state, sub)
@@ -62,15 +66,31 @@ class GanTrainer:
             self.epoch += tcfg.steps_per_call
             if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < tcfg.steps_per_call:
                 self.save_checkpoint()
+        for _ in range(remainder):
+            # exact epoch counts: leftover epochs run on a cached 1-epoch step
+            self.key, sub = jax.random.split(self.key)
+            self.timer.start()
+            self.state, metrics = self._one(self.state, sub)
+            self.timer.stop(1, sync_on=self.state.g_params)
+            self._log_block(jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics), 1)
+            self.epoch += 1
         self.logger.flush()
         return self.state
+
+    def _one(self, state, key):
+        if self._single_step is None:
+            from hfrep_tpu.train.steps import make_train_step
+            self._single_step = jax.jit(make_train_step(self.pair, self.cfg.train, self.windows))
+        return self._single_step(state, key)
 
     def _log_block(self, metrics: dict, n: int) -> None:
         host = jax.device_get(metrics)
         for i in range(n):
             e = self.epoch + i
+            rec = {k: v[i] for k, v in host.items()}
+            self.history.append({"epoch": e, **{k: float(v) for k, v in rec.items()}})
             if e % self.cfg.train.log_every == 0:
-                self.logger.log(e, {k: v[i] for k, v in host.items()})
+                self.logger.log(e, rec)
 
     @property
     def steps_per_sec(self) -> float:
@@ -111,8 +131,10 @@ class GanTrainer:
         (``autoencoder_v4.ipynb`` cell 43), inverse-scaled by default."""
         w, f = self.windows.shape[1], self.windows.shape[2]
         noise = jax.random.normal(key, (n_samples, w, f))
-        out = jax.jit(lambda p, z: self.pair.generator.apply({"params": p}, z))(
-            self.state.g_params, noise)
+        if self._generate_fn is None:
+            self._generate_fn = jax.jit(
+                lambda p, z: self.pair.generator.apply({"params": p}, z))
+        out = self._generate_fn(self.state.g_params, noise)
         if unscale and self.scaler is not None:
             from hfrep_tpu.core import scaler as mm
             out = mm.inverse_transform(self.scaler, out)

@@ -59,8 +59,9 @@ def latest(dirpath: str, prefix: str = "ckpt_") -> Optional[str]:
     d = Path(dirpath)
     if not d.exists():
         return None
-    cands = sorted(
-        (p for p in d.iterdir() if p.name.startswith(prefix) and p.is_dir()),
-        key=lambda p: int(p.name[len(prefix):]),
-    )
+    cands = [
+        p for p in d.iterdir()
+        if p.is_dir() and p.name.startswith(prefix) and p.name[len(prefix):].isdigit()
+    ]
+    cands.sort(key=lambda p: int(p.name[len(prefix):]))
     return str(cands[-1]) if cands else None

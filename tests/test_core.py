@@ -1,0 +1,151 @@
+"""Core numerics vs. the reference formulas on tiny fixed arrays (SURVEY §4 plan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.core import costs, scaler as mm
+from hfrep_tpu.core.sampling import factor_hf_split, sample_windows
+
+
+class TestScaler:
+    def test_matches_sklearn(self, rng):
+        from sklearn.preprocessing import MinMaxScaler
+
+        x = rng.normal(size=(50, 7)).astype(np.float32)
+        ours = np.asarray(mm.fit_transform(jnp.asarray(x))[1])
+        theirs = MinMaxScaler().fit_transform(x)
+        np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+    def test_inverse_roundtrip(self, rng):
+        x = jnp.asarray(rng.normal(size=(30, 5)).astype(np.float32))
+        p, y = mm.fit_transform(x)
+        np.testing.assert_allclose(np.asarray(mm.inverse_transform(p, y)), np.asarray(x), atol=1e-5)
+
+    def test_zero_range_column(self):
+        x = jnp.asarray(np.array([[1.0, 2.0], [1.0, 3.0]], np.float32))
+        p, y = mm.fit_transform(x)
+        assert np.isfinite(np.asarray(y)).all()
+        np.testing.assert_allclose(np.asarray(y[:, 0]), [0.0, 0.0])
+
+
+class TestSampling:
+    def test_shapes_and_contiguity(self, rng):
+        data = jnp.asarray(rng.normal(size=(100, 4)).astype(np.float32))
+        w = sample_windows(jax.random.PRNGKey(0), data, 17, 12)
+        assert w.shape == (17, 12, 4)
+        data_np = np.asarray(data)
+        for win in np.asarray(w):
+            # every sampled window must be a contiguous slice of the panel
+            start = np.where((data_np == win[0]).all(axis=1))[0]
+            assert len(start) == 1
+            np.testing.assert_array_equal(data_np[start[0]:start[0] + 12], win)
+
+    def test_start_range_inclusive(self):
+        # helper.py:57 randint(0, T-window) is inclusive: start T-window valid
+        data = jnp.arange(20, dtype=jnp.float32).reshape(10, 2)
+        w = sample_windows(jax.random.PRNGKey(3), data, 256, 10)
+        # only one valid window when window == T
+        assert np.asarray(w).std(axis=0).max() == 0
+
+    def test_factor_hf_split_matches_reference(self, rng):
+        arr = rng.normal(size=(5, 8, 7)).astype(np.float32)
+        f, h = factor_hf_split(jnp.asarray(arr), 4)
+        # reference helper.py:133-153 semantics
+        f_ref = arr[:, :, :4].reshape(-1, 4)
+        h_ref = arr[:, :, 4:].reshape(-1, 3)
+        np.testing.assert_allclose(np.asarray(f), f_ref, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(h), h_ref, atol=1e-7)
+
+
+def _ref_transaction_cost(old_x, new_x, cov, param=0.05):
+    # helper.py:65-80 verbatim math in numpy
+    vol = np.sqrt(np.diag(np.asarray(cov))) * param
+    delta = np.asarray(old_x) - np.asarray(new_x)
+    return 0.5 * delta**2 * vol
+
+
+def _ref_price_impact(old_x, new_x, cov, param=0.05, phi=0.5):
+    vol = np.sqrt(np.diag(np.asarray(cov))) * param
+    old_x, new_x = np.asarray(old_x), np.asarray(new_x)
+    delta = old_x - new_x
+    return phi * new_x * vol * delta - old_x * vol * delta - 0.5 * delta**2 * vol
+
+
+class TestCosts:
+    def test_transaction_cost(self, rng):
+        cov = np.cov(rng.normal(size=(30, 5)), rowvar=False)
+        old, new = rng.normal(size=5), rng.normal(size=5)
+        vol = jnp.sqrt(jnp.diag(jnp.asarray(cov)))
+        ours = costs.transaction_cost(old, new, vol)
+        np.testing.assert_allclose(np.asarray(ours), _ref_transaction_cost(old, new, cov), rtol=1e-5)
+
+    def test_price_impact(self, rng):
+        cov = np.cov(rng.normal(size=(30, 5)), rowvar=False)
+        old, new = rng.normal(size=5), rng.normal(size=5)
+        vol = jnp.sqrt(jnp.diag(jnp.asarray(cov)))
+        ours = costs.price_impact(old, new, vol)
+        np.testing.assert_allclose(np.asarray(ours), _ref_price_impact(old, new, cov), rtol=1e-5)
+
+    def test_rolling_cov_diag_matches_pandas(self, rng):
+        import pandas as pd
+
+        panel = rng.normal(size=(40, 6)).astype(np.float64)
+        window = 10
+        ours = np.asarray(costs.rolling_cov_diag_vol(jnp.asarray(panel, dtype=jnp.float32), window))
+        for i in range(panel.shape[0] - window + 1):
+            ref = np.sqrt(np.diag(pd.DataFrame(panel[i:i + window]).cov()))
+            np.testing.assert_allclose(ours[i], ref, rtol=1e-4)
+
+    def test_ex_post_return_matches_reference_loop(self, rng):
+        import pandas as pd
+
+        p, s, a, window = 12, 3, 5, 6
+        ex_ante = rng.normal(size=(p, s))
+        weights = rng.normal(size=(s, p, a)) * 0.1
+        factor_etf = rng.normal(size=(p + window, a))
+
+        # --- reference loop (helper.py:112-131), pandas edition
+        expost_ref = np.zeros_like(ex_ante)
+        fe = pd.DataFrame(factor_etf)
+        for idx in range(s):
+            penalties = []
+            for i in range(1, p):
+                cov = fe.iloc[i:i + window].cov().values
+                new_x, old_x = weights[idx, i], weights[idx, i - 1]
+                pen = (_ref_transaction_cost(old_x, new_x, cov)
+                       + _ref_price_impact(old_x, new_x, cov)).sum()
+                penalties.append(pen)
+            expost_ref[0, idx] = ex_ante[0, idx]
+            for i in range(1, p):
+                expost_ref[i, idx] = ex_ante[i, idx] + penalties[i - 1]
+
+        ours = costs.ex_post_return(
+            jnp.asarray(ex_ante, jnp.float32), window,
+            jnp.asarray(weights, jnp.float32), jnp.asarray(factor_etf, jnp.float32))
+        np.testing.assert_allclose(np.asarray(ours), expost_ref, rtol=1e-3, atol=1e-5)
+
+    def test_normalization_matches_reference(self, rng):
+        y = rng.normal(size=(24, 3))
+        x = rng.normal(size=(24, 4))
+        beta = rng.normal(size=(4, 3))
+        # helper.py:10-17 verbatim
+        r_hat = x @ beta
+        den = np.sum((r_hat - r_hat.mean(axis=0)) ** 2 / 23, axis=0)
+        num = np.sum((y - y.mean(axis=0)) ** 2 / 23, axis=0)
+        ref = np.sqrt(num) / np.sqrt(den)
+        ours = costs.normalization(jnp.asarray(y, jnp.float32), jnp.asarray(x, jnp.float32),
+                                   jnp.asarray(beta, jnp.float32), 24)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4)
+
+    def test_turnover_matches_reference(self, rng):
+        # Autoencoder_encapsulate.py:210-224: weights list of (A, S) mats
+        p, a, s = 10, 4, 3
+        w = rng.normal(size=(p, a, s))
+        ref = np.zeros(s)
+        for i in range(p - 1):
+            ref += np.sum(np.abs(w[i] - w[i + 1]), axis=0)
+        ref /= p / 12
+        ours = costs.turnover(jnp.asarray(w, jnp.float32))
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4)

@@ -1,0 +1,103 @@
+"""Model-shape and registry contracts against the reference architectures."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.models import (
+    Autoencoder, DenseDiscriminator, DenseFlatCritic, LSTMFlatCritic, build_gan,
+)
+from hfrep_tpu.models.autoencoder import latent_mask
+from hfrep_tpu.models.registry import FAMILIES
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_generator_output_shape(family):
+    pair = build_gan(ModelConfig(family=family, features=7, window=12, hidden=8))
+    z = jnp.zeros((4, 12, 7))
+    params = pair.generator.init(jax.random.PRNGKey(0), z)["params"]
+    out = pair.generator.apply({"params": params}, z)
+    assert out.shape == (4, 12, 7)
+
+
+@pytest.mark.parametrize("family,score_shape", [
+    ("gan", (4, 12, 1)),          # per-timestep validity, GAN/GAN.py:144-158
+    ("wgan", (4, 12, 1)),         # GAN/WGAN.py:146-163
+    ("wgan_gp", (4, 1)),          # flattened head, GAN/WGAN_GP.py:238-253
+    ("mtss_gan", (4, 12, 1)),     # GAN/MTSS_GAN.py:143-157
+    ("mtss_wgan", (4, 12, 1)),    # GAN/MTSS_WGAN.py:146-163
+    ("mtss_wgan_gp", (4, 1)),     # GAN/MTSS_WGAN_GP.py:237-252
+])
+def test_discriminator_output_shape(family, score_shape):
+    pair = build_gan(ModelConfig(family=family, features=7, window=12, hidden=8))
+    x = jnp.zeros((4, 12, 7))
+    params = pair.discriminator.init(jax.random.PRNGKey(0), x)["params"]
+    out = pair.discriminator.apply({"params": params}, x)
+    assert out.shape == score_shape
+
+
+def test_registry_loss_kinds():
+    kinds = {f: build_gan(ModelConfig(family=f, features=5, window=6)).loss for f in FAMILIES}
+    assert kinds == {
+        "gan": "bce", "mtss_gan": "bce",
+        "wgan": "wgan_clip", "mtss_wgan": "wgan_clip",
+        "wgan_gp": "wgan_gp", "mtss_wgan_gp": "wgan_gp",
+    }
+
+
+def test_production_shape_168x36():
+    """The paper's production generator used (168, 36) windows (SURVEY §2)."""
+    pair = build_gan(ModelConfig(family="mtss_wgan_gp", features=36, window=168))
+    z = jnp.zeros((2, 168, 36))
+    params = pair.generator.init(jax.random.PRNGKey(0), z)["params"]
+    assert pair.generator.apply({"params": params}, z).shape == (2, 168, 36)
+
+
+class TestAutoencoder:
+    def test_roundtrip_shapes(self, rng):
+        ae = Autoencoder(n_features=22, latent_dim=21)
+        x = jnp.asarray(rng.normal(size=(10, 22)).astype(np.float32))
+        params = ae.init(jax.random.PRNGKey(0), x)["params"]
+        assert ae.apply({"params": params}, x).shape == (10, 22)
+        z = ae.apply({"params": params}, x, method=Autoencoder.encode)
+        assert z.shape == (10, 21)
+
+    def test_bias_free_two_matmuls(self, rng):
+        ae = Autoencoder(n_features=5, latent_dim=3)
+        params = ae.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))["params"]
+        # exactly two kernels, no biases — Autoencoder_encapsulate.py:23-30
+        assert set(params) == {"encoder_kernel", "decoder_kernel"}
+        assert params["encoder_kernel"].shape == (5, 3)
+        assert params["decoder_kernel"].shape == (3, 5)
+
+    def test_latent_mask_equivalence(self, rng):
+        """A masked max-latent AE must equal the small AE with the same
+        leading weights: the masked-sweep correctness property."""
+        x = jnp.asarray(rng.normal(size=(6, 8)).astype(np.float32))
+        big = Autoencoder(n_features=8, latent_dim=5)
+        params_big = big.init(jax.random.PRNGKey(1), x)["params"]
+        k = 3
+        small = Autoencoder(n_features=8, latent_dim=k)
+        params_small = {
+            "encoder_kernel": params_big["encoder_kernel"][:, :k],
+            "decoder_kernel": params_big["decoder_kernel"][:k, :],
+        }
+        out_masked = big.apply({"params": params_big}, x, latent_mask(k, 5))
+        out_small = small.apply({"params": params_small}, x)
+        np.testing.assert_allclose(np.asarray(out_masked), np.asarray(out_small), atol=1e-6)
+
+    def test_masked_gradients_zero(self, rng):
+        x = jnp.asarray(rng.normal(size=(6, 8)).astype(np.float32))
+        ae = Autoencoder(n_features=8, latent_dim=5)
+        params = ae.init(jax.random.PRNGKey(1), x)["params"]
+        mask = latent_mask(3, 5)
+
+        def loss(p):
+            out = ae.apply({"params": p}, x, mask)
+            return jnp.mean((out - x) ** 2)
+
+        g = jax.grad(loss)(params)
+        np.testing.assert_allclose(np.asarray(g["encoder_kernel"][:, 3:]), 0.0, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(g["decoder_kernel"][3:, :]), 0.0, atol=1e-7)

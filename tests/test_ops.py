@@ -1,0 +1,99 @@
+"""ops layer: LSTM vs hand-rolled Keras-semantics numpy, rolling OLS vs statsmodels."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.ops.lstm import KerasLSTM
+from hfrep_tpu.ops.rolling import expanding_minmax_scale, ols_beta, rolling_ols_beta
+from hfrep_tpu.ops.sqrtm import sqrtm_product_trace
+
+
+def _np_keras_lstm(x, kernel, recurrent, bias, activation):
+    """Reference Keras LSTM forward in numpy: gates [i, f, c, o],
+    recurrent_activation=sigmoid, `activation` on candidate & output."""
+    sigmoid = lambda v: 1.0 / (1.0 + np.exp(-v))
+    act = {"tanh": np.tanh, "sigmoid": sigmoid, None: lambda v: v}[activation]
+    b, w, f = x.shape
+    h = recurrent.shape[0]
+    h_t = np.zeros((b, h))
+    c_t = np.zeros((b, h))
+    out = []
+    for t in range(w):
+        z = x[:, t] @ kernel + h_t @ recurrent + bias
+        zi, zf, zc, zo = np.split(z, 4, axis=-1)
+        i, fg, o = sigmoid(zi), sigmoid(zf), sigmoid(zo)
+        c_t = fg * c_t + i * act(zc)
+        h_t = o * act(c_t)
+        out.append(h_t)
+    return np.stack(out, axis=1)
+
+
+@pytest.mark.parametrize("activation", ["tanh", "sigmoid", None])
+def test_lstm_matches_keras_semantics(rng, activation):
+    b, w, f, h = 3, 7, 5, 6
+    x = rng.normal(size=(b, w, f)).astype(np.float32)
+    m = KerasLSTM(h, activation=activation)
+    params = m.init(jax.random.PRNGKey(0), jnp.asarray(x))["params"]
+    ours = np.asarray(m.apply({"params": params}, jnp.asarray(x)))
+    ref = _np_keras_lstm(
+        x.astype(np.float64),
+        np.asarray(params["kernel"], np.float64),
+        np.asarray(params["recurrent_kernel"], np.float64),
+        np.asarray(params["bias"], np.float64),
+        activation,
+    )
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_lstm_unit_forget_bias(rng):
+    m = KerasLSTM(4)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 2)))["params"]
+    bias = np.asarray(params["bias"])
+    np.testing.assert_array_equal(bias[4:8], np.ones(4))    # forget block
+    np.testing.assert_array_equal(bias[:4], np.zeros(4))
+    np.testing.assert_array_equal(bias[8:], np.zeros(8))
+
+
+def test_rolling_ols_matches_lstsq(rng):
+    # statsmodels.OLS(Y, X).fit().params is pinv least-squares; numpy
+    # lstsq is the same oracle without the dependency
+    t, k, s, window = 40, 4, 3, 12
+    x = rng.normal(size=(t, k))
+    y = rng.normal(size=(t, s))
+    betas = np.asarray(rolling_ols_beta(jnp.asarray(y, jnp.float32),
+                                        jnp.asarray(x, jnp.float32), window))
+    for i in [0, 5, t - window]:
+        ref = np.linalg.lstsq(x[i:i + window], y[i:i + window], rcond=None)[0]
+        np.testing.assert_allclose(betas[i], ref, atol=1e-3)
+
+
+def test_ols_beta_with_constant_matches_lstsq(rng):
+    x = rng.normal(size=(60, 3))
+    y = rng.normal(size=(60,))
+    xc = np.concatenate([np.ones((60, 1)), x], axis=1)
+    ref = np.linalg.lstsq(xc, y, rcond=None)[0]
+    ours = np.asarray(ols_beta(jnp.asarray(y[:, None], jnp.float32),
+                               jnp.asarray(x, jnp.float32), add_constant=True))[:, 0]
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+def test_expanding_minmax(rng):
+    x = rng.normal(size=(20, 3)).astype(np.float32)
+    mins, maxs = expanding_minmax_scale(jnp.asarray(x))
+    for i in range(1, 20):
+        np.testing.assert_allclose(np.asarray(mins[i]), x[:i + 1].min(axis=0), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(maxs[i]), x[:i + 1].max(axis=0), atol=1e-6)
+
+
+def test_sqrtm_product_trace_matches_scipy(rng):
+    from scipy.linalg import sqrtm
+
+    a = rng.normal(size=(50, 6))
+    b = rng.normal(size=(50, 6))
+    s1 = np.cov(a, rowvar=False)
+    s2 = np.cov(b, rowvar=False)
+    ref = np.trace(sqrtm(s1 @ s2).real)
+    ours = float(sqrtm_product_trace(jnp.asarray(s1, jnp.float32), jnp.asarray(s2, jnp.float32)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-3)

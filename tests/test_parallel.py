@@ -1,0 +1,98 @@
+"""Multi-device semantics on the 8-way virtual CPU mesh (SURVEY §4/§5.8)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import ExperimentConfig, MeshConfig, ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+from hfrep_tpu.parallel.mesh import make_mesh
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.trainer import GanTrainer
+
+MCFG = ModelConfig(features=5, window=8, hidden=8)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(7)
+    return jnp.asarray(g.uniform(0, 1, (64, 8, 5)).astype(np.float32))
+
+
+def test_mesh_uses_all_devices():
+    mesh = make_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("dp",)
+
+
+@pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp", "mtss_wgan_gp"])
+def test_dp_step_runs_and_replicates(family, dataset):
+    mesh = make_mesh()
+    tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=2)
+    mcfg = dataclasses.replace(MCFG, family=family)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    fn = make_dp_multi_step(pair, tcfg, dataset, mesh)
+    new_state, metrics = fn(state, jax.random.PRNGKey(1))
+    assert int(new_state.step) == 2
+    assert np.isfinite(np.asarray(metrics["g_loss"])).all()
+    # parameters must be fully replicated across the mesh
+    leaf = jax.tree_util.tree_leaves(new_state.g_params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_dp_batch_divisibility_error(dataset):
+    mesh = make_mesh()
+    pair = build_gan(MCFG)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_dp_multi_step(pair, TrainConfig(batch_size=9), dataset, mesh)
+
+
+def test_dp_trainer_end_to_end(dataset):
+    cfg = ExperimentConfig(
+        model=dataclasses.replace(MCFG, family="wgan"),
+        train=TrainConfig(epochs=4, batch_size=16, n_critic=2, steps_per_call=2),
+    )
+    tr = GanTrainer(cfg, dataset, mesh=make_mesh())
+    tr.train()
+    assert int(tr.state.step) == 4
+    assert tr.steps_per_sec > 0
+
+
+def test_dp_gradient_is_global_batch_mean(dataset):
+    """pmean'd per-shard gradients must equal the global-batch gradient.
+
+    Verified directly on a BCE discriminator loss: compute the gradient of
+    the mean loss over a fixed global batch on one device, and via 8-way
+    sharded pmean; they must agree."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshConfig())
+    mcfg = dataclasses.replace(MCFG, family="gan")
+    pair = build_gan(mcfg)
+    params = pair.discriminator.init(jax.random.PRNGKey(0), dataset[:1])["params"]
+    batch = dataset[:16]
+
+    def loss(p, x):
+        import optax
+        logits = pair.discriminator.apply({"params": p}, x)
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, jnp.ones_like(logits)))
+
+    g_ref = jax.grad(loss)(params, batch)
+
+    def shard_grad(p, x):
+        g = jax.grad(loss)(p, x)
+        return jax.lax.pmean(g, "dp")
+
+    fn = shard_map(shard_grad, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
+                   check_vma=False)
+    g_dp = fn(params, batch)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref), jax.tree_util.tree_leaves(g_dp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
