@@ -1,0 +1,57 @@
+"""Gaussian naive-Bayes class-probability probe, pure jnp.
+
+The reference's KL/JS divergences are not closed-form divergences between
+the sample distributions — they are divergences between the *class
+probabilities* a ``sklearn.naive_bayes.GaussianNB`` assigns to real vs
+fake windows after being taught to recognize which **feature** a
+window-series belongs to (``GAN/GAN_eval.py:178-187``).  That probe is ~30
+lines of Gaussian log-pdf math (SURVEY §7 stage 2), reimplemented here as
+pure functions so the whole metric is jittable.
+
+Matches sklearn semantics: per-class per-dim mean/variance with variance
+smoothing ``1e-9 · max_d Var(X_d)`` added to every variance, uniform-ish
+priors from class counts, probabilities via softmax over joint
+log-likelihoods.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GaussianNBParams(NamedTuple):
+    theta: jnp.ndarray       # (C, D) per-class means
+    var: jnp.ndarray         # (C, D) smoothed variances
+    log_prior: jnp.ndarray   # (C,)
+
+
+def fit_gaussian_nb(x: jnp.ndarray, y: jnp.ndarray, n_classes: int,
+                    var_smoothing: float = 1e-9) -> GaussianNBParams:
+    """``x`` (N, D) float, ``y`` (N,) int class labels in [0, n_classes)."""
+    one_hot = jax.nn.one_hot(y, n_classes, dtype=x.dtype)       # (N, C)
+    counts = one_hot.sum(axis=0)                                # (C,)
+    safe = jnp.maximum(counts, 1.0)
+    theta = (one_hot.T @ x) / safe[:, None]
+    sq = (one_hot.T @ (x * x)) / safe[:, None]
+    var = sq - theta**2
+    eps = var_smoothing * jnp.max(jnp.var(x, axis=0))
+    return GaussianNBParams(theta=theta, var=var + eps,
+                            log_prior=jnp.log(counts / counts.sum()))
+
+
+def joint_log_likelihood(params: GaussianNBParams, x: jnp.ndarray) -> jnp.ndarray:
+    """(N, D) → (N, C) unnormalized class log-probabilities."""
+    # -(1/2) sum_d [ log(2π var) + (x - θ)² / var ]
+    x_ = x[:, None, :]                                          # (N, 1, D)
+    ll = -0.5 * jnp.sum(
+        jnp.log(2.0 * jnp.pi * params.var)[None] + (x_ - params.theta[None])**2 / params.var[None],
+        axis=-1,
+    )
+    return ll + params.log_prior[None]
+
+
+def predict_proba(params: GaussianNBParams, x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(joint_log_likelihood(params, x), axis=-1)

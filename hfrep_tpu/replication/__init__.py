@@ -1,0 +1,2 @@
+from hfrep_tpu.replication.engine import AEResult, ReplicationEngine, train_autoencoder  # noqa: F401
+from hfrep_tpu.replication import perf_stats, spanning  # noqa: F401

@@ -1,0 +1,339 @@
+"""Autoencoder replication engine: training, evaluation, strategy build.
+
+TPU-native re-design of ``Autoencoder_encapsulate.py:38-224`` (class
+``AE``).  Where the reference trains 21 separate Keras models in a Python
+loop with per-call ``predict`` inside O(T) host loops (SURVEY §3.3), here:
+
+* one AE training run is a single `lax.scan` over epochs with
+  Keras-faithful early stopping folded into the carry;
+* the latent-dim sweep is `vmap` over a latent *mask* (same param shapes,
+  see :mod:`hfrep_tpu.models.autoencoder`) — all 21 trainings execute as
+  one batched XLA program;
+* the expanding-window OOS metrics use prefix min/max scans instead of
+  167 scaler refits;
+* the 24-month rolling OLS is one batched least-squares.
+
+Training recipe ported from ``Autoencoder_encapsulate.py:72-105``:
+MinMax-scale x_train only (``:62-67``; note ``_x_test`` stays *unscaled* —
+the encoder is later applied to raw test returns, ``:67,140``), Nadam on
+MSE, ≤1000 epochs, batch 48, ``validation_split=.25`` (Keras semantics:
+the *last* 25% of rows are validation, the first 75% train), per-epoch
+reshuffling of the train block, EarlyStopping(patience=5) on val_loss
+without best-weight restore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.core import costs
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.models.autoencoder import Autoencoder, latent_mask
+from hfrep_tpu.ops.rolling import expanding_minmax_scale, rolling_ols_beta
+
+import optax
+
+
+class AEResult(NamedTuple):
+    params: dict                 # encoder/decoder kernels (possibly batched)
+    stop_epoch: jnp.ndarray      # epoch index where early stopping fired
+    train_loss: jnp.ndarray     # (epochs,) per-epoch training loss (NaN after stop)
+    val_loss: jnp.ndarray       # (epochs,)
+
+
+def _epoch_batches(n_train: int, batch_size: int) -> Tuple[int, int]:
+    n_batches = -(-n_train // batch_size)
+    return n_batches, n_batches * batch_size
+
+
+def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
+                      mask: Optional[jnp.ndarray] = None) -> AEResult:
+    """Train one (optionally masked) AE; pure function of (key, data, cfg).
+
+    ``mask`` is a (max_latent,) 0/1 vector selecting active latent dims;
+    None trains the full ``cfg.latent_dim``.
+    """
+    model = Autoencoder(n_features=cfg.n_factors, latent_dim=cfg.latent_dim,
+                        slope=cfg.leaky_slope)
+    n = x_train_scaled.shape[0]
+    n_val = int(n * cfg.val_split)
+    n_train = n - n_val
+    x_fit, x_val = x_train_scaled[:n_train], x_train_scaled[n_train:]
+
+    key, init_key = jax.random.split(key)
+    params = model.init(init_key, x_fit[:1])["params"]
+    tx = optax.nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # Keras Nadam defaults
+    opt_state = tx.init(params)
+
+    n_batches, padded = _epoch_batches(n_train, cfg.batch_size)
+
+    def mse(p, x, w=None):
+        pred = model.apply({"params": p}, x, mask)
+        err = jnp.mean((pred - x) ** 2, axis=1)
+        if w is None:
+            return jnp.mean(err)
+        return jnp.sum(err * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    def epoch_step(carry, epoch_key):
+        params, opt_state, best_val, wait, stopped = carry
+        perm = jax.random.permutation(epoch_key, n_train)
+        order = jnp.concatenate([perm, jnp.zeros(padded - n_train, jnp.int32)])
+        weights = (jnp.arange(padded) < n_train).astype(jnp.float32)
+
+        def batch_step(c, i):
+            p, o = c
+            sl = lax.dynamic_slice_in_dim(order, i * cfg.batch_size, cfg.batch_size)
+            w = lax.dynamic_slice_in_dim(weights, i * cfg.batch_size, cfg.batch_size)
+            xb = jnp.take(x_fit, sl, axis=0)
+            loss, grads = jax.value_and_grad(mse)(p, xb, w)
+            updates, o = tx.update(grads, o, p)
+            return (optax.apply_updates(p, updates), o), loss
+
+        (new_params, new_opt), batch_losses = lax.scan(
+            batch_step, (params, opt_state), jnp.arange(n_batches))
+
+        # freeze updates once stopped (Keras keeps stop-epoch weights)
+        params = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(stopped, old, new), params, new_params)
+        opt_state = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(stopped, old, new), opt_state, new_opt)
+
+        val = mse(params, x_val)
+        improved = val < best_val
+        wait = jnp.where(stopped, wait, jnp.where(improved, 0, wait + 1))
+        best_val = jnp.where(stopped, best_val, jnp.minimum(best_val, val))
+        newly_stopped = jnp.logical_and(jnp.logical_not(stopped), wait >= cfg.patience)
+        train_loss = jnp.where(stopped, jnp.nan, jnp.mean(batch_losses))
+        val_out = jnp.where(stopped, jnp.nan, val)
+        stopped = jnp.logical_or(stopped, newly_stopped)
+        return (params, opt_state, best_val, wait, stopped), (train_loss, val_out, stopped)
+
+    keys = jax.random.split(key, cfg.epochs)
+    init = (params, opt_state, jnp.inf, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
+    (params, _, _, _, _), (tl, vl, stop_trace) = lax.scan(epoch_step, init, keys)
+    stop_epoch = jnp.argmax(stop_trace) + jnp.where(jnp.any(stop_trace), 0, cfg.epochs)
+    return AEResult(params=params, stop_epoch=stop_epoch, train_loss=tl, val_loss=vl)
+
+
+def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
+                       latent_dims: Sequence[int]) -> AEResult:
+    """All latent dims in one vmapped program (vs 21 serial Keras fits,
+    ``autoencoder_v4.ipynb`` cell 6).  Params come back with a leading
+    sweep axis; index with `jax.tree_util.tree_map(lambda a: a[i], ...)`."""
+    max_latent = max(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    keys = jax.random.split(key, len(latent_dims))
+    return jax.vmap(lambda k, m: train_autoencoder(k, x_train_scaled, cfg, m))(keys, masks)
+
+
+# ---------------------------------------------------------------- engine
+class ReplicationEngine:
+    """The reference ``AE`` wrapper's full API on one trained model.
+
+    Construction mirrors ``AE.__init__`` (``Autoencoder_encapsulate.py:39-70``):
+    unscaled train/test panels in, train-set MinMax params fit internally.
+    """
+
+    def __init__(self, x_train, y_train, x_test, y_test, cfg: AEConfig | None = None):
+        self.cfg = cfg or AEConfig()
+        if len(x_train) != len(y_train) or len(x_test) != len(y_test):
+            raise ValueError("x/y length mismatch")
+        self.x_train_raw = jnp.asarray(x_train, jnp.float32)
+        self.x_test = jnp.asarray(x_test, jnp.float32)      # unscaled, :67
+        self.y_train = jnp.asarray(y_train, jnp.float32)
+        self.y_test = jnp.asarray(y_test, jnp.float32)
+        self.train_scale, self.x_train = mm.fit_transform(self.x_train_raw)
+        self.model = Autoencoder(n_features=self.cfg.n_factors,
+                                 latent_dim=self.cfg.latent_dim,
+                                 slope=self.cfg.leaky_slope)
+        self.result: Optional[AEResult] = None
+        self.mask: Optional[jnp.ndarray] = None
+        self._ante = None
+        self._strat_weights = None      # (P, A, S)
+        self._post = None
+        self._train_fn = None
+        self._oos_eval_fn = None
+        self._oos_cache = None
+
+    # ------------------------------------------------------------ training
+    def train(self, key: Optional[jax.Array] = None) -> AEResult:
+        key = key if key is not None else jax.random.PRNGKey(self.cfg.seed)
+        if self._train_fn is None:
+            self._train_fn = jax.jit(lambda k: train_autoencoder(k, self.x_train, self.cfg))
+        self.result = self._train_fn(key)
+        self._oos_cache = None
+        return self.result
+
+    def use_params(self, params: dict, mask: Optional[jnp.ndarray] = None) -> None:
+        """Adopt externally trained (e.g. sweep-sliced) parameters."""
+        self.result = AEResult(params=params, stop_epoch=jnp.zeros((), jnp.int32),
+                               train_loss=jnp.zeros(()), val_loss=jnp.zeros(()))
+        self.mask = mask
+        self._oos_cache = None
+
+    @property
+    def params(self) -> dict:
+        if self.result is None:
+            raise RuntimeError("train() first")
+        return self.result.params
+
+    def _apply(self, x):
+        return self.model.apply({"params": self.params}, x, self.mask)
+
+    def _encode(self, x):
+        return self.model.apply({"params": self.params}, x, self.mask,
+                                method=Autoencoder.encode)
+
+    # ------------------------------------------------------------- metrics
+    def model_IS_r2(self) -> float:
+        """r2_score(x_train_scaled, reconstruction) — uniform average over
+        columns (``Autoencoder_encapsulate.py:107-109``)."""
+        pred = self._apply(self.x_train)
+        return float(_r2_columns_mean(self.x_train, pred))
+
+    def model_IS_RMSE(self) -> float:
+        pred = self._apply(self.x_train)
+        return float(jnp.sqrt(jnp.mean((self.x_train - pred) ** 2)))
+
+    def _oos_scaled_prefix_eval(self, params, mask):
+        """All expanding-window rescale+predict passes as one batch
+        (``Autoencoder_encapsulate.py:115-131`` vectorized): for prefix
+        length i ∈ [2, T], scale x_test[:i] with its own min/max, predict,
+        score — returns masked (T-2, T, F) actual/pred tensors.
+
+        ``params``/``mask`` are traced arguments (not baked constants) so
+        the compiled program survives retraining / param swaps."""
+        x = self.x_test
+        t = x.shape[0]
+        mins, maxs = expanding_minmax_scale(x)
+        scale = jnp.where(maxs - mins == 0.0, 1.0, maxs - mins)
+
+        def one_prefix(i):
+            scaled = (x - mins[i - 1]) / scale[i - 1]
+            mask_rows = (jnp.arange(t) < i)[:, None]
+            pred = self.model.apply({"params": params}, scaled, mask)
+            return scaled, pred, mask_rows
+
+        idx = jnp.arange(2, t)
+        return jax.vmap(one_prefix)(idx)
+
+    def _oos_eval(self):
+        """Cached one-shot evaluation of the full expanding-window batch —
+        r2 and RMSE share the same forward pass and compiled program."""
+        if self._oos_cache is None:
+            if self._oos_eval_fn is None:
+                self._oos_eval_fn = jax.jit(self._oos_scaled_prefix_eval)
+            mask = self.mask if self.mask is not None else jnp.ones(
+                (self.params["encoder_kernel"].shape[1],), jnp.float32)
+            self._oos_cache = self._oos_eval_fn(self.params, mask)
+        return self._oos_cache
+
+    def model_OOS_r2(self) -> np.ndarray:
+        scaled, pred, mask_rows = self._oos_eval()
+        return np.asarray(jax.vmap(_r2_columns_mean_masked)(scaled, pred, mask_rows))
+
+    def model_OOS_RMSE(self) -> np.ndarray:
+        scaled, pred, mask_rows = self._oos_eval()
+        sq = jnp.sum((scaled - pred) ** 2 * mask_rows, axis=(1, 2))
+        n_elems = jnp.sum(mask_rows, axis=(1, 2)) * scaled.shape[2]
+        return np.asarray(jnp.sqrt(sq / n_elems))
+
+    # ------------------------------------------------------------ strategy
+    def ante(self, rf, window: Optional[int] = None) -> np.ndarray:
+        """Ex-ante replication returns (``Autoencoder_encapsulate.py:133-201``).
+
+        ``beta_mode='first'`` (default) reproduces the reference exactly:
+        the OLS beta and normalization factor of the *first* 24-month
+        window are reused for every month (``:167`` indexes
+        ``ae_ols_beta[0]``), only the LeakyReLU activation mask varies.
+        ``beta_mode='rolling'`` uses each window's own beta.
+        """
+        window = window or self.cfg.ols_window
+        rf = jnp.asarray(rf, jnp.float32).reshape(-1, 1)
+
+        factors = self._encode(self.x_test)                     # (T, L) raw-input encode, :140
+        betas = rolling_ols_beta(self.y_test, factors, window)  # (T-w+1, L, S)
+        n_windows = self.x_test.shape[0] - window               # :148 range
+        betas = betas[:n_windows]
+
+        def norm_factor(i):
+            xw = lax.dynamic_slice_in_dim(factors, i, window)
+            yw = lax.dynamic_slice_in_dim(self.y_test, i, window)
+            return costs.normalization(yw, xw, betas[i], window)
+
+        norms = jax.vmap(norm_factor)(jnp.arange(n_windows))    # (n_windows, S)
+
+        w_dec = self.params["decoder_kernel"]                   # (L, F) factor→ETF map, :159
+        if self.mask is not None:
+            w_dec = w_dec * self.mask[:, None]
+
+        def month_weights(i, beta, norm):
+            # LeakyReLU mask from the *current* month's decoded sign, :163-166
+            decoded = factors[window + i] @ w_dec               # (F,)
+            leaky = jnp.where(decoded < 0, self.cfg.leaky_slope, 1.0)
+            sw = (jnp.swapaxes(beta, 0, 1) @ w_dec * leaky[None, :]).T * norm[None, :]
+            return sw                                           # (F, S)
+
+        if self.cfg.beta_mode == "first":
+            beta_used = jnp.broadcast_to(betas[0], betas.shape)
+            norm_used = jnp.broadcast_to(norms[0], norms.shape)
+        else:
+            beta_used, norm_used = betas, norms
+        weights = jax.vmap(month_weights)(jnp.arange(n_windows), beta_used, norm_used)
+
+        # last window has no realized month — drop it (:179-180)
+        weights = weights[:-1]                                   # (P, F, S)
+        p = weights.shape[0]
+        delta = 1.0 - jnp.sum(weights, axis=1)                   # (P, S)
+        oos_etf = self.x_test[-p:]
+        oos_rf = rf[-p:]
+        ante = delta * oos_rf + jnp.einsum("pf,pfs->ps", oos_etf, weights)
+
+        self._strat_weights = weights
+        self._ante = ante
+        self.window = window
+        self.oos_hfd = self.y_test[-p:]
+        return np.asarray(ante)
+
+    def post(self, factor_etf_full) -> np.ndarray:
+        """Ex-post returns net of costs (``Autoencoder_encapsulate.py:203-208``):
+        applies the cost penalty using the *full* factor panel's trailing
+        ``P + window`` months."""
+        if self._ante is None:
+            raise RuntimeError("ante() first")
+        p = self._ante.shape[0]
+        panel = jnp.asarray(factor_etf_full, jnp.float32)[-(p + self.window):]
+        weights_s_p_a = jnp.transpose(self._strat_weights, (2, 0, 1))   # (S, P, A)
+        self._post = costs.ex_post_return(self._ante, self.window, weights_s_p_a, panel)
+        return np.asarray(self._post)
+
+    def turnover(self) -> np.ndarray:
+        """Annualized turnover per strategy (``Autoencoder_encapsulate.py:210-224``)."""
+        if self._strat_weights is None:
+            raise RuntimeError("ante() first")
+        return np.asarray(costs.turnover(self._strat_weights))
+
+
+# ------------------------------------------------------------------ utils
+def _r2_columns_mean(actual: jnp.ndarray, pred: jnp.ndarray) -> jnp.ndarray:
+    """sklearn r2_score with multioutput='uniform_average'."""
+    ss_res = jnp.sum((actual - pred) ** 2, axis=0)
+    ss_tot = jnp.sum((actual - jnp.mean(actual, axis=0)) ** 2, axis=0)
+    return jnp.mean(1.0 - ss_res / ss_tot)
+
+
+def _r2_columns_mean_masked(actual, pred, mask_rows) -> jnp.ndarray:
+    w = mask_rows.astype(actual.dtype)
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    mean = jnp.sum(actual * w, axis=0) / n
+    ss_res = jnp.sum(((actual - pred) * w) ** 2, axis=0)
+    ss_tot = jnp.sum(((actual - mean) * w) ** 2, axis=0)
+    return jnp.mean(1.0 - ss_res / ss_tot)

@@ -1,0 +1,187 @@
+"""Performance statistics — the notebook's ``data_analysis`` battery.
+
+jnp ports of ``autoencoder_v4.ipynb`` cell 23 (~190 LoC): Omega ratio and
+curve, annualized Sharpe, FF3/FF5 OLS alpha, historical VaR/CVaR, CEQ,
+assembled into a per-strategy stats table together with the spanning
+tests of :mod:`hfrep_tpu.replication.spanning`.
+
+Reference quirks preserved (each documented at its function):
+
+* ``Omega_ratio`` converts the annual threshold with the exponent
+  ``sqrt(1/252)`` — not ``1/252`` (cell 23, ``daily_threashold``) — and
+  is applied unchanged to *monthly* series;
+* the "five-factor" loader reads only Mkt-RF/SMB/HML from the 5-factor
+  CSV (cell 22 ``usecols`` — so FF5F alpha in the published tables is a
+  3-factor alpha on dailies from a different sample); the corrected
+  loader reads all five, behind ``reference_compat``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hfrep_tpu.ops.rolling import ols_beta
+
+Array = jnp.ndarray
+
+
+def omega_ratio(returns, threshold: float = 0.0) -> jnp.ndarray:
+    """Ω = Σ max(r−τ,0) / Σ max(τ−r,0) with the reference's τ conversion
+    ``(threshold+1)**sqrt(1/252) − 1`` (cell 23)."""
+    tau = (threshold + 1.0) ** np.sqrt(1.0 / 252.0) - 1.0
+    r = jnp.asarray(returns)
+    excess = r - tau
+    gains = jnp.sum(jnp.where(excess > 0, excess, 0.0), axis=0)
+    losses = -jnp.sum(jnp.where(excess < 0, excess, 0.0), axis=0)
+    return gains / losses
+
+
+def omega_curve(returns, thresholds: Optional[np.ndarray] = None) -> np.ndarray:
+    thresholds = thresholds if thresholds is not None else np.linspace(0, 0.2, 50)
+    return np.asarray([np.asarray(omega_ratio(returns, t)) for t in thresholds])
+
+
+def annualized_sharpe(returns, rf=0.0) -> jnp.ndarray:
+    """(mean(ret) − mean(rf)) / std(ret) · √12 (cell 23; population std,
+    matching np.std)."""
+    r = jnp.asarray(returns)
+    rf_mean = jnp.mean(jnp.asarray(rf))
+    return (jnp.mean(r, axis=0) - rf_mean) / jnp.std(r, axis=0) * jnp.sqrt(12.0)
+
+
+def ols_alpha(returns, factors) -> jnp.ndarray:
+    """Intercept of OLS(ret ~ const + factors) (cell 23 ``OLS_alpha``)."""
+    y = jnp.asarray(returns)
+    squeeze = y.ndim == 1
+    if squeeze:
+        y = y[:, None]
+    beta = ols_beta(y, jnp.asarray(factors), add_constant=True)
+    return beta[0, 0] if squeeze else beta[0]
+
+
+def historical_var(returns, alpha: float = 5.0) -> np.ndarray:
+    """Per-column ``np.percentile(returns, alpha)`` (cell 23)."""
+    return np.percentile(np.asarray(returns), alpha, axis=0)
+
+
+def historical_cvar(returns, alpha: float = 5.0) -> np.ndarray:
+    """Mean of returns at or below the VaR quantile (cell 23)."""
+    r = np.asarray(returns)
+    if r.ndim == 1:
+        r = r[:, None]
+    var = np.percentile(r, alpha, axis=0)
+    out = np.empty(r.shape[1])
+    for j in range(r.shape[1]):
+        below = r[:, j] <= var[j]
+        out[j] = r[below, j].mean() if below.any() else np.nan
+    return out
+
+
+def ceq(returns, rf, gamma: float = 2.0) -> jnp.ndarray:
+    """Certainty-equivalent return, CRRA γ ≠ 1 (cell 23 ``ceq``):
+    log(mean(((1+r)/(1+rf))^(1−γ))) / ((1−γ)/12)."""
+    if gamma == 1:
+        raise ValueError("gamma must differ from 1")
+    r = jnp.asarray(returns)
+    rf = jnp.asarray(rf).reshape(-1, *([1] * (r.ndim - 1)))
+    mid = ((1.0 + r) / (1.0 + rf)) ** (1.0 - gamma)
+    return jnp.log(jnp.mean(mid, axis=0)) / ((1.0 - gamma) / 12.0)
+
+
+# ------------------------------------------------------------ FF factors
+def load_ff_factors(path, start="1994-04-30", end="2022-04-30",
+                    five: bool = False, reference_compat: bool = True):
+    """Daily FF factor CSV → monthly log returns (cells 21-22).
+
+    ``reference_compat=True`` reads only Mkt-RF/SMB/HML even from the
+    5-factor file, reproducing the notebook's ``usecols`` bug; with
+    False the 5-factor file contributes RMW and CMA as well.
+    """
+    import pandas as pd
+
+    cols = ["Date", "Mkt-RF", "SMB", "HML"]
+    if five and not reference_compat:
+        cols += ["RMW", "CMA"]
+    df = pd.read_csv(path, usecols=cols)
+    df["Date"] = pd.to_datetime(df["Date"], format="%Y%m%d")
+    df = df.set_index("Date").resample("ME").sum()
+    df = np.log(df / 100.0 + 1.0)
+    return df.loc[start:end]
+
+
+# ---------------------------------------------------------- full battery
+def data_analysis(df, rf=None, three_factor=None, five_factor=None,
+                  span=None, columns: Optional[Sequence[str]] = None,
+                  real_data: bool = True) -> Dict[str, np.ndarray]:
+    """Assemble the notebook's per-strategy stats table (cell 23
+    ``data_analysis``): Omega(0)/Omega(0.1), Sharpe, CVaR, CEQ(2/5/10),
+    FF alphas, and HK/GRS spanning stats when a spanning set is given.
+
+    ``df`` is (T, S) returns; ``span`` (T, K) is the spanning regressor
+    set (each strategy is tested against it).  Returns a dict of arrays
+    keyed by statistic name.
+    """
+    from hfrep_tpu.replication import spanning
+
+    r = jnp.asarray(df, jnp.float32)
+    t = r.shape[0]
+    rf_arr = jnp.zeros((t,)) if rf is None else jnp.asarray(rf, jnp.float32).reshape(-1)
+
+    out: Dict[str, np.ndarray] = {
+        "Omega(0%)": np.asarray(omega_ratio(r, 0.0)),
+        "Omega(10%)": np.asarray(omega_ratio(r, 0.1)),
+        "Sharpe": np.asarray(annualized_sharpe(r, rf_arr)),
+        "cVaR(95%)": historical_cvar(r),
+        "CEQ(2)": np.asarray(ceq(r, rf_arr, 2.0)),
+        "CEQ(5)": np.asarray(ceq(r, rf_arr, 5.0)),
+        "CEQ(10)": np.asarray(ceq(r, rf_arr, 10.0)),
+        "Skewness": _skew(np.asarray(r)),
+        "Kurtosis": _kurtosis(np.asarray(r)),
+    }
+    if real_data and three_factor is not None:
+        out["FF3F_alpha"] = np.asarray(ols_alpha(r, jnp.asarray(np.asarray(three_factor), jnp.float32)))
+    if real_data and five_factor is not None:
+        out["FF5F_alpha"] = np.asarray(ols_alpha(r, jnp.asarray(np.asarray(five_factor), jnp.float32)))
+    if span is not None:
+        hk_f, hk_p, grs_f, grs_p = [], [], [], []
+        span_j = jnp.asarray(np.asarray(span), jnp.float32)
+        for j in range(r.shape[1]):
+            f_stat, p = spanning.hktest(r[:, j:j + 1], span_j)
+            hk_f.append(float(f_stat)); hk_p.append(float(p))
+            f_stat, p = spanning.grstest(r[:, j:j + 1], span_j)
+            grs_f.append(float(f_stat)); grs_p.append(float(p))
+        out["HK_F"] = np.asarray(hk_f); out["HK_p"] = np.asarray(hk_p)
+        out["GRS_F"] = np.asarray(grs_f); out["GRS_p"] = np.asarray(grs_p)
+    if columns is not None:
+        import pandas as pd
+        return pd.DataFrame(out, index=list(columns))
+    return out
+
+
+def _skew(r: np.ndarray) -> np.ndarray:
+    m = r.mean(axis=0)
+    s = r.std(axis=0)
+    return (((r - m) / s) ** 3).mean(axis=0)
+
+
+def _kurtosis(r: np.ndarray) -> np.ndarray:
+    m = r.mean(axis=0)
+    s = r.std(axis=0)
+    return (((r - m) / s) ** 4).mean(axis=0) - 3.0
+
+
+def res_sort(stats_by_latent: Dict[int, np.ndarray], strategy_names: Sequence[str]):
+    """Best latent dim per strategy by Sharpe (notebook cell 27
+    ``res_sort``): given {latent_dim: sharpe_array(S,)}, return the
+    argmax latent and its Sharpe per strategy."""
+    dims = sorted(stats_by_latent)
+    mat = np.stack([stats_by_latent[d] for d in dims])       # (L, S)
+    best_idx = np.argmax(mat, axis=0)
+    return {
+        name: {"latent": dims[best_idx[j]], "sharpe": float(mat[best_idx[j], j])}
+        for j, name in enumerate(strategy_names)
+    }

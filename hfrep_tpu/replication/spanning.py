@@ -1,0 +1,96 @@
+"""Huberman-Kandel and GRS spanning tests, pure jnp.
+
+The reference defines both in ~120 lines of R bridged through
+rpy2/anndata2ri (``autoencoder_v4.ipynb`` cells 16-20) — a Python→R
+process boundary in the middle of the stats loop (SURVEY §3.3).  Here
+they are closed-form jnp: R's ``mldivide`` → least squares via pinv,
+``pseudoinverse`` → `jnp.linalg.pinv`, and the 2×2 eigenvalue product in
+HK collapses to ``1 + tr(M) + det(M)`` so no eigensolver is needed.
+F-distribution p-values via the regularized incomplete beta function
+(`jax.scipy.special.betainc`) — no scipy on the path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betainc
+
+Array = jnp.ndarray
+
+
+def f_sf(x: Array, d1: Array, d2: Array) -> Array:
+    """Survival function of the F(d1, d2) distribution:
+    P(F > x) = I_{d2/(d2 + d1 x)}(d2/2, d1/2)."""
+    x = jnp.maximum(x, 0.0)
+    return betainc(d2 / 2.0, d1 / 2.0, d2 / (d2 + d1 * x))
+
+
+@jax.jit
+def hktest(rt: Array, rb: Array) -> Tuple[Array, Array]:
+    """Huberman-Kandel spanning test (R ``hktest``, notebook cell 17).
+
+    ``rt`` (T, N) test assets, ``rb`` (T, K) benchmark/spanning assets.
+    Returns (F-stat, p-value).
+    """
+    rt = jnp.atleast_2d(rt)
+    rb = jnp.atleast_2d(rb)
+    t, n = rt.shape
+    k = rb.shape[1]
+
+    a = jnp.block([[jnp.ones((1, 1)), jnp.zeros((1, k))],
+                   [jnp.zeros((1, 1)), -jnp.ones((1, k))]])        # (2, K+1)
+    c = jnp.concatenate([jnp.zeros((1, n)), -jnp.ones((1, n))])    # (2, N)
+    x = jnp.concatenate([jnp.ones((t, 1)), rb], axis=1)            # (T, K+1)
+    b = jnp.linalg.pinv(x.T @ x) @ (x.T @ rt)                      # mldivide
+    theta = a @ b - c                                              # (2, N)
+    e = rt - x @ b
+    sigma = jnp.cov(e, rowvar=False).reshape(n, n)
+    h = theta @ jnp.linalg.pinv(sigma) @ theta.T                   # (2, 2)
+
+    mu1 = jnp.mean(rb, axis=0, keepdims=True)                      # (1, K)
+    v11i = jnp.linalg.pinv(jnp.cov(rb, rowvar=False).reshape(k, k))
+    a1 = (mu1 @ v11i @ mu1.T)[0, 0]
+    b1 = jnp.sum(v11i @ mu1.T)
+    c1 = jnp.sum(v11i)
+    g = jnp.array([[1.0 + a1, b1], [b1, c1]])
+
+    m = h @ jnp.linalg.inv(g)
+    # prod(1 + eig(M)) for 2×2 M is det(I + M) = 1 + tr(M) + det(M)
+    ui = 1.0 + jnp.trace(m) + jnp.linalg.det(m)
+
+    if n == 1:
+        f_stat = (t - k - 1) * (ui - 1.0) / 2.0
+        p = f_sf(f_stat, 2.0, jnp.asarray(t - k - 1, jnp.float32))
+    else:
+        f_stat = (t - k - n) * (jnp.sqrt(ui) - 1.0) / n
+        p = f_sf(f_stat, 2.0 * n, 2.0 * (t - n - k))
+    return f_stat, p
+
+
+@jax.jit
+def grstest(ret: Array, factors: Array) -> Tuple[Array, Array]:
+    """Gibbons-Ross-Shanken test (R ``grstest``, notebook cell 19).
+
+    ``ret`` (T, N), ``factors`` (T, K) → (F-stat, p-value).  All N
+    time-series regressions run as one batched solve.
+    """
+    ret = jnp.atleast_2d(ret)
+    factors = jnp.atleast_2d(factors)
+    t, n = ret.shape
+    k = factors.shape[1]
+
+    x = jnp.concatenate([jnp.ones((t, 1)), factors], axis=1)       # (T, K+1)
+    b = jnp.linalg.pinv(x.T @ x) @ (x.T @ ret)                     # (K+1, N)
+    e = ret - x @ b                                                # (T, N)
+    sigma = (e.T @ e) / (t - k - 1)
+    alpha = b[0][:, None]                                          # (N, 1)
+    f_mean = jnp.mean(factors, axis=0, keepdims=True)              # (1, K)
+    omega = ((factors - f_mean).T @ (factors - f_mean)) / (t - 1)
+    tem1 = (alpha.T @ jnp.linalg.pinv(sigma) @ alpha)[0, 0]
+    tem2 = 1.0 + (f_mean @ jnp.linalg.pinv(omega) @ f_mean.T)[0, 0]
+    f_stat = (t / n) * ((t - n - k) / (t - k - 1)) * (tem1 / tem2)
+    p = f_sf(f_stat, jnp.asarray(n, jnp.float32), jnp.asarray(t - n - k, jnp.float32))
+    return f_stat, p

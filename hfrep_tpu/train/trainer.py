@@ -74,6 +74,8 @@ class GanTrainer:
             self.timer.stop(1, sync_on=self.state.g_params)
             self._log_block(jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics), 1)
             self.epoch += 1
+            if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every == 0:
+                self.save_checkpoint()
         self.logger.flush()
         return self.state
 

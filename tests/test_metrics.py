@@ -1,0 +1,182 @@
+"""Metric suite vs scipy/sklearn oracles on the reference's smoke shapes.
+
+The reference's only executable test is its GAN_eval ``__main__`` smoke
+run on (500, 48, 35) Gaussian cubes (``GAN/GAN_eval.py:461-482``); these
+tests do the same at reduced size plus per-metric oracle cross-checks.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.metrics import gan_eval as ge
+from hfrep_tpu.metrics.gaussian_nb import fit_gaussian_nb, predict_proba
+
+
+@pytest.fixture(scope="module")
+def cubes():
+    g = np.random.default_rng(42)
+    real = g.normal(size=(40, 16, 6)).astype(np.float32)
+    fake = (g.normal(size=(40, 16, 6)) * 1.3 + 0.2).astype(np.float32)
+    dataset = g.normal(size=(40, 16, 6)).astype(np.float32)
+    return real, fake, dataset
+
+
+def _rows(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+class TestGaussianNB:
+    def test_matches_sklearn(self, rng):
+        from sklearn.naive_bayes import GaussianNB
+
+        x = rng.normal(size=(60, 5)).astype(np.float64)
+        y = rng.integers(0, 3, 60)
+        ref = GaussianNB().fit(x, y)
+        ours = fit_gaussian_nb(jnp.asarray(x), jnp.asarray(y), 3)
+        np.testing.assert_allclose(np.asarray(ours.theta), ref.theta_, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(ours.var), ref.var_, rtol=1e-3)
+        xq = rng.normal(size=(10, 5))
+        np.testing.assert_allclose(
+            np.asarray(predict_proba(ours, jnp.asarray(xq, jnp.float32))),
+            ref.predict_proba(xq), atol=2e-3)
+
+
+class TestMetricOracles:
+    def test_fid_formula(self, cubes):
+        from scipy.linalg import sqrtm
+
+        real, fake, _ = cubes
+        r, f = _rows(real).astype(np.float64), _rows(fake).astype(np.float64)
+        mu1, mu2 = r.mean(0), f.mean(0)
+        s1, s2 = np.cov(r, rowvar=False), np.cov(f, rowvar=False)
+        ref = np.sum((mu1 - mu2) ** 2) + np.trace(s1 + s2 - 2 * sqrtm(s1 @ s2).real)
+        ours = float(ge.fid(jnp.asarray(real), jnp.asarray(fake)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-3)
+
+    def test_linear_mmd(self, cubes):
+        real, fake, _ = cubes
+        r, f = real.mean(0), fake.mean(0)
+        ref = (r @ r.T).mean() + (f @ f.T).mean() - 2 * (r @ f.T).mean()
+        np.testing.assert_allclose(float(ge.linear_mmd(jnp.asarray(real), jnp.asarray(fake))),
+                                   ref, rtol=1e-4)
+
+    def test_gaussian_mmd_matches_sklearn(self, cubes):
+        from sklearn import metrics as skm
+
+        real, fake, _ = cubes
+        r, f = real.mean(0).astype(np.float64), fake.mean(0).astype(np.float64)
+        ref = (skm.pairwise.rbf_kernel(r, r, 1.0).mean()
+               + skm.pairwise.rbf_kernel(f, f, 1.0).mean()
+               - 2 * skm.pairwise.rbf_kernel(r, f, 1.0).mean())
+        np.testing.assert_allclose(float(ge.gaussian_mmd(jnp.asarray(real), jnp.asarray(fake))),
+                                   ref, atol=1e-5)
+
+    def test_poly_mmd_matches_sklearn(self, cubes):
+        from sklearn import metrics as skm
+
+        real, fake, _ = cubes
+        r, f = real.mean(0).astype(np.float64), fake.mean(0).astype(np.float64)
+        ref = (skm.pairwise.polynomial_kernel(r, r, 2, 1, 0).mean()
+               + skm.pairwise.polynomial_kernel(f, f, 2, 1, 0).mean()
+               - 2 * skm.pairwise.polynomial_kernel(r, f, 2, 1, 0).mean())
+        np.testing.assert_allclose(float(ge.poly_mmd(jnp.asarray(real), jnp.asarray(fake))),
+                                   ref, rtol=1e-3)
+
+    def test_ks_matches_scipy(self, cubes):
+        from scipy.stats import ks_2samp
+
+        real, fake, _ = cubes
+        r, f = _rows(real), _rows(fake)
+        # auto → scipy's exact path at this size (matches reference kstest)
+        stats, pvals = ge.ks_test(jnp.asarray(real), jnp.asarray(fake), group=False)
+        # asymp branch must match scipy's asymp mode
+        stats_a, pvals_a = ge.ks_test(jnp.asarray(real), jnp.asarray(fake),
+                                      group=False, method="asymp")
+        for i in range(r.shape[1]):
+            ref = ks_2samp(r[:, i], f[:, i])
+            np.testing.assert_allclose(stats[i], ref.statistic, atol=1e-6)
+            np.testing.assert_allclose(pvals[i], ref.pvalue, atol=1e-6)
+            ref_a = ks_2samp(r[:, i], f[:, i], method="asymp")
+            np.testing.assert_allclose(pvals_a[i], ref_a.pvalue, atol=1e-6)
+
+    def test_wasserstein_matches_scipy(self, cubes):
+        from scipy.stats import wasserstein_distance
+
+        real, fake, _ = cubes
+        r, f = _rows(real), _rows(fake)
+        ref = np.mean([wasserstein_distance(r[:, i], f[:, i]) for i in range(r.shape[1])])
+        np.testing.assert_allclose(float(ge.wasserstein(jnp.asarray(real), jnp.asarray(fake))),
+                                   ref, rtol=1e-4)
+
+    def test_lp_dist_formula(self, cubes):
+        real, fake, _ = cubes
+        r, f = _rows(real), _rows(fake)
+        ref = np.mean([np.linalg.norm(r[:, i] - f[:, i]) / r.shape[0] for i in range(r.shape[1])])
+        np.testing.assert_allclose(float(ge.lp_dist(jnp.asarray(real), jnp.asarray(fake))),
+                                   ref, rtol=1e-4)
+
+    def test_acf_matches_direct_formula(self, cubes):
+        real, fake, _ = cubes
+        nlags = 5
+
+        def np_acf(series):
+            xc = series - series.mean()
+            denom = (xc * xc).sum()
+            return np.array([(xc[:len(xc) - k] * xc[k:]).sum() / denom for k in range(nlags + 1)])
+
+        r_acf = np.mean([[np_acf(real[i, :, j]) for j in range(real.shape[2])]
+                         for i in range(real.shape[0])], axis=0)
+        f_acf = np.mean([[np_acf(fake[i, :, j]) for j in range(fake.shape[2])]
+                         for i in range(fake.shape[0])], axis=0)
+        ref = np.mean([np.mean(np.abs(r_acf[i] - f_acf[i])) for i in range(real.shape[2])])
+        ours = float(ge.acf_abs_error(jnp.asarray(real), jnp.asarray(fake), nlags=nlags))
+        np.testing.assert_allclose(ours, ref, rtol=1e-3)
+
+    def test_kl_js_properties(self, cubes):
+        real, fake, dataset = cubes
+        r, f, d = (jnp.asarray(a) for a in cubes)
+        kl_same = float(ge.kl_div(r, r, d))
+        js_same = float(ge.js_div(r, r, d))
+        np.testing.assert_allclose(kl_same, 0.0, atol=1e-5)
+        np.testing.assert_allclose(js_same, 0.0, atol=1e-5)
+        assert float(ge.kl_div(r, f, d)) > 0
+        js_rf = float(ge.js_div(r, f, d))
+        assert 0 < js_rf <= np.log(2) + 1e-6   # JS divergence bound (nats)
+        # symmetric in real/fake
+        np.testing.assert_allclose(js_rf, float(ge.js_div(f, r, d)), rtol=1e-4)
+
+    def test_inception_score_identity(self, cubes):
+        r, f, d = (jnp.asarray(a) for a in cubes)
+        np.testing.assert_allclose(float(ge.inception_score(r, r, d)), 1.0, atol=1e-4)
+        assert float(ge.inception_score(r, f, d)) > 1.0
+
+    def test_r2_relative_error(self, cubes):
+        r, f, d = (jnp.asarray(a) for a in cubes)
+        assert float(ge.r2_relative_error(r, f, d)) > 0
+        # identical samples → zero gap
+        np.testing.assert_allclose(float(ge.r2_relative_error(r, r, d)), 0.0, atol=1e-5)
+        # reference_compat reproduces the real-vs-real bug: exactly 0
+        np.testing.assert_allclose(float(ge.r2_relative_error(r, f, d, reference_compat=True)),
+                                   0.0, atol=1e-6)
+
+
+class TestSuite:
+    def test_run_all_smoke(self, cubes):
+        real, fake, dataset = cubes
+        suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
+        res = suite.run_all()
+        assert set(res) == set(ge.GanEval.METRICS)
+        assert all(np.isfinite(v) for v in res.values())
+
+    def test_shape_mismatch_raises(self, cubes):
+        real, fake, dataset = cubes
+        with pytest.raises(ValueError):
+            ge.GanEval(real[:5], fake, dataset)
+
+    def test_eyeball_writes_png(self, cubes, tmp_path):
+        real, fake, dataset = cubes
+        suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
+        out = suite.eyeball(str(tmp_path / "ecdf.png"))
+        import os
+        assert os.path.getsize(out) > 0

@@ -1,0 +1,262 @@
+"""Replication engine vs reference-formula numpy oracles."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.replication import perf_stats, spanning
+from hfrep_tpu.replication.engine import (
+    ReplicationEngine, sweep_autoencoders, train_autoencoder,
+)
+
+CFG = AEConfig(n_factors=6, latent_dim=4, epochs=60, batch_size=16, patience=5, seed=0)
+
+
+@pytest.fixture(scope="module")
+def panels():
+    g = np.random.default_rng(11)
+    t = 90
+    # low-rank factor structure so the AE has something to learn
+    z = g.normal(size=(t, 3))
+    x = (z @ g.normal(size=(3, 6)) + 0.05 * g.normal(size=(t, 6))).astype(np.float32) * 0.02
+    y = (z @ g.normal(size=(3, 4)) + 0.05 * g.normal(size=(t, 4))).astype(np.float32) * 0.02
+    rf = np.abs(g.normal(0.001, 0.0003, (t, 1))).astype(np.float32)
+    return x, y, rf
+
+
+def _make_engine(panels, **cfg_kw):
+    x, y, rf = panels
+    half = len(x) // 2
+    cfg = dataclasses.replace(CFG, **cfg_kw) if cfg_kw else CFG
+    eng = ReplicationEngine(x[:half], y[:half], x[half:], y[half:], cfg)
+    return eng, rf[half:]
+
+
+class TestTraining:
+    def test_early_stopping_freezes_params(self, panels):
+        x, _, _ = panels
+        from hfrep_tpu.core import scaler as mm
+        _, xs = mm.fit_transform(jnp.asarray(x))
+        res = train_autoencoder(jax.random.PRNGKey(0), xs, CFG)
+        stop = int(res.stop_epoch)
+        val = np.asarray(res.val_loss)
+        if stop < CFG.epochs:
+            # post-stop epochs must be frozen (NaN sentinel in the trace)
+            assert np.isnan(val[stop + 1:]).all()
+            assert np.isfinite(val[:stop + 1]).all()
+
+    def test_loss_decreases(self, panels):
+        x, _, _ = panels
+        from hfrep_tpu.core import scaler as mm
+        _, xs = mm.fit_transform(jnp.asarray(x))
+        res = train_autoencoder(jax.random.PRNGKey(0), xs, CFG)
+        tl = np.asarray(res.train_loss)
+        tl = tl[np.isfinite(tl)]
+        assert tl[-1] < tl[0]
+
+    def test_sweep_matches_individual_training(self, panels):
+        """vmapped sweep member must equal a solo masked run with the same
+        key — the batched program is the same program."""
+        x, _, _ = panels
+        from hfrep_tpu.core import scaler as mm
+        from hfrep_tpu.models.autoencoder import latent_mask
+        _, xs = mm.fit_transform(jnp.asarray(x))
+        dims = [1, 2, 3]
+        sweep = sweep_autoencoders(jax.random.PRNGKey(5), xs, CFG, dims)
+        keys = jax.random.split(jax.random.PRNGKey(5), len(dims))
+        cfg3 = dataclasses.replace(CFG, latent_dim=3)
+        solo = train_autoencoder(keys[1], xs, cfg3, latent_mask(2, 3))
+        for k in ("encoder_kernel", "decoder_kernel"):
+            np.testing.assert_allclose(np.asarray(sweep.params[k][1]),
+                                       np.asarray(solo.params[k]), atol=2e-5)
+
+
+class TestMetrics:
+    def test_is_r2_matches_sklearn(self, panels):
+        from sklearn.metrics import r2_score
+
+        eng, _ = _make_engine(panels)
+        eng.train()
+        pred = np.asarray(eng._apply(eng.x_train))
+        ref = r2_score(np.asarray(eng.x_train), pred)
+        np.testing.assert_allclose(eng.model_IS_r2(), ref, rtol=1e-4)
+
+    def test_oos_metrics_match_naive_loop(self, panels):
+        from sklearn.metrics import mean_squared_error, r2_score
+        from sklearn.preprocessing import MinMaxScaler
+
+        eng, _ = _make_engine(panels)
+        eng.train()
+        x_test = np.asarray(eng.x_test)
+        r2_ref, rmse_ref = [], []
+        for i in range(2, len(x_test)):
+            scaler = MinMaxScaler()
+            xr = scaler.fit_transform(x_test[:i])
+            pred = np.asarray(eng._apply(jnp.asarray(xr, jnp.float32)))
+            r2_ref.append(r2_score(xr, pred))
+            rmse_ref.append(np.sqrt(mean_squared_error(xr, pred)))
+        np.testing.assert_allclose(eng.model_OOS_r2(), r2_ref, atol=2e-4)
+        np.testing.assert_allclose(eng.model_OOS_RMSE(), rmse_ref, atol=2e-5)
+
+
+class TestStrategy:
+    def test_ante_matches_reference_algorithm(self, panels):
+        """Engine (beta_mode='first') vs a direct numpy transcription of
+        the reference algorithm (Autoencoder_encapsulate.py:133-201)."""
+        eng, rf = _make_engine(panels, ols_window=12)
+        eng.train()
+        window = 12
+        ante = eng.ante(rf)
+
+        # ---- numpy oracle
+        x_test = np.asarray(eng.x_test)
+        y_test = np.asarray(eng.y_test)
+        factors = np.asarray(eng._encode(eng.x_test))
+        w_dec = np.asarray(eng.params["decoder_kernel"])
+        betas, norms = [], []
+        for i in range(len(x_test) - window):
+            xw, yw = factors[i:i + window], y_test[i:i + window]
+            beta = np.linalg.lstsq(xw, yw, rcond=None)[0]
+            betas.append(beta)
+            r_hat = xw @ beta
+            num = np.sum((yw - yw.mean(0)) ** 2 / (window - 1), axis=0)
+            den = np.sum((r_hat - r_hat.mean(0)) ** 2 / (window - 1), axis=0)
+            norms.append(np.sqrt(num) / np.sqrt(den))
+        weights, deltas = [], []
+        for i in range(len(betas)):
+            leaky = np.ones(w_dec.shape[1])
+            decoded = factors[window + i] @ w_dec
+            leaky[decoded < 0] = 0.2
+            sw = (betas[0].T @ w_dec * leaky).T * norms[0]
+            weights.append(sw)
+            deltas.append(1 - sw.sum(axis=0))
+        weights.pop(); deltas.pop()
+        p = len(weights)
+        oos_etf = x_test[-p:]
+        oos_rf = np.asarray(rf[-p:]).reshape(-1)
+        ante_ref = np.stack([
+            deltas[i] * oos_rf[i] + (oos_etf[i] * weights[i].T).sum(axis=1)
+            for i in range(p)
+        ])
+        np.testing.assert_allclose(ante, ante_ref, atol=2e-4)
+
+    def test_post_and_turnover_run(self, panels):
+        x, y, rf = panels
+        eng, rf_test = _make_engine(panels, ols_window=12)
+        eng.train()
+        eng.ante(rf_test)
+        post = eng.post(x)
+        assert post.shape == eng._ante.shape
+        # month 0 has no penalty
+        np.testing.assert_allclose(post[0], np.asarray(eng._ante)[0], atol=1e-6)
+        to = eng.turnover()
+        assert to.shape == (y.shape[1],)
+        assert (to >= 0).all()
+
+    def test_rolling_beta_mode_differs(self, panels):
+        eng1, rf = _make_engine(panels, ols_window=12)
+        eng1.train()
+        a1 = eng1.ante(rf)
+        eng2, _ = _make_engine(panels, ols_window=12, beta_mode="rolling")
+        eng2.train()
+        a2 = eng2.ante(rf)
+        assert np.abs(a1 - a2).max() > 1e-6
+
+
+class TestPerfStats:
+    def test_omega_matches_formula(self, rng):
+        r = rng.normal(0.01, 0.05, 200)
+        tau = (0.1 + 1) ** np.sqrt(1 / 252) - 1
+        ex = r - tau
+        ref = ex[ex > 0].sum() / (-ex[ex < 0].sum())
+        np.testing.assert_allclose(float(perf_stats.omega_ratio(r, 0.1)), ref, rtol=1e-5)
+
+    def test_sharpe_matches_formula(self, rng):
+        r = rng.normal(0.01, 0.05, 200)
+        rf = rng.normal(0.002, 0.001, 200)
+        ref = (r.mean() - rf.mean()) / r.std() * np.sqrt(12)
+        np.testing.assert_allclose(float(perf_stats.annualized_sharpe(r, rf)), ref, rtol=1e-4)
+
+    def test_cvar_matches_formula(self, rng):
+        r = rng.normal(0.0, 0.05, (300, 2))
+        var = np.percentile(r, 5, axis=0)
+        ref = [r[r[:, j] <= var[j], j].mean() for j in range(2)]
+        np.testing.assert_allclose(perf_stats.historical_cvar(r), ref, rtol=1e-6)
+
+    def test_ceq_matches_formula(self, rng):
+        r = rng.normal(0.01, 0.03, 150)
+        rf = np.abs(rng.normal(0.002, 0.0005, 150))
+        mid = ((1 + r) / (1 + rf)) ** (1 - 5.0)
+        ref = np.log(mid.mean()) / ((1 - 5.0) / 12)
+        np.testing.assert_allclose(float(perf_stats.ceq(r, rf, 5.0)), ref, rtol=1e-4)
+
+    def test_ols_alpha_matches_lstsq(self, rng):
+        x = rng.normal(size=(120, 3))
+        y = 0.002 + x @ np.array([0.5, -0.2, 0.1]) + 0.01 * rng.normal(size=120)
+        xc = np.concatenate([np.ones((120, 1)), x], axis=1)
+        ref = np.linalg.lstsq(xc, y, rcond=None)[0][0]
+        np.testing.assert_allclose(float(perf_stats.ols_alpha(y, x)), ref, atol=1e-4)
+
+    def test_data_analysis_assembles(self, rng):
+        r = rng.normal(0.005, 0.04, (120, 3)).astype(np.float32)
+        rf = np.abs(rng.normal(0.002, 0.0005, 120)).astype(np.float32)
+        span = rng.normal(0.004, 0.03, (120, 4)).astype(np.float32)
+        out = perf_stats.data_analysis(r, rf=rf, span=span)
+        for key in ("Omega(0%)", "Sharpe", "CEQ(2)", "HK_F", "GRS_p"):
+            assert key in out and len(out[key]) == 3
+
+    def test_res_sort(self):
+        stats = {1: np.array([0.5, 0.9]), 2: np.array([0.7, 0.1])}
+        best = perf_stats.res_sort(stats, ["A", "B"])
+        assert best["A"] == {"latent": 2, "sharpe": 0.7}
+        assert best["B"] == {"latent": 1, "sharpe": 0.9}
+
+
+class TestSpanning:
+    def _np_grs(self, ret, fac):
+        t, n = ret.shape
+        k = fac.shape[1]
+        x = np.concatenate([np.ones((t, 1)), fac], axis=1)
+        b = np.linalg.lstsq(x, ret, rcond=None)[0]
+        e = ret - x @ b
+        sigma = e.T @ e / (t - k - 1)
+        alpha = b[0][:, None]
+        fm = fac.mean(axis=0, keepdims=True)
+        omega = (fac - fm).T @ (fac - fm) / (t - 1)
+        tem1 = float(alpha.T @ np.linalg.inv(sigma) @ alpha)
+        tem2 = 1 + float(fm @ np.linalg.inv(omega) @ fm.T)
+        return (t / n) * ((t - n - k) / (t - k - 1)) * tem1 / tem2
+
+    def test_grs_matches_numpy(self, rng):
+        ret = rng.normal(0.004, 0.03, (120, 3))
+        fac = rng.normal(0.003, 0.025, (120, 4))
+        f_ref = self._np_grs(ret, fac)
+        f_ours, p = spanning.grstest(jnp.asarray(ret, jnp.float32), jnp.asarray(fac, jnp.float32))
+        np.testing.assert_allclose(float(f_ours), f_ref, rtol=1e-3)
+        assert 0 <= float(p) <= 1
+
+    def test_f_sf_matches_scipy(self):
+        from scipy.stats import f as fdist
+
+        for x, d1, d2 in [(1.5, 3, 40), (0.2, 2, 100), (4.0, 6, 20)]:
+            ours = float(spanning.f_sf(jnp.asarray(x), jnp.asarray(float(d1)), jnp.asarray(float(d2))))
+            np.testing.assert_allclose(ours, fdist.sf(x, d1, d2), atol=1e-5)
+
+    def test_hktest_spanned_vs_unspanned(self, rng):
+        """An asset inside the span must yield a small F / large p; an
+        independent asset with extra mean must reject."""
+        t, k = 200, 4
+        fac = rng.normal(0.004, 0.02, (t, k))
+        w = np.abs(rng.normal(size=(k, 1)))
+        w = w / w.sum()           # HK spanning needs portfolio weights: Σβ = 1
+        spanned = fac @ w + 0.0005 * rng.normal(size=(t, 1))
+        f1, p1 = spanning.hktest(jnp.asarray(spanned, jnp.float32), jnp.asarray(fac, jnp.float32))
+        outside = rng.normal(0.01, 0.05, (t, 1))
+        f2, p2 = spanning.hktest(jnp.asarray(outside, jnp.float32), jnp.asarray(fac, jnp.float32))
+        assert float(f2) > float(f1)
+        assert float(p1) > 0.05
+        assert np.isfinite(float(p2))
