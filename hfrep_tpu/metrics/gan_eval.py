@@ -178,9 +178,10 @@ def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto") -> np.n
         from scipy.stats import distributions as _dist
     except ImportError:  # pragma: no cover - scipy is present in CI image
         return _kolmogorov_sf(np.sqrt(n * m / (n + m)) * stats)
-    if method == "auto" and max(n, m) <= 10000:
+    if method not in ("auto", "exact", "asymp"):
+        raise ValueError(f"method must be auto|exact|asymp, got {method!r}")
+    if method == "exact" or (method == "auto" and max(n, m) <= 10000):
         # scipy's exact two-sample path (hypergeometric recursion)
-        from scipy.stats import ks_2samp as _ks
         import scipy.stats._stats_py as _sp
         g = np.gcd(n, m)
         out = np.empty_like(stats)

@@ -63,8 +63,10 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
     model = Autoencoder(n_features=cfg.n_factors, latent_dim=cfg.latent_dim,
                         slope=cfg.leaky_slope)
     n = x_train_scaled.shape[0]
-    n_val = int(n * cfg.val_split)
-    n_train = n - n_val
+    # Keras validation_split semantics: split_at = floor(n * (1 - split))
+    # training rows, the rest validation (167 → 125 train / 42 val).
+    n_train = int(n * (1.0 - cfg.val_split))
+    n_val = n - n_train
     x_fit, x_val = x_train_scaled[:n_train], x_train_scaled[n_train:]
 
     key, init_key = jax.random.split(key)
@@ -169,6 +171,7 @@ class ReplicationEngine:
         if self._train_fn is None:
             self._train_fn = jax.jit(lambda k: train_autoencoder(k, self.x_train, self.cfg))
         self.result = self._train_fn(key)
+        self.mask = None            # full-latent model: drop any use_params() mask
         self._oos_cache = None
         return self.result
 

@@ -94,12 +94,13 @@ def ceq(returns, rf, gamma: float = 2.0) -> jnp.ndarray:
 
 # ------------------------------------------------------------ FF factors
 def load_ff_factors(path, start="1994-04-30", end="2022-04-30",
-                    five: bool = False, reference_compat: bool = True):
+                    five: bool = False, reference_compat: bool = False):
     """Daily FF factor CSV → monthly log returns (cells 21-22).
 
     ``reference_compat=True`` reads only Mkt-RF/SMB/HML even from the
-    5-factor file, reproducing the notebook's ``usecols`` bug; with
-    False the 5-factor file contributes RMW and CMA as well.
+    5-factor file, reproducing the notebook's ``usecols`` bug; the
+    default False (matching every other ``reference_compat`` switch in
+    this package) lets the 5-factor file contribute RMW and CMA too.
     """
     import pandas as pd
 
