@@ -174,12 +174,12 @@ def _kolmogorov_sf(x: np.ndarray, terms: int = 101) -> np.ndarray:
 
 
 def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto") -> np.ndarray:
+    if method not in ("auto", "exact", "asymp"):
+        raise ValueError(f"method must be auto|exact|asymp, got {method!r}")
     try:
         from scipy.stats import distributions as _dist
     except ImportError:  # pragma: no cover - scipy is present in CI image
         return _kolmogorov_sf(np.sqrt(n * m / (n + m)) * stats)
-    if method not in ("auto", "exact", "asymp"):
-        raise ValueError(f"method must be auto|exact|asymp, got {method!r}")
     if method == "exact" or (method == "auto" and max(n, m) <= 10000):
         # scipy's exact two-sample path (hypergeometric recursion)
         import scipy.stats._stats_py as _sp

@@ -172,7 +172,7 @@ class ReplicationEngine:
             self._train_fn = jax.jit(lambda k: train_autoencoder(k, self.x_train, self.cfg))
         self.result = self._train_fn(key)
         self.mask = None            # full-latent model: drop any use_params() mask
-        self._oos_cache = None
+        self._invalidate()
         return self.result
 
     def use_params(self, params: dict, mask: Optional[jnp.ndarray] = None) -> None:
@@ -180,7 +180,14 @@ class ReplicationEngine:
         self.result = AEResult(params=params, stop_epoch=jnp.zeros((), jnp.int32),
                                train_loss=jnp.zeros(()), val_loss=jnp.zeros(()))
         self.mask = mask
+        self._invalidate()
+
+    def _invalidate(self) -> None:
+        """Drop every derived artifact of the previous parameter set."""
         self._oos_cache = None
+        self._ante = None
+        self._strat_weights = None
+        self._post = None
 
     @property
     def params(self) -> dict:

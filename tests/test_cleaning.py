@@ -1,0 +1,72 @@
+"""Cleaning pipeline vs the committed cleaned_data/ snapshot.
+
+The reference's cleaning notebook is a missing blob; these tests pin the
+re-derived pipeline (SURVEY §2 "Missing blobs" row) to its committed
+outputs: hfd and 14/22 factor columns bitwise, rf to the precision the
+snapshot allows, CBOE columns methodologically (their daily source file
+``ETF_data_full.csv`` is itself a missing blob).
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hfrep_tpu.core import cleaning
+
+RAW = "/root/reference/data"
+REF = "/root/reference/cleaned_data"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(RAW), reason="reference raw data not mounted")
+
+
+@pytest.fixture(scope="module")
+def result():
+    return cleaning.run_cleaning(RAW)
+
+
+def test_shapes_and_index(result):
+    assert result.hfd.shape == (337, 13)
+    assert result.factor_etf.shape == (337, 22)
+    assert result.rf.shape == (337, 1)
+    assert str(result.hfd.index[0].date()) == "1994-04-30"
+    assert str(result.hfd.index[-1].date()) == "2022-04-30"
+    assert list(result.factor_etf.columns) == cleaning.FACTOR_TICKERS
+
+
+def test_validation_report(result):
+    rep = cleaning.validate_against(result, REF)
+    # Underlying total log returns log1p(NAVROR %) reproduce bitwise.
+    assert rep["hfd_total"] < 1e-12, rep
+    # rf: exact upstream monthly series absent; daily compounding agrees
+    # to ~1.5e-5 (≈0.5% relative), and excess returns inherit that.
+    assert rep["rf"] < 5e-5, rep
+    assert rep["hfd_excess"] < 5e-5, rep
+    # 14 non-CBOE factor columns reproduce bitwise in total-return terms.
+    assert rep["factor_total_exact_cols"] < 1e-12, rep
+    # CBOE columns: same transform on the committed (spot) dailies —
+    # positively correlated with the missing-source originals.
+    assert rep["factor_approx_corr_min"] > 0.3, rep
+
+
+def test_roundtrip_write(result, tmp_path):
+    cleaning.run_cleaning(RAW, out_dir=str(tmp_path))
+    for name in ["hfd.csv", "factor_etf_data.csv", "rf.csv",
+                 "hfd_fullname.pkl", "factor_etf_name.pkl"]:
+        assert (tmp_path / name).exists()
+    again = pd.read_csv(tmp_path / "hfd.csv", index_col=0)
+    assert again.shape == (337, 13)
+    np.testing.assert_allclose(again.values, result.hfd.values, atol=1e-12)
+
+
+def test_loadable_by_panel_loader(result, tmp_path):
+    """The rebuilt cleaned_dir feeds the framework's canonical loader."""
+    from hfrep_tpu.core.data import load_panel
+    cleaning.run_cleaning(RAW, out_dir=str(tmp_path))
+    panel = load_panel(str(tmp_path))
+    assert panel.n_months == 337
+    joined = panel.joined(include_rf=True)
+    assert joined.shape == (337, 36)
+    assert np.isfinite(np.asarray(joined)).all()
