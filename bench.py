@@ -41,7 +41,7 @@ def load_dataset(mcfg: ModelConfig) -> jnp.ndarray:
         from hfrep_tpu.core.data import build_gan_dataset
         cfg = DataConfig(window=mcfg.window)
         return build_gan_dataset(cfg, jax.random.PRNGKey(cfg.seed)).windows
-    except (ImportError, FileNotFoundError, OSError) as e:
+    except (ImportError, OSError) as e:
         import sys
         print(f"bench: reference cleaned_data unavailable ({e!r}); "
               "falling back to synthetic windows", file=sys.stderr)

@@ -1,0 +1,8 @@
+"""``python -m hfrep_tpu`` entry point."""
+
+import sys
+
+from hfrep_tpu.experiments.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

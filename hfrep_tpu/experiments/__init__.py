@@ -1,0 +1,25 @@
+"""Experiment drivers: the reference's notebook flows as CLI programs.
+
+The reference drives everything from ``autoencoder_v4.ipynb`` (70 cells,
+SURVEY §3.3-3.4); here each flow is a config-driven, reproducible program:
+
+* :mod:`~hfrep_tpu.experiments.cli` — ``train-gan`` / ``eval-gan``
+  subcommands: train any of the six GAN presets, checkpoint, sample, and
+  score with the 12-metric eval suite.
+* :mod:`~hfrep_tpu.experiments.augment` — sample a trained generator and
+  splice the synthetic rows into the AE training set (cells 42-50).
+* :mod:`~hfrep_tpu.experiments.sweep` — the latent-dim sweep with
+  ante/post/turnover and the full stats battery (cells 6-33 / 51-69).
+* :mod:`~hfrep_tpu.experiments.report` — tables and cumulative-return
+  plots (cells 27-38).
+
+``python -m hfrep_tpu <subcommand>`` dispatches to these.
+"""
+
+from hfrep_tpu.experiments.augment import AugmentedData, augment_training_set, sample_generator
+from hfrep_tpu.experiments.sweep import SweepResult, run_sweep
+
+__all__ = [
+    "AugmentedData", "augment_training_set", "sample_generator",
+    "SweepResult", "run_sweep",
+]

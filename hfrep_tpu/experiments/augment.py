@@ -1,0 +1,76 @@
+"""GAN-augmentation of the AE training set (``autoencoder_v4.ipynb``
+cells 42-50, SURVEY §3.4).
+
+The reference flow: load the trained generator ``.h5``, sample
+``normal(0,1,(10,168,36))`` windows (cell 43), inverse-transform with a
+MinMax scaler fit on the *full* factor⋈hfd⋈rf panel (cell 47), split the
+cube into factor / HF / rf rows (``helper.py:133-153``, cell 48), and
+vstack the synthetic rows above the real training rows (cell 50).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.core.data import Panel
+from hfrep_tpu.core.sampling import factor_hf_split
+
+
+@dataclasses.dataclass
+class AugmentedData:
+    """Flattened synthetic rows, ready to vstack with real rows."""
+
+    factors: jnp.ndarray        # (N*W, 22)
+    hf: jnp.ndarray             # (N*W, 13)
+    rf: Optional[jnp.ndarray]   # (N*W,) when the generator carried an rf column
+    raw_windows: jnp.ndarray    # (N, W, F) inverse-scaled cube
+
+
+def sample_generator(trainer, key: jax.Array, n_windows: int = 10,
+                     n_factors: int = 22, n_hf: int = 13) -> AugmentedData:
+    """Sample a trained :class:`~hfrep_tpu.train.trainer.GanTrainer` and
+    split the inverse-scaled cube into replication inputs.
+
+    The trainer's own scaler (fit on the joined panel at dataset build
+    time and carried through checkpoints) plays the role of the
+    notebook's refit inverse scaler — same params by construction, minus
+    the refit.
+    """
+    cube = trainer.generate(key, n_windows, unscale=True)       # (N, W, F)
+    return split_cube(cube, n_factors=n_factors, n_hf=n_hf)
+
+
+def split_cube(cube: jnp.ndarray, n_factors: int = 22, n_hf: int = 13) -> AugmentedData:
+    """(N, W, F) inverse-scaled cube → flattened factor/HF/rf rows."""
+    n_features = cube.shape[2]
+    factors, rest = factor_hf_split(cube, n_factors)            # rows, rows
+    if n_features > n_factors + n_hf:                           # rf column present
+        hf, rf = rest[:, :n_hf], rest[:, n_hf]
+    else:
+        hf, rf = rest, None
+    return AugmentedData(factors=factors, hf=hf, rf=rf, raw_windows=cube)
+
+
+def augment_training_set(x_train: jnp.ndarray, y_train: jnp.ndarray,
+                         aug: AugmentedData) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Synthetic rows first, real rows after — exactly the notebook's
+    ``np.vstack([generated, real])`` (cell 50)."""
+    x_aug = jnp.concatenate([aug.factors, jnp.asarray(x_train, jnp.float32)], axis=0)
+    y_aug = jnp.concatenate([aug.hf, jnp.asarray(y_train, jnp.float32)], axis=0)
+    return x_aug, y_aug
+
+
+def inverse_scale_cube(cube_scaled: jnp.ndarray, panel: Panel,
+                       include_rf: bool = True) -> jnp.ndarray:
+    """Re-derive the notebook's inverse scaler (cell 47: MinMax fit on
+    factor⋈hfd⋈rf over the full sample) and apply it to a generated cube
+    — for samples produced outside a trainer (e.g. loaded from disk)."""
+    joined = panel.joined(include_rf=include_rf)
+    params, _ = mm.fit_transform(joined)
+    flat = cube_scaled.reshape(-1, cube_scaled.shape[2])
+    return mm.inverse_transform(params, flat).reshape(cube_scaled.shape)

@@ -1,0 +1,214 @@
+"""``python -m hfrep_tpu`` — config-driven CLI over the experiment flows.
+
+The reference has no CLI (everything runs by executing scripts /
+notebook cells, SURVEY §5.6); these subcommands cover the full pipeline:
+
+    clean       data/ → cleaned_data/ re-derivation
+    train-gan   train a GAN preset, checkpoint, sample, optionally eval
+    eval-gan    12-metric eval of a saved sample cube vs real windows
+    sweep       latent-dim sweep (real-only, or GAN-augmented via
+                --gan-checkpoint), tables + summary + plots
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="hfrep_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("clean", help="re-derive cleaned_data/ from raw vendor files")
+    c.add_argument("--raw-dir", default="/root/reference/data")
+    c.add_argument("--out-dir", required=True)
+    c.add_argument("--validate-against", default=None,
+                   help="reference cleaned_data/ to diff against")
+
+    t = sub.add_parser("train-gan", help="train a GAN preset")
+    t.add_argument("--preset", default="mtss_wgan_gp")
+    t.add_argument("--epochs", type=int, default=None)
+    t.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument("--samples-out", default=None, help="write generated cube (.npy)")
+    t.add_argument("--n-samples", type=int, default=10)
+    t.add_argument("--eval", action="store_true", help="run the 12-metric suite after training")
+    t.add_argument("--mesh", action="store_true", help="data-parallel over all devices")
+    t.add_argument("--quiet", action="store_true")
+
+    e = sub.add_parser("eval-gan", help="score a saved sample cube")
+    e.add_argument("--samples", required=True, help=".npy cube, inverse-scaled returns")
+    e.add_argument("--preset", default="mtss_wgan_gp")
+    e.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    e.add_argument("--out", default=None, help="write metrics JSON here")
+
+    s = sub.add_parser("sweep", help="latent-dim sweep (cells 5-33 / 51-69)")
+    s.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    s.add_argument("--latents", default="1:21", help="'lo:hi' inclusive, or comma list")
+    s.add_argument("--out", required=True)
+    s.add_argument("--gan-checkpoint", default=None,
+                   help="generator checkpoint: run the GAN-augmented sweep")
+    s.add_argument("--preset", default="mtss_wgan_gp_prod",
+                   help="preset the checkpoint was trained with")
+    s.add_argument("--n-gen-windows", type=int, default=10)
+    s.add_argument("--epochs", type=int, default=None, help="AE epochs override")
+    s.add_argument("--plots", action="store_true")
+    return p
+
+
+def _parse_latents(spec: str):
+    if ":" in spec:
+        lo, hi = spec.split(":")
+        return list(range(int(lo), int(hi) + 1))
+    return [int(x) for x in spec.split(",")]
+
+
+def cmd_clean(args) -> int:
+    from hfrep_tpu.core import cleaning
+    res = cleaning.run_cleaning(args.raw_dir, out_dir=args.out_dir)
+    print(f"wrote cleaned panel ({res.hfd.shape[0]} months) to {args.out_dir}")
+    if args.validate_against:
+        rep = cleaning.validate_against(res, args.validate_against)
+        print(json.dumps(rep, indent=2))
+    return 0
+
+
+def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
+                  mesh=False, quiet=False):
+    import jax
+    from hfrep_tpu.config import get_preset
+    from hfrep_tpu.core.data import build_gan_dataset, load_panel
+    from hfrep_tpu.train.trainer import GanTrainer
+    from hfrep_tpu.utils.logging import MetricLogger
+
+    cfg = get_preset(preset)
+    if checkpoint_dir:
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, checkpoint_dir=checkpoint_dir))
+    panel = load_panel(cleaned_dir)
+    ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
+    device_mesh = None
+    if mesh:
+        from hfrep_tpu.parallel import make_mesh
+        device_mesh = make_mesh()
+    style = {"gan": "gan", "mtss_gan": "gan", "wgan": "wgan", "mtss_wgan": "wgan"}.get(
+        cfg.model.family, "wgan_gp")
+    logger = MetricLogger(echo=not quiet, echo_style=style)
+    return GanTrainer(cfg, ds, mesh=device_mesh, logger=logger), ds, panel, cfg
+
+
+def cmd_train_gan(args) -> int:
+    import jax
+
+    trainer, ds, panel, cfg = _make_trainer(
+        args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh, args.quiet)
+    trainer.train(epochs=args.epochs)
+    print(f"trained {cfg.model.family} for {trainer.epoch} epochs "
+          f"({trainer.steps_per_sec:.2f} steps/s)")
+    if args.checkpoint_dir:
+        path = trainer.save_checkpoint()
+        print(f"checkpoint: {path}")
+    if args.samples_out:
+        cube = trainer.generate(jax.random.PRNGKey(9), args.n_samples)
+        np.save(args.samples_out, np.asarray(cube))
+        print(f"samples: {args.samples_out} {tuple(cube.shape)}")
+    if args.eval:
+        _eval_trainer_samples(trainer, ds, out=None)
+    return 0
+
+
+def _eval_trainer_samples(trainer, ds, out):
+    import jax
+    from hfrep_tpu.metrics.gan_eval import GanEval
+
+    n = min(500, ds.windows.shape[0])
+    fake = trainer.generate(jax.random.PRNGKey(11), n, unscale=False)
+    suite = GanEval(ds.windows[:n], fake, ds.windows,
+                    model_name=[trainer.cfg.model.family])
+    res = suite.run_all()
+    print(json.dumps(res, indent=2))
+    if out:
+        with open(out, "w") as f:
+            json.dump(res, f, indent=2)
+    return res
+
+
+def cmd_eval_gan(args) -> int:
+    import jax
+    from hfrep_tpu.config import get_preset
+    from hfrep_tpu.core.data import build_gan_dataset, load_panel
+    from hfrep_tpu.core import scaler as mm
+    from hfrep_tpu.metrics.gan_eval import GanEval
+
+    cfg = get_preset(args.preset)
+    panel = load_panel(args.cleaned_dir)
+    ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
+    cube = np.load(args.samples)
+    # samples are stored inverse-scaled; move them back into scaler space
+    flat = mm.transform(ds.scaler, cube.reshape(-1, cube.shape[2]))
+    fake = np.asarray(flat).reshape(cube.shape)
+    n = min(cube.shape[0], ds.windows.shape[0])
+    suite = GanEval(ds.windows[:n], fake[:n], ds.windows,
+                    model_name=[args.preset])
+    res = suite.run_all()
+    print(json.dumps(res, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=2)
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    import jax
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.core.data import load_panel
+    from hfrep_tpu.experiments.augment import augment_training_set, sample_generator
+    from hfrep_tpu.experiments.sweep import run_sweep
+    from hfrep_tpu.experiments import report
+
+    panel = load_panel(args.cleaned_dir)
+    x_train, x_test, y_train, y_test = panel.train_test_split()
+    rf_test = panel.rf[x_train.shape[0]:]
+
+    if args.gan_checkpoint:
+        trainer, _, _, _ = _make_trainer(args.preset, args.cleaned_dir, quiet=True)
+        trainer.restore_checkpoint(args.gan_checkpoint)
+        aug = sample_generator(trainer, jax.random.PRNGKey(7),
+                               n_windows=args.n_gen_windows)
+        x_train, y_train = augment_training_set(x_train, y_train, aug)
+        print(f"augmented training set: {x_train.shape[0]} rows "
+              f"({aug.factors.shape[0]} synthetic)")
+
+    cfg = AEConfig()
+    if args.epochs:
+        cfg = dataclasses.replace(cfg, epochs=args.epochs)
+    result = run_sweep(x_train, y_train, x_test, y_test, rf_test,
+                       panel.factors, cfg, _parse_latents(args.latents),
+                       strategy_names=panel.hf_names)
+    result.save(args.out)
+    print(json.dumps(result.summary(), indent=2, default=str))
+
+    if args.plots:
+        i_best = int(np.argmax(result.oos_r2_mean))
+        p = result.post[i_best]
+        actual = np.asarray(y_test)[-p.shape[0]:]
+        report.multiplot(p, actual, panel.hf_names,
+                         os.path.join(args.out, "cumulative_returns.png"))
+        print(f"plot: {os.path.join(args.out, 'cumulative_returns.png')}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {"clean": cmd_clean, "train-gan": cmd_train_gan,
+            "eval-gan": cmd_eval_gan, "sweep": cmd_sweep}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Reporting: stats tables and cumulative-return charts
+(``autoencoder_v4.ipynb`` cells 23-38).
+
+The notebook renders matplotlib figures inline; here plots are written as
+offline PNG reports (SURVEY §5.5) and tables as CSV.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hfrep_tpu.replication import perf_stats
+
+
+def multiplot(replication: np.ndarray, actual: np.ndarray,
+              names: Sequence[str], path: str, ncols: int = 3,
+              labels: tuple = ("replication", "actual")) -> str:
+    """Cumulative-return grid, one panel per strategy (cell 38's
+    ``multiplot``): replicated vs actual index, compounded from monthly
+    returns."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    s = replication.shape[1]
+    nrows = -(-s // ncols)
+    fig, axes = plt.subplots(nrows, ncols, figsize=(4.2 * ncols, 3.0 * nrows),
+                             squeeze=False)
+    for j in range(nrows * ncols):
+        ax = axes[j // ncols][j % ncols]
+        if j >= s:
+            ax.axis("off")
+            continue
+        ax.plot(np.cumprod(1.0 + replication[:, j]) - 1.0, label=labels[0])
+        ax.plot(np.cumprod(1.0 + actual[:, j]) - 1.0, label=labels[1])
+        ax.set_title(names[j], fontsize=9)
+        ax.legend(fontsize=7)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def stats_table(returns: np.ndarray, names: Sequence[str], rf=None,
+                ff3_path: Optional[str] = None, ff5_path: Optional[str] = None,
+                span: Optional[np.ndarray] = None,
+                start: str = "1994-04-30", end: str = "2022-04-30"):
+    """The notebook's ``data_analysis`` battery as a DataFrame: Omega,
+    Sharpe, cVaR, CEQ, skew/kurtosis, FF alphas, HK/GRS spanning tests."""
+    def _load_aligned(path, five):
+        fac = perf_stats.load_ff_factors(path, start=start, end=end, five=five).values
+        if fac.shape[0] < returns.shape[0]:
+            raise ValueError(
+                f"factor file {path} covers {fac.shape[0]} months < "
+                f"{returns.shape[0]} return months in [{start}, {end}]")
+        return fac[-returns.shape[0]:]
+
+    three = five = None
+    if ff3_path and os.path.exists(ff3_path):
+        three = _load_aligned(ff3_path, five=False)
+    if ff5_path and os.path.exists(ff5_path):
+        five = _load_aligned(ff5_path, five=True)
+    return perf_stats.data_analysis(returns, rf=rf, three_factor=three,
+                                    five_factor=five, span=span, columns=names)

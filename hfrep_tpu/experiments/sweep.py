@@ -1,0 +1,145 @@
+"""Latent-dimension sweep: the dissertation's core experiment
+(``autoencoder_v4.ipynb`` cells 5-33 real-only, 51-69 GAN-augmented).
+
+Reference flow per latent dim d ∈ 1..21: train ``AE(X_train, Y_train,
+X_test, Y_test, d)``, record IS/OOS R²/RMSE, build the replication
+strategy (``ante``), cost-adjust it (``post``), compute turnover, and
+tabulate performance stats; finally ``res_sort`` picks the best latent
+per strategy by Sharpe (cell 27).  That is 21 serial Keras fits plus
+O(T) ``predict`` loops; here all 21 trainings run as ONE vmapped XLA
+program (:func:`hfrep_tpu.replication.engine.sweep_autoencoders`) and the
+per-latent evaluations reuse a single engine's jitted evaluators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.models.autoencoder import latent_mask
+from hfrep_tpu.replication.engine import ReplicationEngine, sweep_autoencoders
+from hfrep_tpu.replication import perf_stats
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Everything the notebook's result cells tabulate, per latent dim."""
+
+    latent_dims: List[int]
+    strategy_names: List[str]
+    is_r2: np.ndarray           # (L,)
+    is_rmse: np.ndarray         # (L,)
+    oos_r2_mean: np.ndarray     # (L,)  mean over expanding windows (cell 13)
+    oos_r2_max: np.ndarray      # (L,)
+    oos_rmse_mean: np.ndarray   # (L,)
+    ante: np.ndarray            # (L, P, S) ex-ante replication returns
+    post: np.ndarray            # (L, P, S) ex-post (net of costs)
+    turnover: np.ndarray        # (L, S) annualized
+    sharpe_ante: np.ndarray     # (L, S)
+    sharpe_post: np.ndarray     # (L, S)
+    stop_epoch: np.ndarray      # (L,) early-stopping epoch per training
+
+    def best_by_sharpe(self, ex_post: bool = True) -> Dict[str, dict]:
+        """``res_sort`` (cell 27): best latent per strategy by Sharpe."""
+        mat = self.sharpe_post if ex_post else self.sharpe_ante
+        by_latent = {d: mat[i] for i, d in enumerate(self.latent_dims)}
+        return perf_stats.res_sort(by_latent, self.strategy_names)
+
+    def summary(self) -> dict:
+        best = self.best_by_sharpe()
+        i_best = int(np.argmax(self.oos_r2_mean))
+        return {
+            "best_oos_r2": {"latent": self.latent_dims[i_best],
+                            "mean": float(self.oos_r2_mean[i_best]),
+                            "max": float(self.oos_r2_max[i_best])},
+            "best_oos_rmse": float(np.min(self.oos_rmse_mean)),
+            "best_latent_by_strategy": best,
+        }
+
+    def save(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        import pandas as pd
+        idx = pd.Index(self.latent_dims, name="latent_dim")
+        pd.DataFrame(
+            {"IS_R2": self.is_r2, "IS_RMSE": self.is_rmse,
+             "OOS_R2_mean": self.oos_r2_mean, "OOS_R2_max": self.oos_r2_max,
+             "OOS_RMSE_mean": self.oos_rmse_mean,
+             "stop_epoch": self.stop_epoch},
+            index=idx).to_csv(os.path.join(out_dir, "fit_metrics.csv"))
+        for name, arr in [("sharpe_ante", self.sharpe_ante),
+                          ("sharpe_post", self.sharpe_post),
+                          ("turnover", self.turnover)]:
+            pd.DataFrame(arr, index=idx, columns=self.strategy_names).to_csv(
+                os.path.join(out_dir, f"{name}.csv"))
+        np.save(os.path.join(out_dir, "ante.npy"), self.ante)
+        np.save(os.path.join(out_dir, "post.npy"), self.post)
+        with open(os.path.join(out_dir, "summary.json"), "w") as f:
+            json.dump(self.summary(), f, indent=2, default=str)
+
+
+def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
+              cfg: Optional[AEConfig] = None,
+              latent_dims: Sequence[int] = tuple(range(1, 22)),
+              key: Optional[jax.Array] = None,
+              strategy_names: Optional[Sequence[str]] = None) -> SweepResult:
+    """Train all latent dims in one vmapped program, then evaluate each.
+
+    ``x_train``/``y_train`` may be GAN-augmented (synthetic rows stacked
+    above real rows); ``x_test``/``y_test``/``rf_test`` are always the
+    real OOS panels, and ``factor_full`` the full-sample factor panel the
+    cost model draws trailing covariance windows from.
+    """
+    cfg = cfg or AEConfig()
+    key = key if key is not None else jax.random.PRNGKey(cfg.seed)
+    latent_dims = list(latent_dims)
+    max_latent = max(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+
+    engine = ReplicationEngine(x_train, y_train, x_test, y_test, cfg)
+    swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
+
+    n_l = len(latent_dims)
+    rows = {k: [] for k in ["is_r2", "is_rmse", "oos_r2_mean", "oos_r2_max",
+                            "oos_rmse_mean", "ante", "post", "turnover",
+                            "sharpe_ante", "sharpe_post"]}
+    for i, d in enumerate(latent_dims):
+        params_i = jax.tree_util.tree_map(lambda a: a[i], swept.params)
+        engine.use_params(params_i, latent_mask(d, max_latent))
+        rows["is_r2"].append(engine.model_IS_r2())
+        rows["is_rmse"].append(engine.model_IS_RMSE())
+        oos_r2 = engine.model_OOS_r2()
+        oos_rmse = engine.model_OOS_RMSE()
+        rows["oos_r2_mean"].append(float(np.mean(oos_r2)))
+        rows["oos_r2_max"].append(float(np.max(oos_r2)))
+        rows["oos_rmse_mean"].append(float(np.mean(oos_rmse)))
+        ante = engine.ante(rf_test)
+        post = engine.post(factor_full)
+        rows["ante"].append(ante)
+        rows["post"].append(post)
+        rows["turnover"].append(engine.turnover())
+        rows["sharpe_ante"].append(np.asarray(perf_stats.annualized_sharpe(
+            jnp.asarray(ante), jnp.asarray(rf_test, jnp.float32)[-ante.shape[0]:])))
+        rows["sharpe_post"].append(np.asarray(perf_stats.annualized_sharpe(
+            jnp.asarray(post), jnp.asarray(rf_test, jnp.float32)[-post.shape[0]:])))
+
+    names = list(strategy_names) if strategy_names is not None else [
+        f"strategy_{j}" for j in range(rows["ante"][0].shape[1])]
+    return SweepResult(
+        latent_dims=latent_dims, strategy_names=names,
+        is_r2=np.asarray(rows["is_r2"]), is_rmse=np.asarray(rows["is_rmse"]),
+        oos_r2_mean=np.asarray(rows["oos_r2_mean"]),
+        oos_r2_max=np.asarray(rows["oos_r2_max"]),
+        oos_rmse_mean=np.asarray(rows["oos_rmse_mean"]),
+        ante=np.stack(rows["ante"]), post=np.stack(rows["post"]),
+        turnover=np.asarray(rows["turnover"]),
+        sharpe_ante=np.asarray(rows["sharpe_ante"]),
+        sharpe_post=np.asarray(rows["sharpe_post"]),
+        stop_epoch=np.asarray(swept.stop_epoch),
+    )

@@ -1,0 +1,148 @@
+"""Experiment-driver layer: augment, sweep, report, CLI."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.experiments import augment as aug_mod
+from hfrep_tpu.experiments import report
+from hfrep_tpu.experiments.sweep import run_sweep
+
+REF = "/root/reference/cleaned_data"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF),
+                               reason="reference cleaned_data not mounted")
+
+
+class TestAugment:
+    def test_split_cube_with_rf(self):
+        cube = jnp.arange(2 * 4 * 36, dtype=jnp.float32).reshape(2, 4, 36)
+        a = aug_mod.split_cube(cube, n_factors=22, n_hf=13)
+        assert a.factors.shape == (8, 22)
+        assert a.hf.shape == (8, 13)
+        assert a.rf.shape == (8,)
+        # rf is column 35 of each row
+        np.testing.assert_allclose(np.asarray(a.rf)[0], float(cube[0, 0, 35]))
+
+    def test_split_cube_without_rf(self):
+        cube = jnp.zeros((3, 5, 35))
+        a = aug_mod.split_cube(cube)
+        assert a.hf.shape == (15, 13)
+        assert a.rf is None
+
+    def test_augment_training_set_order(self):
+        cube = jnp.ones((1, 2, 35))
+        a = aug_mod.split_cube(cube)
+        x_real = jnp.full((4, 22), 7.0)
+        y_real = jnp.full((4, 13), 7.0)
+        x, y = aug_mod.augment_training_set(x_real, y_real, a)
+        assert x.shape == (6, 22) and y.shape == (6, 13)
+        # synthetic rows first (notebook cell 50 vstack order)
+        np.testing.assert_allclose(np.asarray(x[:2]), 1.0)
+        np.testing.assert_allclose(np.asarray(x[2:]), 7.0)
+
+    def test_inverse_scale_cube_roundtrip(self):
+        from hfrep_tpu.core import scaler as mm
+        from hfrep_tpu.core.data import Panel
+        key = jax.random.PRNGKey(0)
+        factors = jax.random.normal(key, (30, 22)) * 0.05
+        hf = jax.random.normal(jax.random.fold_in(key, 1), (30, 13)) * 0.03
+        rf = jnp.abs(jax.random.normal(jax.random.fold_in(key, 2), (30, 1))) * 1e-3
+        panel = Panel(factors=factors, hf=hf, rf=rf,
+                      dates=np.arange(30), factor_names=[], hf_names=[],
+                      factor_fullnames={}, hf_fullnames={})
+        joined = panel.joined(include_rf=True)
+        params, scaled = mm.fit_transform(joined)
+        cube_scaled = scaled[:8].reshape(2, 4, 36)
+        back = aug_mod.inverse_scale_cube(cube_scaled, panel)
+        np.testing.assert_allclose(np.asarray(back),
+                                   np.asarray(joined[:8]).reshape(2, 4, 36),
+                                   atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def tiny_problem():
+    key = jax.random.PRNGKey(42)
+    t_train, t_test, n_f, n_s = 60, 60, 22, 4
+    x_train = jax.random.normal(key, (t_train, n_f)) * 0.04
+    x_test = jax.random.normal(jax.random.fold_in(key, 1), (t_test, n_f)) * 0.04
+    # HF returns = linear mix of factors + noise so replication is learnable
+    mix = jax.random.normal(jax.random.fold_in(key, 2), (n_f, n_s)) * 0.3
+    y_train = x_train @ mix + 0.01 * jax.random.normal(jax.random.fold_in(key, 3), (t_train, n_s))
+    y_test = x_test @ mix + 0.01 * jax.random.normal(jax.random.fold_in(key, 4), (t_test, n_s))
+    rf_test = jnp.full((t_test, 1), 2e-3)
+    factor_full = jnp.concatenate([x_train, x_test], axis=0)
+    return x_train, y_train, x_test, y_test, rf_test, factor_full
+
+
+class TestSweep:
+    def test_run_sweep_shapes_and_summary(self, tiny_problem, tmp_path):
+        x_train, y_train, x_test, y_test, rf_test, factor_full = tiny_problem
+        cfg = AEConfig(epochs=30, ols_window=12)
+        res = run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
+                        cfg, latent_dims=[1, 4, 8],
+                        strategy_names=[f"s{j}" for j in range(4)])
+        assert res.ante.shape[0] == 3 and res.ante.shape[2] == 4
+        assert res.post.shape == res.ante.shape
+        assert res.sharpe_post.shape == (3, 4)
+        assert np.isfinite(res.oos_r2_mean).all()
+        assert np.isfinite(res.ante).all() and np.isfinite(res.post).all()
+        # richer latent space should not reconstruct worse in-sample
+        assert res.is_r2[2] >= res.is_r2[0] - 1e-3
+
+        best = res.best_by_sharpe()
+        assert set(best) == {"s0", "s1", "s2", "s3"}
+        res.save(str(tmp_path))
+        for f in ["fit_metrics.csv", "sharpe_post.csv", "turnover.csv",
+                  "ante.npy", "summary.json"]:
+            assert (tmp_path / f).exists()
+        summary = json.loads((tmp_path / "summary.json").read_text())
+        assert "best_oos_r2" in summary
+
+    def test_augmented_sweep_runs(self, tiny_problem):
+        x_train, y_train, x_test, y_test, rf_test, factor_full = tiny_problem
+        cube = jnp.concatenate([
+            jnp.asarray(x_train[:10]).reshape(1, 10, 22),
+            jnp.asarray(y_train[:10]).reshape(1, 10, 4)], axis=2)
+        a = aug_mod.split_cube(cube, n_factors=22, n_hf=4)
+        x_aug, y_aug = aug_mod.augment_training_set(x_train, y_train, a)
+        cfg = AEConfig(epochs=20, ols_window=12)
+        res = run_sweep(x_aug, y_aug, x_test, y_test, rf_test, factor_full,
+                        cfg, latent_dims=[2])
+        assert np.isfinite(res.post).all()
+
+
+class TestReport:
+    def test_multiplot_writes_png(self, tmp_path):
+        rep = np.random.default_rng(0).normal(0, 0.02, (40, 5))
+        act = np.random.default_rng(1).normal(0, 0.02, (40, 5))
+        p = report.multiplot(rep, act, [f"s{j}" for j in range(5)],
+                             str(tmp_path / "cum.png"))
+        assert os.path.getsize(p) > 0
+
+    def test_stats_table(self):
+        r = np.random.default_rng(2).normal(0.005, 0.02, (60, 3))
+        df = report.stats_table(r, ["a", "b", "c"])
+        assert list(df.index) == ["a", "b", "c"]
+        assert "Sharpe" in df.columns
+
+
+@needs_ref
+class TestCli:
+    def test_clean_cli(self, tmp_path):
+        from hfrep_tpu.experiments.cli import main
+        rc = main(["clean", "--out-dir", str(tmp_path / "cleaned"),
+                   "--validate-against", REF])
+        assert rc == 0
+        assert (tmp_path / "cleaned" / "hfd.csv").exists()
+
+    def test_sweep_cli_tiny(self, tmp_path):
+        from hfrep_tpu.experiments.cli import main
+        rc = main(["sweep", "--latents", "1,2", "--epochs", "15",
+                   "--out", str(tmp_path / "sweep")])
+        assert rc == 0
+        assert (tmp_path / "sweep" / "summary.json").exists()
