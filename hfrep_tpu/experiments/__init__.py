@@ -16,10 +16,22 @@ SURVEY §3.3-3.4); here each flow is a config-driven, reproducible program:
 ``python -m hfrep_tpu <subcommand>`` dispatches to these.
 """
 
-from hfrep_tpu.experiments.augment import AugmentedData, augment_training_set, sample_generator
-from hfrep_tpu.experiments.sweep import SweepResult, run_sweep
-
 __all__ = [
     "AugmentedData", "augment_training_set", "sample_generator",
     "SweepResult", "run_sweep",
 ]
+
+_EXPORTS = {
+    "AugmentedData": "augment", "augment_training_set": "augment",
+    "sample_generator": "augment", "SweepResult": "sweep", "run_sweep": "sweep",
+}
+
+
+def __getattr__(name):
+    # Lazy re-exports: keep `python -m hfrep_tpu <cmd> --help` free of the
+    # jax/replication import cost (cli.py defers heavy imports likewise).
+    if name in _EXPORTS:
+        import importlib
+        mod = importlib.import_module(f"hfrep_tpu.experiments.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,145 @@
+"""Sequence (context) parallelism: pipelined LSTM over a window-sharded mesh.
+
+The reference processes windows of 48-168 months sequentially on one
+device (SURVEY §5.7 — no sequence parallelism exists to port).  For
+long-window synthesis (W ≫ 168) a recurrent model cannot use ring
+attention's trick of reordering blockwise softmax — the carry is a hard
+sequential dependency.  The idiomatic TPU answer is *pipeline parallelism
+over the time axis*:
+
+* the window axis W is sharded into contiguous chunks, one per device on
+  the ``sp`` mesh axis (device k owns timesteps [k·W/D, (k+1)·W/D));
+* the batch is split into M microbatches; device k runs its chunk of
+  microbatch m at pipeline superstep s = k + m, so after the k-step
+  fill the pipe all D devices compute concurrently;
+* the (h, c) carry crosses device boundaries via `lax.ppermute` over
+  ICI — the only communication, 2·Bm·H floats per superstep.
+
+Per-chunk compute follows :class:`hfrep_tpu.ops.lstm.KerasLSTM`: the
+input projection for the whole local chunk is one big MXU matmul hoisted
+out of the recurrence; only the (Bm, H) @ (H, 4H) recurrent matmul runs
+per timestep.
+
+Exactness: the pipeline computes the identical recurrence (same order,
+same arithmetic) as the single-device scan — verified to float32
+round-off in tests/test_sequence.py on an 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hfrep_tpu.ops.layers import ACTIVATIONS
+
+
+def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarray],
+                      recurrent: jnp.ndarray, act, rec_act):
+    """Scan one (Wl, Bm, 4H) pre-projected chunk from the given carry."""
+
+    def cell(c, xz_t):
+        h_prev, c_prev = c
+        z = xz_t + h_prev @ recurrent
+        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+        i = rec_act(zi)
+        f = rec_act(zf)
+        c_new = f * c_prev + i * act(zc)
+        o = rec_act(zo)
+        h_t = o * act(c_new)
+        return (h_t, c_new), h_t
+
+    return lax.scan(cell, carry, xz_chunk)
+
+
+def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
+            x: jnp.ndarray, mesh: Mesh, *, axis_name: str = "sp",
+            microbatches: Optional[int] = None,
+            activation: str = "tanh",
+            recurrent_activation: str = "sigmoid") -> jnp.ndarray:
+    """LSTM over (B, W, F) with W sharded across ``axis_name``.
+
+    Returns the full hidden sequence (B, W, H), sharded over W the same
+    way.  ``microbatches`` defaults to the number of ``sp`` devices
+    (square pipeline — fill/drain overhead D/(M+D-1)).  Activation
+    defaults mirror :class:`hfrep_tpu.ops.lstm.KerasLSTM` (tanh candidate
+    transform, sigmoid gates); the reference's generators override the
+    candidate transform with sigmoid (``GAN/MTSS_WGAN_GP.py:224-226``).
+    """
+    n_dev = mesh.shape[axis_name]
+    b, w, f = x.shape
+    h = recurrent.shape[0]
+    m = microbatches or n_dev
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    if w % n_dev:
+        raise ValueError(f"window {w} not divisible by sp devices {n_dev}")
+    bm = b // m
+    act, rec_act = ACTIVATIONS[activation], ACTIVATIONS[recurrent_activation]
+
+    fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
+
+    def per_device(kern, rec, bia, x_local):
+        # x_local: (B, Wl, F) — this device's time chunk for every row.
+        wl = x_local.shape[1]
+        k_idx = lax.axis_index(axis_name)
+        # Hoisted input projection: one MXU matmul for the whole chunk.
+        xz = (x_local.reshape(b * wl, f) @ kern + bia).reshape(b, wl, 4 * h)
+        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4H)
+        xz_mb = xz.reshape(wl, m, bm, 4 * h)            # microbatch split
+
+        # pvary: mark the device-varying loop state as such for the new
+        # shard_map VMA type system (loop outputs vary over 'sp').
+        out = lax.pvary(jnp.zeros((wl, m, bm, h), xz.dtype), (axis_name,))
+        carry_reg = (lax.pvary(jnp.zeros((bm, h), xz.dtype), (axis_name,)),
+                     lax.pvary(jnp.zeros((bm, h), xz.dtype), (axis_name,)))
+
+        def superstep(s, state):
+            out_buf, (h_in, c_in) = state
+            mb = s - k_idx                              # microbatch this device runs now
+            active = jnp.logical_and(mb >= 0, mb < m)
+            mb_c = jnp.clip(mb, 0, m - 1)
+            xz_s = lax.dynamic_index_in_dim(xz_mb, mb_c, axis=1, keepdims=False)
+            # Device 0 always starts microbatches from the zero carry.
+            h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
+            c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
+            (h_f, c_f), h_seq = _local_chunk_scan(xz_s, (h0, c0), rec, act, rec_act)
+            out_buf = jnp.where(
+                active,
+                lax.dynamic_update_index_in_dim(out_buf, h_seq, mb_c, axis=1),
+                out_buf)
+            h_f = jnp.where(active, h_f, 0.0)
+            c_f = jnp.where(active, c_f, 0.0)
+            # Hand the finished carry to the next pipeline stage.
+            h_nxt = lax.ppermute(h_f, axis_name, perm=fwd)
+            c_nxt = lax.ppermute(c_f, axis_name, perm=fwd)
+            return out_buf, (h_nxt, c_nxt)
+
+        out, _ = lax.fori_loop(0, m + n_dev - 1, superstep, (out, carry_reg))
+        # (Wl, M, Bm, H) → (B, Wl, H)
+        out = out.reshape(wl, b, h)
+        return jnp.swapaxes(out, 0, 1)
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, axis_name, None)),
+        out_specs=P(None, axis_name, None))
+    return mapped(kernel, recurrent, bias, x)
+
+
+def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
+                          **kw) -> jnp.ndarray:
+    """Convenience wrapper taking a KerasLSTM param dict
+    ({kernel, recurrent_kernel, bias}) and placing ``x`` window-sharded
+    on the mesh before the pipelined scan."""
+    axis = kw.get("axis_name", "sp")
+    sharding = NamedSharding(mesh, P(None, axis, None))
+    x = jax.device_put(x, sharding)
+    return sp_lstm(params["kernel"], params["recurrent_kernel"], params["bias"],
+                   x, mesh, **kw)

@@ -28,7 +28,8 @@ from hfrep_tpu.utils.profiling import StepTimer
 
 class GanTrainer:
     def __init__(self, cfg: ExperimentConfig, dataset: GanDataset | jnp.ndarray,
-                 mesh=None, logger: Optional[MetricLogger] = None):
+                 mesh=None, logger: Optional[MetricLogger] = None,
+                 nan_guard: bool = False, max_recoveries: int = 3):
         self.cfg = cfg
         self.windows = dataset.windows if isinstance(dataset, GanDataset) else jnp.asarray(dataset)
         self.scaler = dataset.scaler if isinstance(dataset, GanDataset) else None
@@ -51,33 +52,79 @@ class GanTrainer:
         self.history: list[dict] = []
         self._single_step = None
         self._generate_fn = None
+        # Failure detection (SURVEY §5.2-5.3: absent in the reference — a
+        # diverged 5000-epoch run loses everything).  When enabled, a
+        # block producing non-finite metrics is rolled back in memory (the
+        # pre-block state is kept as a copy) and retried on a fresh PRNG
+        # stream; after max_recoveries consecutive failures it raises.
+        self.nan_guard = nan_guard
+        self.max_recoveries = max_recoveries
+        self.recoveries = 0
 
     # ------------------------------------------------------------ training
     def train(self, epochs: Optional[int] = None) -> GanState:
         tcfg = self.cfg.train
         epochs = epochs if epochs is not None else tcfg.epochs
         n_full, remainder = divmod(epochs, tcfg.steps_per_call)
-        for _ in range(n_full):
+        done = 0
+        while done < n_full:
             self.key, sub = jax.random.split(self.key)
             self.timer.start()
-            self.state, metrics = self._multi(self.state, sub)
+            metrics = self._guarded(self._multi, sub)
+            if metrics is None:
+                continue                    # guard tripped: block retried
             self.timer.stop(tcfg.steps_per_call, sync_on=self.state.g_params)
             self._log_block(metrics, tcfg.steps_per_call)
             self.epoch += tcfg.steps_per_call
+            done += 1
             if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < tcfg.steps_per_call:
                 self.save_checkpoint()
-        for _ in range(remainder):
+        done = 0
+        while done < remainder:
             # exact epoch counts: leftover epochs run on a cached 1-epoch step
             self.key, sub = jax.random.split(self.key)
             self.timer.start()
-            self.state, metrics = self._one(self.state, sub)
+            metrics = self._guarded(self._one, sub)
+            if metrics is None:
+                continue
             self.timer.stop(1, sync_on=self.state.g_params)
             self._log_block(jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics), 1)
             self.epoch += 1
+            done += 1
             if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every == 0:
                 self.save_checkpoint()
         self.logger.flush()
         return self.state
+
+    def _guarded(self, fn, key):
+        """Run one block; on non-finite metrics roll back and reseed.
+
+        Returns the metrics, or None when the guard rolled the block back
+        (the caller retries with a fresh key).  Raises after
+        ``max_recoveries`` consecutive failures.
+        """
+        # The jitted step donates the input state buffers, so a rollback
+        # target must be materialized before the call.
+        prev_state = (jax.tree_util.tree_map(jnp.copy, self.state)
+                      if self.nan_guard else self.state)
+        state, metrics = fn(self.state, key)
+        if self.nan_guard:
+            host = jax.device_get(metrics)
+            finite = all(np.isfinite(v).all() for v in host.values())
+            if not finite:
+                self.recoveries += 1
+                if self.recoveries > self.max_recoveries:
+                    raise FloatingPointError(
+                        f"training diverged {self.recoveries} times in a row "
+                        f"(epoch {self.epoch}); last metrics: "
+                        f"{ {k: np.asarray(v).reshape(-1)[-1] for k, v in host.items()} }")
+                self.logger.log(self.epoch, {"recovery": self.recoveries})
+                self.state = prev_state        # in-memory rollback of the block
+                self.key = jax.random.fold_in(self.key, 7919 + self.recoveries)
+                return None
+            self.recoveries = 0
+        self.state = state
+        return metrics
 
     def _one(self, state, key):
         if self._single_step is None:

@@ -135,3 +135,54 @@ def test_trainer_generate_inverse_scales():
     tr = GanTrainer(cfg, ds)
     out = tr.generate(jax.random.PRNGKey(2), 3)
     assert out.shape == (3, 8, 5)
+
+
+class TestNanGuard:
+    """Failure detection: non-finite block rolls back and reseeds."""
+
+    def _trainer(self, dataset, **kw):
+        cfg = ExperimentConfig(model=MCFG, train=TCFG)
+        return GanTrainer(cfg, dataset, **kw)
+
+    def test_recovers_from_transient_nan(self, dataset):
+        tr = self._trainer(dataset, nan_guard=True)
+        real_multi = tr._multi
+        calls = {"n": 0}
+
+        def flaky(state, key):
+            calls["n"] += 1
+            state2, metrics = real_multi(state, key)
+            if calls["n"] == 1:
+                metrics = {k: jnp.full_like(v, jnp.nan) for k, v in metrics.items()}
+            return state2, metrics
+
+        tr._multi = flaky
+        state_before = jax.tree_util.tree_map(jnp.copy, tr.state)
+        tr.train(epochs=3)              # one steps_per_call block
+        assert tr.recoveries == 0       # reset after the successful retry
+        assert calls["n"] == 2          # failed once, retried once
+        assert tr.epoch == 3
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()), state_before.g_params,
+            tr.state.g_params)
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+    def test_gives_up_after_max_recoveries(self, dataset):
+        tr = self._trainer(dataset, nan_guard=True, max_recoveries=2)
+        real_multi = tr._multi
+
+        def always_nan(state, key):
+            state2, metrics = real_multi(state, key)
+            return state2, {k: jnp.full_like(v, jnp.nan) for k, v in metrics.items()}
+
+        tr._multi = always_nan
+        with pytest.raises(FloatingPointError):
+            tr.train(epochs=3)
+
+    def test_guard_off_keeps_nan(self, dataset):
+        tr = self._trainer(dataset, nan_guard=False)
+        real_multi = tr._multi
+        tr._multi = lambda s, k: (lambda st, m: (st, {kk: jnp.full_like(vv, jnp.nan)
+                                                      for kk, vv in m.items()}))(*real_multi(s, k))
+        tr.train(epochs=3)              # no raise, NaNs pass through
+        assert any(not np.isfinite(h["d_loss"]) for h in tr.history)
