@@ -31,6 +31,26 @@ from jax import lax
 from hfrep_tpu.ops.layers import ACTIVATIONS
 
 
+def lstm_cell_step(carry, xz_t, *, recurrent, act, rec_act):
+    """One fused LSTM step from a pre-projected input slice.
+
+    ``xz_t`` is the already-projected input ``x_t @ kernel + bias`` with
+    shape (..., 4H); gate blocks are Keras-ordered [input, forget,
+    candidate, output].  Shared by :class:`KerasLSTM` and the pipelined
+    sequence-parallel scan (``hfrep_tpu.parallel.sequence``) so the two
+    paths cannot drift apart arithmetically.
+    """
+    h_prev, c_prev = carry
+    z = xz_t + h_prev @ recurrent
+    zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+    i = rec_act(zi)
+    fgt = rec_act(zf)
+    c = fgt * c_prev + i * act(zc)
+    o = rec_act(zo)
+    h_t = o * act(c)
+    return (h_t, c), h_t
+
+
 def _unit_forget_bias(key, shape, dtype=jnp.float32):
     h = shape[0] // 4
     return jnp.concatenate([
@@ -66,15 +86,7 @@ class KerasLSTM(nn.Module):
         rec = recurrent.astype(dtype)
 
         def cell(carry, xz_t):
-            h_prev, c_prev = carry
-            z = xz_t + h_prev @ rec
-            zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
-            i = rec_act(zi)
-            fgt = rec_act(zf)
-            c = fgt * c_prev + i * act(zc)
-            o = rec_act(zo)
-            h_t = o * act(c)
-            return (h_t, c), h_t
+            return lstm_cell_step(carry, xz_t, recurrent=rec, act=act, rec_act=rec_act)
 
         init = (jnp.zeros((b, h), dtype), jnp.zeros((b, h), dtype))
         _, hs = lax.scan(cell, init, xz)

@@ -38,22 +38,16 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hfrep_tpu.ops.layers import ACTIVATIONS
+from hfrep_tpu.ops.lstm import lstm_cell_step
 
 
 def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarray],
                       recurrent: jnp.ndarray, act, rec_act):
-    """Scan one (Wl, Bm, 4H) pre-projected chunk from the given carry."""
+    """Scan one (Wl, Bm, 4H) pre-projected chunk from the given carry,
+    using the same fused cell as the single-device :class:`KerasLSTM`."""
 
     def cell(c, xz_t):
-        h_prev, c_prev = c
-        z = xz_t + h_prev @ recurrent
-        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
-        i = rec_act(zi)
-        f = rec_act(zf)
-        c_new = f * c_prev + i * act(zc)
-        o = rec_act(zo)
-        h_t = o * act(c_new)
-        return (h_t, c_new), h_t
+        return lstm_cell_step(c, xz_t, recurrent=recurrent, act=act, rec_act=rec_act)
 
     return lax.scan(cell, carry, xz_chunk)
 
@@ -94,11 +88,14 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
         xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4H)
         xz_mb = xz.reshape(wl, m, bm, 4 * h)            # microbatch split
 
-        # pvary: mark the device-varying loop state as such for the new
-        # shard_map VMA type system (loop outputs vary over 'sp').
-        out = lax.pvary(jnp.zeros((wl, m, bm, h), xz.dtype), (axis_name,))
-        carry_reg = (lax.pvary(jnp.zeros((bm, h), xz.dtype), (axis_name,)),
-                     lax.pvary(jnp.zeros((bm, h), xz.dtype), (axis_name,)))
+        # pcast to varying: mark the device-varying loop state as such for
+        # the shard_map VMA type system (loop outputs vary over 'sp').
+        def _varying(a):
+            return lax.pcast(a, axis_name, to="varying")
+
+        out = _varying(jnp.zeros((wl, m, bm, h), xz.dtype))
+        carry_reg = (_varying(jnp.zeros((bm, h), xz.dtype)),
+                     _varying(jnp.zeros((bm, h), xz.dtype)))
 
         def superstep(s, state):
             out_buf, (h_in, c_in) = state
