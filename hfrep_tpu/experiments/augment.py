@@ -45,6 +45,31 @@ def sample_generator(trainer, key: jax.Array, n_windows: int = 10,
     return split_cube(cube, n_factors=n_factors, n_hf=n_hf)
 
 
+def sample_keras_generator(path: str, key: jax.Array, panel: Panel,
+                           n_windows: int = 10, n_factors: int = 22,
+                           n_hf: int = 13) -> AugmentedData:
+    """The notebook's exact cell 42-48 flow from a reference ``.h5``
+    artifact: load the trained Keras generator
+    (:func:`~hfrep_tpu.utils.keras_import.load_keras_generator`), sample
+    ``normal(0, 1, (N, W, F))`` noise (cell 43), inverse-scale with the
+    panel-refit MinMax scaler (cell 47), and split (cell 48).
+
+    Whether the artifact carries an rf column is inferred from its own
+    feature count — 36 → 22 factors + 13 HF + rf (production shape),
+    35 → no rf (committed-script shape).
+    """
+    from hfrep_tpu.utils.keras_import import load_keras_generator
+
+    module, params, (window, features) = load_keras_generator(path)
+    z = jax.random.normal(key, (n_windows, window, features), jnp.float32)
+    cube_scaled = jax.jit(lambda p, z: module.apply({"params": p}, z))(params, z)
+    # rf presence is a property of the *emitted* cube, not the noise width
+    # (a latent-dim generator can have input width != output width).
+    include_rf = cube_scaled.shape[2] > n_factors + n_hf
+    cube = inverse_scale_cube(cube_scaled, panel, include_rf=include_rf)
+    return split_cube(cube, n_factors=n_factors, n_hf=n_hf)
+
+
 def split_cube(cube: jnp.ndarray, n_factors: int = 22, n_hf: int = 13) -> AugmentedData:
     """(N, W, F) inverse-scaled cube → flattened factor/HF/rf rows."""
     n_features = cube.shape[2]

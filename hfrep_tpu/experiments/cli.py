@@ -52,13 +52,25 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
     s.add_argument("--latents", default="1:21", help="'lo:hi' inclusive, or comma list")
     s.add_argument("--out", required=True)
-    s.add_argument("--gan-checkpoint", default=None,
-                   help="generator checkpoint: run the GAN-augmented sweep")
+    src = s.add_mutually_exclusive_group()
+    src.add_argument("--gan-checkpoint", default=None,
+                     help="generator checkpoint: run the GAN-augmented sweep")
+    src.add_argument("--h5-generator", default=None,
+                     help="reference Keras .h5 generator artifact: run the "
+                          "GAN-augmented sweep from it (notebook cell 42)")
     s.add_argument("--preset", default="mtss_wgan_gp_prod",
                    help="preset the checkpoint was trained with")
     s.add_argument("--n-gen-windows", type=int, default=10)
     s.add_argument("--epochs", type=int, default=None, help="AE epochs override")
     s.add_argument("--plots", action="store_true")
+
+    h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
+                                         "into an inverse-scaled cube (.npy)")
+    h.add_argument("--h5", required=True, help="trained_generator/*.h5 artifact")
+    h.add_argument("--out", required=True, help="output .npy path")
+    h.add_argument("--n-windows", type=int, default=10)
+    h.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    h.add_argument("--seed", type=int, default=0)
     return p
 
 
@@ -176,11 +188,17 @@ def cmd_sweep(args) -> int:
     x_train, x_test, y_train, y_test = panel.train_test_split()
     rf_test = panel.rf[x_train.shape[0]:]
 
+    aug = None
     if args.gan_checkpoint:
         trainer, _, _, _ = _make_trainer(args.preset, args.cleaned_dir, quiet=True)
         trainer.restore_checkpoint(args.gan_checkpoint)
         aug = sample_generator(trainer, jax.random.PRNGKey(7),
                                n_windows=args.n_gen_windows)
+    elif args.h5_generator:
+        from hfrep_tpu.experiments.augment import sample_keras_generator
+        aug = sample_keras_generator(args.h5_generator, jax.random.PRNGKey(7),
+                                     panel, n_windows=args.n_gen_windows)
+    if aug is not None:
         x_train, y_train = augment_training_set(x_train, y_train, aug)
         print(f"augmented training set: {x_train.shape[0]} rows "
               f"({aug.factors.shape[0]} synthetic)")
@@ -204,10 +222,24 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_sample_h5(args) -> int:
+    import jax
+    from hfrep_tpu.core.data import load_panel
+    from hfrep_tpu.experiments.augment import sample_keras_generator
+
+    panel = load_panel(args.cleaned_dir)
+    aug = sample_keras_generator(args.h5, jax.random.PRNGKey(args.seed),
+                                 panel, n_windows=args.n_windows)
+    np.save(args.out, np.asarray(aug.raw_windows))
+    print(f"samples: {args.out} {tuple(aug.raw_windows.shape)}")
+    return 0
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     return {"clean": cmd_clean, "train-gan": cmd_train_gan,
-            "eval-gan": cmd_eval_gan, "sweep": cmd_sweep}[args.cmd](args)
+            "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
+            "sample-h5": cmd_sample_h5}[args.cmd](args)
 
 
 if __name__ == "__main__":

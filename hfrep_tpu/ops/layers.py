@@ -59,8 +59,9 @@ class KerasDense(nn.Module):
 class KerasLayerNorm(nn.Module):
     """``keras.layers.LayerNormalization`` defaults: axis=-1, eps=1e-3."""
 
+    epsilon: float = 1e-3
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x):
-        return nn.LayerNorm(epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.LayerNorm(epsilon=self.epsilon, dtype=self.dtype)(x)

@@ -1,0 +1,221 @@
+"""Import reference Keras ``.h5`` generator artifacts into Flax params.
+
+The paper's headline GAN-augmentation experiment starts from a trained
+generator saved by ``GAN/MTSS_WGAN_GP.py:285-287``, loaded with Keras
+``load_model`` at ``autoencoder_v4.ipynb`` cell 42 and sampled at cell
+43.  This module makes those artifacts (production
+``trained_generator/MTTS_GAN_GP20220621_02-49-32.h5`` plus the six
+``old/`` family checkpoints) first-class inputs to the TPU pipeline: it
+parses the h5's ``model_config`` JSON into a layer spec, builds the
+matching Flax module from the same Keras-semantics primitives the
+native models use (:class:`~hfrep_tpu.ops.lstm.KerasLSTM`,
+:class:`~hfrep_tpu.ops.layers.KerasDense`, …), and binds the stored
+weights.
+
+The model is built from the artifact's *own* config rather than assumed
+from the family name, because the production artifact's architecture
+differs from the committed script: in the h5, ``LeakyReLU`` follows
+*both* LSTMs, while ``GAN/MTSS_WGAN_GP.py:221-235`` applies it only
+after the second.  Committed-script shapes (48, 35) and the production
+shape (168, 36) (SURVEY §2 tail) both load through the same path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
+from hfrep_tpu.ops.lstm import KerasLSTM
+
+# A spec is a hashable tuple so it can live in a Flax module field:
+#   ("lstm", units, activation, recurrent_activation)
+#   ("dense", units, activation)
+#   ("layer_norm", epsilon)
+#   ("leaky_relu", alpha)
+Spec = Tuple[Any, ...]
+
+_WEIGHTED = {"lstm", "dense", "layer_norm"}
+
+
+def _as_str(x) -> str:
+    return x.decode() if isinstance(x, bytes) else str(x)
+
+
+def _flatten_layers(layers: Sequence[dict], specs: List[Spec],
+                    input_shape: List[Tuple[int, ...]]) -> None:
+    for layer in layers:
+        cls, cfg = layer["class_name"], layer["config"]
+        if cls in ("Sequential", "Functional", "Model"):
+            _flatten_layers(cfg["layers"], specs, input_shape)
+        elif cls == "InputLayer":
+            shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+            if shape is not None:
+                input_shape.append(tuple(shape[1:]))
+        elif cls == "LSTM":
+            # Fields our KerasLSTM does not model must fail loudly, not
+            # load as a silently different function.
+            for field, default in (("return_sequences", True),
+                                   ("go_backwards", False),
+                                   ("stateful", False), ("use_bias", True)):
+                if cfg.get(field, default) != default:
+                    raise ValueError(
+                        f"unsupported LSTM config {field}={cfg[field]!r}")
+            specs.append(("lstm", int(cfg["units"]),
+                          cfg.get("activation", "tanh"),
+                          cfg.get("recurrent_activation", "sigmoid")))
+        elif cls == "Dense":
+            specs.append(("dense", int(cfg["units"]), cfg.get("activation"),
+                          bool(cfg.get("use_bias", True))))
+        elif cls == "LayerNormalization":
+            specs.append(("layer_norm", float(cfg.get("epsilon", 1e-3))))
+        elif cls == "LeakyReLU":
+            specs.append(("leaky_relu",
+                          float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))))
+        elif cls in ("Flatten", "Activation", "Dropout"):
+            # Flatten appears only in critics (not saved); tolerate anyway.
+            specs.append((cls.lower(), cfg.get("activation")))
+        else:
+            raise ValueError(f"unsupported Keras layer in artifact: {cls}")
+
+
+def parse_model_config(path: str) -> Tuple[Tuple[Spec, ...], Tuple[int, ...]]:
+    """h5 ``model_config`` attr → (layer specs, per-sample input shape)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        cfg = json.loads(_as_str(f.attrs["model_config"]))
+    specs: List[Spec] = []
+    input_shapes: List[Tuple[int, ...]] = []
+    _flatten_layers([cfg] if "class_name" in cfg else cfg["config"]["layers"],
+                    specs, input_shapes)
+    if not input_shapes:
+        raise ValueError(f"no InputLayer shape found in {path}")
+    return tuple(specs), input_shapes[0]
+
+
+class ImportedSequential(nn.Module):
+    """A reference Sequential generator rebuilt on the native primitives.
+
+    Parameter tree keys are ``layer_{i}`` with ``i`` the position in
+    ``specs`` — weightless layers (LeakyReLU) simply have no entry.
+    """
+
+    specs: Tuple[Spec, ...]
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i, spec in enumerate(self.specs):
+            kind = spec[0]
+            name = f"layer_{i}"
+            if kind == "lstm":
+                x = KerasLSTM(spec[1], activation=spec[2],
+                              recurrent_activation=spec[3], name=name)(x)
+            elif kind == "dense":
+                use_bias = spec[3] if len(spec) > 3 else True
+                x = KerasDense(spec[1], activation=spec[2],
+                               use_bias=use_bias, name=name)(x)
+            elif kind == "layer_norm":
+                x = KerasLayerNorm(epsilon=spec[1], name=name)(x)
+            elif kind == "leaky_relu":
+                x = leaky_relu(x, spec[1])
+            elif kind == "flatten":
+                x = x.reshape(x.shape[0], -1)
+            elif kind == "activation":
+                from hfrep_tpu.ops.layers import ACTIVATIONS
+                x = ACTIVATIONS[spec[1]](x)
+            elif kind == "dropout":
+                pass                                   # inference: identity
+            else:  # pragma: no cover - parse_model_config rejects these
+                raise ValueError(f"unsupported spec {spec}")
+        return x
+
+
+def _ordered_weight_groups(path: str) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """Flatten ``model_weights`` into per-layer {basename: array} dicts,
+    preserving the save order recorded in the ``weight_names`` attrs.
+
+    Keras writes one entry per variable as e.g.
+    ``sequential_2/lstm_4/lstm_cell_4/kernel:0``; consecutive entries
+    sharing a dirname belong to one layer.
+    """
+    import h5py
+
+    groups: List[Tuple[str, Dict[str, np.ndarray]]] = []
+    with h5py.File(path, "r") as f:
+        mw = f["model_weights"]
+        for layer_name in mw.attrs["layer_names"]:
+            g = mw[_as_str(layer_name)]
+            for wn in g.attrs.get("weight_names", []):
+                wn = _as_str(wn)
+                dirname, base = wn.rsplit("/", 1)
+                base = base.split(":")[0]
+                if not groups or groups[-1][0] != dirname:
+                    groups.append((dirname, {}))
+                groups[-1][1][base] = np.array(g[wn])
+    return groups
+
+
+def load_keras_weights(path: str, specs: Sequence[Spec]) -> Dict[str, Any]:
+    """h5 weights → params dict matching :class:`ImportedSequential`."""
+    groups = _ordered_weight_groups(path)
+    weighted = [(i, s) for i, s in enumerate(specs) if s[0] in _WEIGHTED]
+    if len(groups) != len(weighted):
+        raise ValueError(
+            f"{path}: {len(groups)} weighted layer groups in h5 vs "
+            f"{len(weighted)} weighted specs from model_config")
+    params: Dict[str, Any] = {}
+    for (i, spec), (dirname, w) in zip(weighted, groups):
+        kind = spec[0]
+        try:
+            if kind == "lstm":
+                params[f"layer_{i}"] = {
+                    "kernel": jnp.asarray(w["kernel"]),
+                    "recurrent_kernel": jnp.asarray(w["recurrent_kernel"]),
+                    "bias": jnp.asarray(w["bias"]),
+                }
+            elif kind == "dense":
+                p = {"kernel": jnp.asarray(w["kernel"])}
+                if "bias" in w:
+                    p["bias"] = jnp.asarray(w["bias"])
+                params[f"layer_{i}"] = {"Dense_0": p}
+            elif kind == "layer_norm":
+                params[f"layer_{i}"] = {"LayerNorm_0": {
+                    "scale": jnp.asarray(w["gamma"]),
+                    "bias": jnp.asarray(w["beta"]),
+                }}
+        except KeyError as e:  # pragma: no cover - malformed artifact
+            raise ValueError(
+                f"{path}: layer group '{dirname}' missing weight {e} "
+                f"for spec {spec}") from e
+    return params
+
+
+def load_keras_generator(path: str):
+    """Load a reference generator artifact.
+
+    Returns ``(module, params, input_shape)`` where ``input_shape`` is
+    the per-sample noise shape, e.g. ``(168, 36)`` for the production
+    artifact (``autoencoder_v4.ipynb`` cell 43 samples
+    ``normal(0, 1, (10, 168, 36))``).
+    """
+    specs, input_shape = parse_model_config(path)
+    params = load_keras_weights(path, specs)
+    module = ImportedSequential(specs=specs)
+
+    # Structural validation: imported tree must match a fresh init.
+    ref = jax.eval_shape(
+        lambda k: module.init(k, jnp.zeros((1,) + tuple(input_shape), jnp.float32)),
+        jax.random.PRNGKey(0))["params"]
+    ref_shapes = jax.tree_util.tree_map(lambda a: tuple(a.shape), ref)
+    got_shapes = jax.tree_util.tree_map(lambda a: tuple(a.shape), params)
+    if ref_shapes != got_shapes:
+        raise ValueError(
+            f"{path}: imported weight shapes do not match model_config "
+            f"architecture:\n  config: {ref_shapes}\n  h5: {got_shapes}")
+    return module, params, tuple(input_shape)
