@@ -174,8 +174,18 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     def gp_critic_loss(d_params, g_params, real, noise, alpha):
         fake = lax.stop_gradient(g_apply(g_params, noise))
         interp = alpha * real + (1.0 - alpha) * fake
+        b = real.shape[0]
+
+        # One critic traversal scores real ⊕ fake (2B batch) — identical
+        # math to two separate applications since the LSTM recurrence is
+        # per-sample, but one fewer serial scan on the critical path.
+        # The gradient penalty stays a separate B-wide traversal: folding
+        # interp into the batch too would widen the *second-order* path
+        # (outer grad through the GP input-grad) to 3B and measures
+        # slower on the chip than the scan it saves.
+        scores = d_apply(d_params, jnp.concatenate([real, fake], axis=0))
         gp = gradient_penalty(d_apply, d_params, interp)
-        w_loss = jnp.mean(-d_apply(d_params, real)) + jnp.mean(d_apply(d_params, fake))
+        w_loss = jnp.mean(-scores[:b]) + jnp.mean(scores[b:])
         return w_loss + gp_w * gp, (w_loss, gp)
 
     def wgan_gp_step(state: GanState, key: jax.Array):
