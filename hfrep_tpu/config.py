@@ -58,6 +58,7 @@ class TrainConfig:
     checkpoint_every: int = 1000   # reference saves only at end (GAN/MTSS_WGAN_GP.py:285-287)
     checkpoint_dir: Optional[str] = None
     steps_per_call: int = 25       # host↔device round-trips amortized via lax.scan
+    lstm_backend: str = "auto"     # auto|pallas|xla — see ops/pallas_lstm.py
 
 
 @dataclasses.dataclass(frozen=True)

@@ -43,7 +43,7 @@ class DenseDiscriminator(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, backend=None):
         x = KerasDense(self.hidden, dtype=self.dtype)(x)
         x = KerasDense(self.hidden, dtype=self.dtype)(x)
         return KerasDense(1, dtype=self.dtype)(x)
@@ -57,7 +57,7 @@ class DenseCritic(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, backend=None):
         x = KerasDense(self.hidden, dtype=self.dtype)(x)
         x = leaky_relu(x, self.slope)
         x = KerasLayerNorm(dtype=self.dtype)(x)
@@ -74,7 +74,7 @@ class DenseFlatCritic(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, backend=None):
         x = KerasDense(self.hidden, dtype=self.dtype)(x)
         x = KerasDense(self.hidden, dtype=self.dtype)(x)
         x = x.reshape(x.shape[0], -1)
@@ -88,9 +88,9 @@ class LSTMDiscriminator(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+    def __call__(self, x, backend=None):
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
         return KerasDense(1, dtype=self.dtype)(x)
 
 
@@ -102,11 +102,11 @@ class LSTMCritic(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
-        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x)
+    def __call__(self, x, backend=None):
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
         x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
         x = KerasLayerNorm(dtype=self.dtype)(x)
         return KerasDense(1, dtype=self.dtype)(x)
@@ -119,8 +119,8 @@ class LSTMFlatCritic(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x)
+    def __call__(self, x, backend=None):
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
+        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
         x = x.reshape(x.shape[0], -1)
         return KerasDense(1, dtype=self.dtype)(x)

@@ -37,7 +37,7 @@ class DenseGenerator(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, z: jnp.ndarray, backend=None) -> jnp.ndarray:
         x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype)(z)
         x = leaky_relu(x, self.slope)
         x = KerasLayerNorm(dtype=self.dtype)(x)
@@ -54,10 +54,10 @@ class LSTMGenerator(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:
-        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(z)
+    def __call__(self, z: jnp.ndarray, backend=None) -> jnp.ndarray:
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(z, backend=backend)
         x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(x)
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
         x = KerasLayerNorm(dtype=self.dtype)(x)
         return KerasDense(self.features, dtype=self.dtype)(x)

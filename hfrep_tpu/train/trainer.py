@@ -181,8 +181,10 @@ class GanTrainer:
         w, f = self.windows.shape[1], self.windows.shape[2]
         noise = jax.random.normal(key, (n_samples, w, f))
         if self._generate_fn is None:
+            from hfrep_tpu.train.steps import resolve_lstm_backend
+            be = resolve_lstm_backend(self.cfg.train.lstm_backend)
             self._generate_fn = jax.jit(
-                lambda p, z: self.pair.generator.apply({"params": p}, z))
+                lambda p, z: self.pair.generator.apply({"params": p}, z, backend=be))
         out = self._generate_fn(self.state.g_params, noise)
         if unscale and self.scaler is not None:
             from hfrep_tpu.core import scaler as mm
