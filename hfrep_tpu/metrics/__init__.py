@@ -1,2 +1,2 @@
 from hfrep_tpu.metrics.gan_eval import GanEval  # noqa: F401
-from hfrep_tpu.metrics.gaussian_nb import GaussianNBParams, fit_gaussian_nb, predict_proba  # noqa: F401
+from hfrep_tpu.metrics.gaussian_nb import GaussianNBParams, fit_gaussian_nb, predict_log_proba, predict_proba  # noqa: F401

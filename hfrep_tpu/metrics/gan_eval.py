@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hfrep_tpu.metrics.gaussian_nb import fit_gaussian_nb, predict_proba
+from hfrep_tpu.metrics.gaussian_nb import fit_gaussian_nb, predict_log_proba
 from hfrep_tpu.ops.rolling import ols_beta
 from hfrep_tpu.ops.sqrtm import sqrtm_product_trace
 
@@ -107,23 +107,25 @@ def _probe_labels(n_windows: int, n_features: int, reference_compat: bool) -> jn
 
 
 @functools.partial(jax.jit, static_argnames=("reference_compat",))
-def _nb_probs(real: Array, fake: Array, dataset: Array, reference_compat: bool = False):
+def _nb_log_probs(real: Array, fake: Array, dataset: Array, reference_compat: bool = False):
     n, _, f = dataset.shape
     params = fit_gaussian_nb(_probe_rows(dataset), _probe_labels(n, f, reference_compat), f)
-    return predict_proba(params, _probe_rows(real)), predict_proba(params, _probe_rows(fake))
-
-
-def _rel_entr(p: Array, q: Array) -> Array:
-    """scipy.special.rel_entr: p·log(p/q), 0 where p == 0."""
-    return jnp.where(p > 0, p * (jnp.log(p) - jnp.log(q)), 0.0)
+    return (predict_log_proba(params, _probe_rows(real)),
+            predict_log_proba(params, _probe_rows(fake)))
 
 
 def kl_div(real: Array, fake: Array, dataset: Array, div_only: bool = True,
            reference_compat: bool = False):
     """Mean per-row KL(fake‖real) of NB class probabilities
-    (``GAN_eval.py:139-191``)."""
-    rp, fp = _nb_probs(real, fake, dataset, reference_compat)
-    per_row = jnp.sum(_rel_entr(fp, rp), axis=1)
+    (``GAN_eval.py:139-191``).
+
+    Computed in log-domain: sklearn's float64 probe yields tiny-but-
+    nonzero probabilities where a float32 softmax underflows to exact 0
+    and ``rel_entr`` would report spurious ∞ (see
+    :func:`~hfrep_tpu.metrics.gaussian_nb.predict_log_proba`).
+    """
+    lr, lf = _nb_log_probs(real, fake, dataset, reference_compat)
+    per_row = jnp.sum(jnp.exp(lf) * (lf - lr), axis=1)
     if div_only:
         return jnp.mean(per_row)
     return jnp.mean(per_row), jnp.mean(jnp.sqrt(jnp.maximum(per_row, 0.0)))
@@ -132,10 +134,12 @@ def kl_div(real: Array, fake: Array, dataset: Array, div_only: bool = True,
 def js_div(real: Array, fake: Array, dataset: Array, div_only: bool = True,
            reference_compat: bool = False):
     """Jensen-Shannon divergence of NB class probabilities
-    (``GAN_eval.py:193-246``)."""
-    rp, fp = _nb_probs(real, fake, dataset, reference_compat)
-    m = 0.5 * (rp + fp)
-    per_row = 0.5 * jnp.sum(_rel_entr(fp, m), axis=1) + 0.5 * jnp.sum(_rel_entr(rp, m), axis=1)
+    (``GAN_eval.py:193-246``); log-domain for the same reason as
+    :func:`kl_div`."""
+    lr, lf = _nb_log_probs(real, fake, dataset, reference_compat)
+    lm = jnp.logaddexp(lr, lf) - jnp.log(2.0)
+    per_row = (0.5 * jnp.sum(jnp.exp(lf) * (lf - lm), axis=1)
+               + 0.5 * jnp.sum(jnp.exp(lr) * (lr - lm), axis=1))
     if div_only:
         return jnp.mean(per_row)
     return jnp.mean(per_row), jnp.mean(jnp.sqrt(jnp.maximum(per_row, 0.0)))

@@ -35,8 +35,11 @@ def fit_gaussian_nb(x: jnp.ndarray, y: jnp.ndarray, n_classes: int,
     counts = one_hot.sum(axis=0)                                # (C,)
     safe = jnp.maximum(counts, 1.0)
     theta = (one_hot.T @ x) / safe[:, None]
-    sq = (one_hot.T @ (x * x)) / safe[:, None]
-    var = sq - theta**2
+    # Centered two-pass variance: the E[x²]−E[x]² form cancels
+    # catastrophically in f32 when |mean| ≫ std and can go negative
+    # (→ log(NaN) in the likelihood).
+    diff = x - one_hot @ theta                                  # x − θ[y]
+    var = (one_hot.T @ (diff * diff)) / safe[:, None]
     eps = var_smoothing * jnp.max(jnp.var(x, axis=0))
     return GaussianNBParams(theta=theta, var=var + eps,
                             log_prior=jnp.log(counts / counts.sum()))
@@ -55,3 +58,16 @@ def joint_log_likelihood(params: GaussianNBParams, x: jnp.ndarray) -> jnp.ndarra
 
 def predict_proba(params: GaussianNBParams, x: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.softmax(joint_log_likelihood(params, x), axis=-1)
+
+
+def predict_log_proba(params: GaussianNBParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Normalized log-probabilities.
+
+    sklearn computes the probe in float64, where confident classifications
+    yield tiny-but-nonzero probabilities; a float32 softmax underflows the
+    same values to exact 0, which turns the KL/JS ``rel_entr`` terms into
+    spurious ∞.  Divergences must therefore be computed from these
+    log-probabilities (finite at any confidence) rather than from
+    :func:`predict_proba`.
+    """
+    return jax.nn.log_softmax(joint_log_likelihood(params, x), axis=-1)

@@ -235,7 +235,7 @@ def pallas_keras_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray,
     _supported(activation, recurrent_activation)
     b, w, f = x.shape
     h = recurrent.shape[0]
-    hp = max(LANE, ((h + LANE - 1) // LANE) * LANE)
+    hp = ((h + LANE - 1) // LANE) * LANE
 
     kernel_p = pad_gate_cols(kernel, h, hp)                       # (F, 4Hp)
     bias_p = pad_gate_cols(bias, h, hp)                           # (4Hp,)

@@ -151,6 +151,54 @@ class TestMetricOracles:
         np.testing.assert_allclose(float(ge.inception_score(r, r, d)), 1.0, atol=1e-4)
         assert float(ge.inception_score(r, f, d)) > 1.0
 
+    def test_kl_js_finite_under_confident_probe(self, rng):
+        """Well-separated features make the NB probe assign probabilities
+        that underflow to exact 0 in a linear-domain f32 (and even f64)
+        softmax — rel_entr would then report spurious ∞.  The log-domain
+        computation must stay finite (real trained-GAN samples hit this,
+        e.g. the 5000-epoch MTSS-WGAN-GP run)."""
+        n, w, f = 40, 12, 6
+        offsets = np.arange(f) * 50.0          # far-apart class means
+        d = (rng.normal(0, 0.1, (n, w, f)) + offsets).astype(np.float32)
+        r = (rng.normal(0, 0.1, (n, w, f)) + offsets).astype(np.float32)
+        fake = (rng.normal(0.5, 0.3, (n, w, f)) + offsets).astype(np.float32)
+        for compat in (False, True):
+            kl = float(ge.kl_div(jnp.asarray(r), jnp.asarray(fake), jnp.asarray(d),
+                                 reference_compat=compat))
+            js = float(ge.js_div(jnp.asarray(r), jnp.asarray(fake), jnp.asarray(d),
+                                 reference_compat=compat))
+            assert np.isfinite(kl) and kl >= 0, (compat, kl)
+            assert np.isfinite(js) and 0 <= js <= np.log(2) + 1e-6, (compat, js)
+
+    def test_kl_js_reference_compat_matches_sklearn(self, rng):
+        """reference_compat=True must reproduce the reference's own
+        GaussianNB probe (repeat-ordered labels, ``GAN_eval.py:178-187``)
+        run through sklearn in float64."""
+        from scipy.special import rel_entr
+        from sklearn.naive_bayes import GaussianNB
+
+        n, w, f = 30, 10, 5
+        d = rng.normal(0, 1.0, (n, w, f)).astype(np.float32)
+        r = rng.normal(0, 1.0, (n, w, f)).astype(np.float32)
+        fake = rng.normal(0.3, 1.2, (n, w, f)).astype(np.float32)
+
+        td = np.transpose(d, (0, 2, 1)).reshape(-1, w)
+        tr = np.transpose(r, (0, 2, 1)).reshape(-1, w)
+        tf = np.transpose(fake, (0, 2, 1)).reshape(-1, w)
+        gbn = GaussianNB().fit(td, np.repeat(np.arange(f), n))
+        rp, fp = gbn.predict_proba(tr), gbn.predict_proba(tf)
+        kl_ref = np.mean([sum(rel_entr(fp[i], rp[i])) for i in range(len(rp))])
+        m = 0.5 * (rp + fp)
+        js_ref = np.mean([0.5 * sum(rel_entr(fp[i], m[i]))
+                          + 0.5 * sum(rel_entr(rp[i], m[i])) for i in range(len(rp))])
+
+        kl = float(ge.kl_div(jnp.asarray(r), jnp.asarray(fake), jnp.asarray(d),
+                             reference_compat=True))
+        js = float(ge.js_div(jnp.asarray(r), jnp.asarray(fake), jnp.asarray(d),
+                             reference_compat=True))
+        np.testing.assert_allclose(kl, kl_ref, rtol=2e-3)
+        np.testing.assert_allclose(js, js_ref, rtol=2e-3)
+
     def test_r2_relative_error(self, cubes):
         r, f, d = (jnp.asarray(a) for a in cubes)
         assert float(ge.r2_relative_error(r, f, d)) > 0

@@ -43,10 +43,11 @@ def test_bf16_falls_back_to_scan():
                                np.asarray(ref, np.float32), atol=1e-6)
 
 
-@pytest.mark.parametrize("activation", ["sigmoid", "tanh"])
-def test_gradients_match_scan(activation):
-    mod, params, x = _mk(100, 35, activation, jax.random.PRNGKey(1))
-    w = jax.random.normal(jax.random.PRNGKey(2), (4, 6, 100))
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", None])
+@pytest.mark.parametrize("h", [100, 200])
+def test_gradients_match_scan(activation, h):
+    mod, params, x = _mk(h, 35, activation, jax.random.PRNGKey(1))
+    w = jax.random.normal(jax.random.PRNGKey(2), (4, 6, h))
 
     def loss(be):
         def f(p, xx):
