@@ -51,7 +51,7 @@ def load_dataset(mcfg: ModelConfig) -> jnp.ndarray:
 
 def main() -> None:
     mcfg = ModelConfig(family="mtss_wgan_gp")
-    tcfg = TrainConfig(steps_per_call=25)
+    tcfg = TrainConfig(steps_per_call=50)
     dataset = load_dataset(mcfg)
 
     pair = build_gan(mcfg)
@@ -63,7 +63,7 @@ def main() -> None:
     state, metrics = multi(state, jax.random.fold_in(key, 0))
     jax.block_until_ready(metrics)
 
-    n_calls = 8  # 8 × 25 = 200 timed epochs
+    n_calls = 6  # 6 × 50 = 300 timed epochs
     t0 = time.perf_counter()
     for i in range(1, n_calls + 1):
         state, metrics = multi(state, jax.random.fold_in(key, i))

@@ -63,6 +63,12 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--n-gen-windows", type=int, default=10)
     s.add_argument("--epochs", type=int, default=None, help="AE epochs override")
     s.add_argument("--plots", action="store_true")
+    s.add_argument("--stats", action="store_true",
+                   help="full stats battery for the best latent (cell 25): "
+                        "Omega/Sharpe/cVaR/CEQ/skew/kurt, FF3F/FF5F alphas, "
+                        "HK+GRS spanning of each HF index vs its replication")
+    s.add_argument("--ff3", default="/root/reference/data/F-F_Research_Data_Factors_daily.CSV")
+    s.add_argument("--ff5", default="/root/reference/data/F-F_Research_Data_5_Factors_2x3_daily.CSV")
 
     h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
                                          "into an inverse-scaled cube (.npy)")
@@ -212,13 +218,33 @@ def cmd_sweep(args) -> int:
     result.save(args.out)
     print(json.dumps(result.summary(), indent=2, default=str))
 
-    if args.plots:
+    if args.plots or args.stats:
         i_best = int(np.argmax(result.oos_r2_mean))
         p = result.post[i_best]
         actual = np.asarray(y_test)[-p.shape[0]:]
+    if args.plots:
         report.multiplot(p, actual, panel.hf_names,
                          os.path.join(args.out, "cumulative_returns.png"))
         print(f"plot: {os.path.join(args.out, 'cumulative_returns.png')}")
+    if args.stats:
+        rf_aligned = np.asarray(rf_test).reshape(-1)[-p.shape[0]:]
+        # Spanning set = the factor/ETF universe, exactly the notebook's
+        # data_analysis(..., span=factor_etf_data) (cells 25/28); OOS
+        # stats window 2010-05 → 2022-04 (cell 25).
+        span_set = np.asarray(panel.factors)[-p.shape[0]:]
+        start, end = "2010-05-31", "2022-04-30"
+        for flag, path in (("--ff3", args.ff3), ("--ff5", args.ff5)):
+            if not os.path.exists(path):
+                print(f"warning: {flag} file {path} not found — "
+                      "FF alpha columns will be omitted", file=sys.stderr)
+        for name, returns in (("replication", p), ("benchmark", actual)):
+            table = report.stats_table(
+                returns, panel.hf_names, rf=rf_aligned,
+                ff3_path=args.ff3, ff5_path=args.ff5, span=span_set,
+                start=start, end=end)
+            path = os.path.join(args.out, f"stats_{name}.csv")
+            table.to_csv(path)
+            print(f"stats: {path}")
     return 0
 
 

@@ -64,11 +64,12 @@ class KerasLSTM(nn.Module):
     ``backend`` selects the recurrence implementation:
 
     * ``"xla"`` (default) — time-major `lax.scan`; arbitrarily
-      differentiable, required under the WGAN-GP gradient penalty's
-      second-order path.
+      differentiable.
     * ``"pallas"`` — fused TPU kernel (:mod:`hfrep_tpu.ops.pallas_lstm`),
-      ~10× faster per traversal, first-order differentiable only
-      (`jax.custom_vjp`); interpreted (slow) off-TPU.
+      ~10× faster per traversal; twice-differentiable via nested
+      custom_vjps (second-order residue runs a scan twin), so it also
+      serves the WGAN-GP gradient-penalty path; interpreted (slow)
+      off-TPU.
 
     The call-time ``backend=`` kwarg overrides the module field so one
     set of params can be applied through either path per call site.

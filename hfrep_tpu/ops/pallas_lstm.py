@@ -19,11 +19,15 @@ weights are zero); outputs are sliced back to H.
 Differentiation: :func:`lstm_seq` carries a ``jax.custom_vjp`` whose
 backward is itself a Pallas kernel (reverse-time grid, gate recompute
 from saved h/c — one extra matmul per step instead of storing (W, B, 4H)
-pre-activations).  ``custom_vjp`` functions are not twice-differentiable,
-so callers that need higher-order AD — the WGAN-GP gradient penalty's
-∂/∂θ ∇_x c path — must use the XLA scan backend
-(:class:`hfrep_tpu.ops.lstm.KerasLSTM` with ``backend='xla'``); JAX
-raises loudly if this rule is violated.
+pre-activations).  A single ``custom_vjp`` is not twice-differentiable,
+so second-order AD — the WGAN-GP gradient penalty's ∂/∂θ ∇_x c path —
+is supported through *nesting*: the VJP rule's residual-producing
+forward (:func:`lstm_fwd_res`) and the backward itself
+(:func:`lstm_bwd_seq`) are each their own differentiable-once
+primitives; ``lstm_bwd_seq``'s VJP falls back to JAX AD over a
+pure-JAX scan twin (:func:`_lstm_bwd_scan`).  Each custom_vjp is
+differentiated at most once, so grad-of-grad through the pallas backend
+is legal and matches the XLA double backward (tests).
 """
 
 from __future__ import annotations
@@ -140,8 +144,10 @@ def _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True):
 
 # -------------------------------------------------------------- backward
 
-def _bwd_kernel(act_name, xz_ref, rec_ref, rec_t_ref, h_prev_ref, c_prev_ref,
-                cs_ref, dhs_ref, dxz_ref, drec_ref, dh_scr, dc_scr):
+def _bwd_kernel(act_name, with_dcs, xz_ref, rec_ref, rec_t_ref, h_prev_ref,
+                c_prev_ref, cs_ref, dhs_ref, *rest):
+    dcs_ref = rest[0] if with_dcs else None
+    dxz_ref, drec_ref, dh_scr, dc_scr = rest[-4:]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -170,6 +176,8 @@ def _bwd_kernel(act_name, xz_ref, rec_ref, rec_t_ref, h_prev_ref, c_prev_ref,
     do = dh * a_c
     dzo = do * o * (1.0 - o)
     dc = dc_scr[:] + dh * o * _act_prime_from_value(act_name, a_c)
+    if with_dcs:                    # cotangent flowing into cs directly
+        dc = dc + dcs_ref[0]
     dzi = dc * gcell * i * (1.0 - i)
     dzf = dc * c_prev * f * (1.0 - f)
     dzc = dc * i * _act_prime_from_value(act_name, gcell)
@@ -183,29 +191,32 @@ def _bwd_kernel(act_name, xz_ref, rec_ref, rec_t_ref, h_prev_ref, c_prev_ref,
                                    preferred_element_type=jnp.float32)
 
 
-def _lstm_seq_fwd(xz, rec, activation):
-    hs, cs = _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True)
-    return hs, (xz, rec, hs, cs)
+def _shifted(hs, cs):
+    zero = jnp.zeros_like(hs[:1])
+    return (jnp.concatenate([zero, hs[:-1]], axis=0),
+            jnp.concatenate([zero, cs[:-1]], axis=0))
 
 
-def _lstm_seq_bwd(activation, residuals, dhs):
-    xz, rec, hs, cs = residuals
+def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation):
+    """Reverse-time pallas sweep: (dxz, drec) from output cotangents.
+
+    ``dcs`` (optional) is a direct cotangent on the cell-state sequence —
+    nonzero only when ``cs`` escapes as a residual (second-order paths).
+    """
     w, b, g = xz.shape
     hp = g // 4
-    zero = jnp.zeros((1, b, hp), jnp.float32)
-    h_prev = jnp.concatenate([zero, hs[:-1]], axis=0)
-    c_prev = jnp.concatenate([zero, cs[:-1]], axis=0)
+    h_prev, c_prev = _shifted(hs, cs)
     rev = lambda t: (w - 1 - t, 0, 0)
+    t_in = pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM)
+    with_dcs = dcs is not None
+    operands = [xz, rec, rec.T, h_prev, c_prev, cs, dhs] + ([dcs] if with_dcs else [])
     dxz, drec = pl.pallas_call(
-        functools.partial(_bwd_kernel, activation),
+        functools.partial(_bwd_kernel, activation, with_dcs),
         grid=(w,),
         in_specs=[pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                   pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM),
-                  pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM),
-                  pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM),
-                  pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM),
-                  pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM),
-                  pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM)],
+                  pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)]
+                 + [t_in] * (4 + int(with_dcs)),
         out_specs=[pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                    pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)],
         out_shape=[jax.ShapeDtypeStruct((w, b, g), jnp.float32),
@@ -213,8 +224,109 @@ def _lstm_seq_bwd(activation, residuals, dhs):
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
                         pltpu.VMEM((b, hp), jnp.float32)],
         interpret=_interpret(),
-    )(xz, rec, rec.T, h_prev, c_prev, cs, dhs)
+    )(*operands)
     return dxz, drec
+
+
+def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation):
+    """Pure-JAX twin of :func:`_bwd_call` (same arithmetic, `lax.scan`).
+
+    This is the second-order fallback: :func:`lstm_bwd_seq`'s own VJP is
+    derived by JAX AD over this implementation, so hand-written kernels
+    never need their derivatives hand-derived.
+    """
+    act = _ACT[activation]
+    h_prev, c_prev = _shifted(hs, cs)
+    b, hp = hs.shape[1], hs.shape[2]
+    g = xz.shape[2]
+    if dcs is None:
+        dcs = jnp.zeros_like(cs)
+
+    def step(carry, inp):
+        dh_c, dc_c, drec = carry
+        xz_s, hp_s, cp_s, c_s, dhs_s, dcs_s = inp
+        z = xz_s + hp_s @ rec
+        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf)
+        gcell = act(zc)
+        o = jax.nn.sigmoid(zo)
+        a_c = act(c_s)
+        dh = dhs_s + dh_c
+        do = dh * a_c
+        dzo = do * o * (1.0 - o)
+        dc = dc_c + dh * o * _act_prime_from_value(activation, a_c) + dcs_s
+        dzi = dc * gcell * i * (1.0 - i)
+        dzf = dc * cp_s * f * (1.0 - f)
+        dzc = dc * i * _act_prime_from_value(activation, gcell)
+        dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
+        drec = drec + lax.dot_general(hp_s, dz, (((0,), (0,)), ((), ())))
+        return (dz @ rec.T, dc * f, drec), dz
+
+    init = (jnp.zeros((b, hp), xz.dtype), jnp.zeros((b, hp), xz.dtype),
+            jnp.zeros((hp, g), xz.dtype))
+    (_, _, drec), dz_rev = lax.scan(
+        step, init,
+        (xz[::-1], h_prev[::-1], c_prev[::-1], cs[::-1], dhs[::-1], dcs[::-1]))
+    return dz_rev[::-1], drec
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def lstm_bwd_seq(xz, rec, hs, cs, dhs, activation):
+    """First-order LSTM backward as a differentiable-once primitive:
+    pallas primal, JAX-scan-derived VJP (the genuine second-order math,
+    needed by the WGAN-GP gradient penalty's ∂/∂θ ∇_x c path)."""
+    return _bwd_call(xz, rec, hs, cs, dhs, None, activation)
+
+
+def _lstm_bwd_seq_fwd(xz, rec, hs, cs, dhs, activation):
+    return _bwd_call(xz, rec, hs, cs, dhs, None, activation), (xz, rec, hs, cs, dhs)
+
+
+def _lstm_bwd_seq_bwd(activation, residuals, cotangents):
+    xz, rec, hs, cs, dhs = residuals
+    _, vjp = jax.vjp(
+        lambda a, r, h, c, d: _lstm_bwd_scan(a, r, h, c, d, None, activation),
+        xz, rec, hs, cs, dhs)
+    return vjp(cotangents)
+
+
+lstm_bwd_seq.defvjp(_lstm_bwd_seq_fwd, _lstm_bwd_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def lstm_fwd_res(xz, rec, activation):
+    """Forward producing (hs, cs) with a pallas VJP (dcs-extended backward
+    kernel).  Used as the residual-producing forward inside
+    :func:`lstm_seq`'s VJP so that second-order traces never hit a raw,
+    non-differentiable ``pallas_call``."""
+    return _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True)
+
+
+def _lstm_fwd_res_fwd(xz, rec, activation):
+    hs, cs = _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True)
+    return (hs, cs), (xz, rec, hs, cs)
+
+
+def _lstm_fwd_res_bwd(activation, residuals, cotangents):
+    xz, rec, hs, cs = residuals
+    dhs, dcs = cotangents
+    return _bwd_call(xz, rec, hs, cs, dhs, dcs, activation)
+
+
+lstm_fwd_res.defvjp(_lstm_fwd_res_fwd, _lstm_fwd_res_bwd)
+
+
+def _lstm_seq_fwd(xz, rec, activation):
+    # Residuals come from the differentiable lstm_fwd_res, not a raw
+    # pallas_call, so an outer grad over this VJP's trace stays legal.
+    hs, cs = lstm_fwd_res(xz, rec, activation)
+    return hs, (xz, rec, hs, cs)
+
+
+def _lstm_seq_bwd(activation, residuals, dhs):
+    xz, rec, hs, cs = residuals
+    return lstm_bwd_seq(xz, rec, hs, cs, dhs, activation)
 
 
 lstm_seq.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
