@@ -41,12 +41,21 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--eval", action="store_true", help="run the 12-metric suite after training")
     t.add_argument("--mesh", action="store_true", help="data-parallel over all devices")
     t.add_argument("--quiet", action="store_true")
+    t.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint in --checkpoint-dir "
+                        "before training (elastic recovery, SURVEY §5.3)")
+    t.add_argument("--export-h5", default=None,
+                   help="after training, write the generator as a reference-"
+                        "compatible Keras .h5 (loads in the notebook's cell 42)")
 
     e = sub.add_parser("eval-gan", help="score a saved sample cube")
     e.add_argument("--samples", required=True, help=".npy cube, inverse-scaled returns")
     e.add_argument("--preset", default="mtss_wgan_gp")
     e.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
     e.add_argument("--out", default=None, help="write metrics JSON here")
+    e.add_argument("--eyeball", default=None,
+                   help="write the ECDF 'eyeball' grid plot here "
+                        "(GAN_eval.py:407-445)")
 
     s = sub.add_parser("sweep", help="latent-dim sweep (cells 5-33 / 51-69)")
     s.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
@@ -126,7 +135,18 @@ def cmd_train_gan(args) -> int:
 
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh, args.quiet)
-    trainer.train(epochs=args.epochs)
+    target = args.epochs if args.epochs is not None else cfg.train.epochs
+    if args.resume:
+        from hfrep_tpu.utils.checkpoint import latest
+        path = latest(args.checkpoint_dir) if args.checkpoint_dir else None
+        if path:
+            trainer.restore_checkpoint(path)
+            print(f"resumed from {path} (epoch {trainer.epoch})")
+            # recovery completes the original schedule, not epochs on top
+            target = max(0, target - trainer.epoch)
+        else:
+            print("no checkpoint to resume from; training from scratch")
+    trainer.train(epochs=target)
     print(f"trained {cfg.model.family} for {trainer.epoch} epochs "
           f"({trainer.steps_per_sec:.2f} steps/s)")
     if args.checkpoint_dir:
@@ -138,6 +158,11 @@ def cmd_train_gan(args) -> int:
         print(f"samples: {args.samples_out} {tuple(cube.shape)}")
     if args.eval:
         _eval_trainer_samples(trainer, ds, out=None)
+    if args.export_h5:
+        from hfrep_tpu.utils.keras_export import export_keras_generator
+        path = export_keras_generator(cfg.model, trainer.state.g_params,
+                                      args.export_h5)
+        print(f"keras artifact: {path}")
     return 0
 
 
@@ -168,6 +193,13 @@ def cmd_eval_gan(args) -> int:
     panel = load_panel(args.cleaned_dir)
     ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
     cube = np.load(args.samples)
+    if cube.ndim != 3 or cube.shape[1:] != ds.windows.shape[1:]:
+        print(f"sample cube has shape {cube.shape} but preset "
+              f"{args.preset!r} builds (N, {ds.windows.shape[1]}, "
+              f"{ds.windows.shape[2]}) windows; pass the matching --preset "
+              "((168, 36) production cubes need mtss_wgan_gp_prod)",
+              file=sys.stderr)
+        return 2
     # samples are stored inverse-scaled; move them back into scaler space
     flat = mm.transform(ds.scaler, cube.reshape(-1, cube.shape[2]))
     fake = np.asarray(flat).reshape(cube.shape)
@@ -179,6 +211,9 @@ def cmd_eval_gan(args) -> int:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(res, f, indent=2)
+    if args.eyeball:
+        suite.eyeball(args.eyeball)
+        print(f"eyeball plot: {args.eyeball}")
     return 0
 
 

@@ -1,0 +1,112 @@
+"""Export trained Flax generators as reference-compatible Keras ``.h5``.
+
+The reference ecosystem consumes generator artifacts via Keras
+``load_model`` (``autoencoder_v4.ipynb`` cell 42, saved at
+``GAN/MTSS_WGAN_GP.py:285-287``).  This is the outbound half of the
+artifact interop (:mod:`hfrep_tpu.utils.keras_import` is the inbound
+half): a generator trained here can be dropped into the reference's own
+notebook flow.  Requires tensorflow (present in this image; gated
+import so the core package never depends on it).
+
+Layer order mirrors :mod:`hfrep_tpu.models.generators` exactly:
+
+* LSTM family: ``LSTM(H, act=sigmoid) → LayerNorm → LSTM(H, act=sigmoid)
+  → LeakyReLU(0.2) → LayerNorm → Dense(F)``
+* Dense family: ``Dense(H, sigmoid) → LeakyReLU → LayerNorm ×2 → Dense(F)``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.models.generators import LSTMGenerator
+from hfrep_tpu.models.registry import FAMILIES
+
+
+def _tf():
+    try:
+        import tensorflow as tf
+        return tf
+    except ImportError as e:  # pragma: no cover - image ships TF
+        raise ImportError(
+            "keras_export requires tensorflow for writing .h5 artifacts; "
+            "use checkpoints (hfrep_tpu.utils.checkpoint) when TF is "
+            "unavailable") from e
+
+
+def _np(tree: Any) -> Any:
+    return np.asarray(tree)
+
+
+def export_keras_generator(mcfg: ModelConfig, params: Dict[str, Any],
+                           path: str) -> str:
+    """Write generator ``params`` (a Flax tree from
+    :func:`hfrep_tpu.models.registry.build_gan`) as a Keras ``.h5``.
+
+    Returns ``path``.  Round-trips through
+    :func:`hfrep_tpu.utils.keras_import.load_keras_generator` (tested).
+    """
+    tf = _tf()
+    h, w, f = mcfg.hidden, mcfg.window, mcfg.features
+    slope = mcfg.leaky_slope
+    if mcfg.family not in FAMILIES:
+        raise ValueError(f"unknown generator family {mcfg.family!r}; "
+                         f"available: {sorted(FAMILIES)}")
+    body = FAMILIES[mcfg.family][0]
+
+    if body is LSTMGenerator:
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((w, f)),
+            tf.keras.layers.LSTM(h, activation="sigmoid",
+                                 recurrent_activation="sigmoid",
+                                 return_sequences=True),
+            tf.keras.layers.LayerNormalization(epsilon=1e-3),
+            tf.keras.layers.LSTM(h, activation="sigmoid",
+                                 recurrent_activation="sigmoid",
+                                 return_sequences=True),
+            tf.keras.layers.LeakyReLU(negative_slope=slope),
+            tf.keras.layers.LayerNormalization(epsilon=1e-3),
+            tf.keras.layers.Dense(f),
+        ])
+        weighted = [
+            (model.layers[0], [params["KerasLSTM_0"][k] for k in
+                               ("kernel", "recurrent_kernel", "bias")]),
+            (model.layers[1], [params["KerasLayerNorm_0"]["LayerNorm_0"]["scale"],
+                               params["KerasLayerNorm_0"]["LayerNorm_0"]["bias"]]),
+            (model.layers[2], [params["KerasLSTM_1"][k] for k in
+                               ("kernel", "recurrent_kernel", "bias")]),
+            (model.layers[4], [params["KerasLayerNorm_1"]["LayerNorm_0"]["scale"],
+                               params["KerasLayerNorm_1"]["LayerNorm_0"]["bias"]]),
+            (model.layers[5], [params["KerasDense_0"]["Dense_0"]["kernel"],
+                               params["KerasDense_0"]["Dense_0"]["bias"]]),
+        ]
+    else:
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((w, f)),
+            tf.keras.layers.Dense(h, activation="sigmoid"),
+            tf.keras.layers.LeakyReLU(negative_slope=slope),
+            tf.keras.layers.LayerNormalization(epsilon=1e-3),
+            tf.keras.layers.Dense(h, activation="sigmoid"),
+            tf.keras.layers.LeakyReLU(negative_slope=slope),
+            tf.keras.layers.LayerNormalization(epsilon=1e-3),
+            tf.keras.layers.Dense(f),
+        ])
+        weighted = [
+            (model.layers[0], [params["KerasDense_0"]["Dense_0"]["kernel"],
+                               params["KerasDense_0"]["Dense_0"]["bias"]]),
+            (model.layers[2], [params["KerasLayerNorm_0"]["LayerNorm_0"]["scale"],
+                               params["KerasLayerNorm_0"]["LayerNorm_0"]["bias"]]),
+            (model.layers[3], [params["KerasDense_1"]["Dense_0"]["kernel"],
+                               params["KerasDense_1"]["Dense_0"]["bias"]]),
+            (model.layers[5], [params["KerasLayerNorm_1"]["LayerNorm_0"]["scale"],
+                               params["KerasLayerNorm_1"]["LayerNorm_0"]["bias"]]),
+            (model.layers[6], [params["KerasDense_2"]["Dense_0"]["kernel"],
+                               params["KerasDense_2"]["Dense_0"]["bias"]]),
+        ]
+    for layer, ws in weighted:
+        layer.set_weights([_np(v) for v in ws])
+    model.save(path)
+    return path

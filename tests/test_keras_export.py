@@ -1,0 +1,61 @@
+"""Outbound Keras .h5 export (``hfrep_tpu.utils.keras_export``) and its
+round-trip through the importer — the two halves of artifact interop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.models.registry import build_gan
+
+
+def _has_tf():
+    try:
+        import tensorflow  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+needs_tf = pytest.mark.skipif(not _has_tf(), reason="tensorflow unavailable")
+
+
+@needs_tf
+@pytest.mark.parametrize("family", ["mtss_wgan_gp", "gan"])
+def test_export_roundtrip(family, tmp_path):
+    from hfrep_tpu.utils.keras_export import export_keras_generator
+    from hfrep_tpu.utils.keras_import import load_keras_generator
+
+    mcfg = ModelConfig(family=family, hidden=12, window=6, features=5)
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(0)
+    z = jax.random.normal(key, (3, mcfg.window, mcfg.features))
+    params = pair.generator.init(key, z)["params"]
+    expected = np.asarray(pair.generator.apply({"params": params}, z))
+
+    path = export_keras_generator(mcfg, params, str(tmp_path / "gen.h5"))
+    module, imported, shape = load_keras_generator(path)
+    assert shape == (mcfg.window, mcfg.features)
+    got = np.asarray(module.apply({"params": imported}, z))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+@needs_tf
+def test_exported_artifact_loads_in_keras(tmp_path):
+    """The artifact must load through Keras itself — that is what the
+    reference notebook does with it (cell 42)."""
+    import tensorflow as tf
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=8, window=5, features=4)
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(1)
+    z = jax.random.normal(key, (2, 5, 4))
+    params = pair.generator.init(key, z)["params"]
+    expected = np.asarray(pair.generator.apply({"params": params}, z))
+
+    from hfrep_tpu.utils.keras_export import export_keras_generator
+    path = export_keras_generator(mcfg, params, str(tmp_path / "gen.h5"))
+    model = tf.keras.models.load_model(path, compile=False)
+    got = model.predict(np.asarray(z), verbose=0)
+    np.testing.assert_allclose(got, expected, atol=1e-4)
