@@ -57,7 +57,9 @@ class TrainConfig:
     log_every: int = 50
     checkpoint_every: int = 1000   # reference saves only at end (GAN/MTSS_WGAN_GP.py:285-287)
     checkpoint_dir: Optional[str] = None
-    steps_per_call: int = 25       # host↔device round-trips amortized via lax.scan
+    steps_per_call: int = 50       # host↔device round-trips amortized via lax.scan
+                                   # (50 measures ~7% faster than 25 through the
+                                   # tunnel's ~4ms dispatch latency)
     lstm_backend: str = "auto"     # auto|pallas|xla — see ops/pallas_lstm.py
 
 

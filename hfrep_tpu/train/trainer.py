@@ -52,6 +52,8 @@ class GanTrainer:
         self.history: list[dict] = []
         self._single_step = None
         self._generate_fn = None
+        self._multi_warm = False    # first block per program carries compile
+        self._one_warm = False
         # Failure detection (SURVEY §5.2-5.3: absent in the reference — a
         # diverged 5000-epoch run loses everything).  When enabled, a
         # block producing non-finite metrics is rolled back in memory (the
@@ -73,7 +75,9 @@ class GanTrainer:
             metrics = self._guarded(self._multi, sub)
             if metrics is None:
                 continue                    # guard tripped: block retried
-            self.timer.stop(tcfg.steps_per_call, sync_on=self.state.g_params)
+            self.timer.stop(tcfg.steps_per_call, sync_on=self.state.g_params,
+                            warmup=not self._multi_warm)
+            self._multi_warm = True
             self._log_block(metrics, tcfg.steps_per_call)
             self.epoch += tcfg.steps_per_call
             done += 1
@@ -87,7 +91,9 @@ class GanTrainer:
             metrics = self._guarded(self._one, sub)
             if metrics is None:
                 continue
-            self.timer.stop(1, sync_on=self.state.g_params)
+            self.timer.stop(1, sync_on=self.state.g_params,
+                            warmup=not self._one_warm)
+            self._one_warm = True
             self._log_block(jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics), 1)
             self.epoch += 1
             done += 1
@@ -161,7 +167,8 @@ class GanTrainer:
         return path
 
     def restore_checkpoint(self, path: Optional[str] = None) -> None:
-        path = path or ckpt.latest(self.cfg.train.checkpoint_dir)
+        ckpt_dir = self.cfg.train.checkpoint_dir
+        path = path or (ckpt.latest(ckpt_dir) if ckpt_dir else None)
         if path is None:
             raise FileNotFoundError("no checkpoint found")
         restored = ckpt.restore(path, target=self._ckpt_tree())

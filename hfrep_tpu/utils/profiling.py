@@ -19,23 +19,29 @@ class StepTimer:
     array before stopping the clock so XLA's async dispatch doesn't lie."""
 
     def __init__(self) -> None:
-        self.samples: List[tuple[int, float]] = []
+        self.samples: List[tuple[int, float, bool]] = []
         self._t0: Optional[float] = None
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self, n_steps: int, sync_on=None) -> float:
+    def stop(self, n_steps: int, sync_on=None, warmup: bool = False) -> float:
+        """``warmup=True`` marks a sample that carries XLA compile time
+        (~30-40s for the GAN steps); such samples are excluded from
+        :attr:`steps_per_sec` whenever steady-state samples exist."""
         if sync_on is not None:
             jax.block_until_ready(sync_on)
         dt = time.perf_counter() - self._t0
-        self.samples.append((n_steps, dt))
+        self.samples.append((n_steps, dt, warmup))
         return dt
 
     @property
     def steps_per_sec(self) -> float:
-        steps = sum(n for n, _ in self.samples)
-        secs = sum(t for _, t in self.samples)
+        """Steady-state rate (warmup samples excluded when possible)."""
+        steady = [(n, t) for n, t, w in self.samples if not w]
+        samples = steady or [(n, t) for n, t, _ in self.samples]
+        steps = sum(n for n, _ in samples)
+        secs = sum(t for _, t in samples)
         return steps / secs if secs else float("nan")
 
     def reset(self) -> None:
