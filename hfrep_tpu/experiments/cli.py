@@ -137,13 +137,17 @@ def cmd_train_gan(args) -> int:
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh, args.quiet)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
-        try:
-            trainer.restore_checkpoint()        # latest in --checkpoint-dir
-            print(f"resumed at epoch {trainer.epoch}")
+        from hfrep_tpu.utils.checkpoint import latest
+        path = latest(args.checkpoint_dir) if args.checkpoint_dir else None
+        if path is None:
+            print("no checkpoint to resume from; training from scratch")
+        else:
+            # restore failures (e.g. a partial checkpoint) must propagate,
+            # not silently retrain from scratch
+            trainer.restore_checkpoint(path)
+            print(f"resumed from {path} (epoch {trainer.epoch})")
             # recovery completes the original schedule, not epochs on top
             target = max(0, target - trainer.epoch)
-        except FileNotFoundError:
-            print("no checkpoint to resume from; training from scratch")
     trainer.train(epochs=target)
     rate = (f" ({trainer.steps_per_sec:.2f} steps/s)"
             if trainer.timer.samples else " (schedule already complete)")

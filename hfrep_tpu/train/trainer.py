@@ -66,23 +66,62 @@ class GanTrainer:
     # ------------------------------------------------------------ training
     def train(self, epochs: Optional[int] = None) -> GanState:
         tcfg = self.cfg.train
+        spc = tcfg.steps_per_call
         epochs = epochs if epochs is not None else tcfg.epochs
-        n_full, remainder = divmod(epochs, tcfg.steps_per_call)
+        n_full, remainder = divmod(epochs, spc)
         done = 0
+        # Steady-state blocks are pipelined: block i's host-side logging
+        # (device_get + history/JSONL) runs while block i+1 executes on
+        # device, so the chip never idles on the logger.  The NaN guard
+        # inspects metrics synchronously, so guard mode keeps the
+        # one-block-at-a-time path.  The open steady timing window spans
+        # whole pipelined stretches and is closed (synced and recorded)
+        # before anything that is not training — checkpoints in
+        # particular — so steps_per_sec reflects device throughput only.
+        pending = None                      # (metrics, base_epoch)
+        steady_steps = 0                    # steps in the open window; 0 = closed
+
+        def flush_pending():
+            nonlocal pending
+            if pending is not None:
+                self._log_block(pending[0], spc, pending[1])
+                pending = None
+
+        def close_steady():
+            nonlocal steady_steps
+            if steady_steps:
+                self.timer.stop(steady_steps, sync_on=self.state.g_params)
+                steady_steps = 0
+
         while done < n_full:
             self.key, sub = jax.random.split(self.key)
-            self.timer.start()
-            metrics = self._guarded(self._multi, sub)
-            if metrics is None:
-                continue                    # guard tripped: block retried
-            self.timer.stop(tcfg.steps_per_call, sync_on=self.state.g_params,
-                            warmup=not self._multi_warm)
-            self._multi_warm = True
-            self._log_block(metrics, tcfg.steps_per_call)
-            self.epoch += tcfg.steps_per_call
+            warm_block = not self._multi_warm
+            if warm_block or self.nan_guard:
+                close_steady()
+                self.timer.start()
+                metrics = self._guarded(self._multi, sub)
+                if metrics is None:
+                    continue                # guard tripped: block retried
+                self.timer.stop(spc, sync_on=self.state.g_params,
+                                warmup=warm_block)
+                self._multi_warm = True
+                flush_pending()
+                self._log_block(metrics, spc, self.epoch)
+            else:
+                if steady_steps == 0:
+                    self.timer.start()
+                metrics = self._guarded(self._multi, sub)   # async dispatch
+                flush_pending()             # overlaps with device compute
+                pending = (metrics, self.epoch)
+                steady_steps += spc
+            self.epoch += spc
             done += 1
-            if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < tcfg.steps_per_call:
+            if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < spc:
+                flush_pending()
+                close_steady()
                 self.save_checkpoint()
+        close_steady()
+        flush_pending()
         done = 0
         while done < remainder:
             # exact epoch counts: leftover epochs run on a cached 1-epoch step
@@ -94,7 +133,9 @@ class GanTrainer:
             self.timer.stop(1, sync_on=self.state.g_params,
                             warmup=not self._one_warm)
             self._one_warm = True
-            self._log_block(jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics), 1)
+            self._log_block(
+                jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], metrics),
+                1, self.epoch)
             self.epoch += 1
             done += 1
             if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every == 0:
@@ -138,10 +179,10 @@ class GanTrainer:
             self._single_step = jax.jit(make_train_step(self.pair, self.cfg.train, self.windows))
         return self._single_step(state, key)
 
-    def _log_block(self, metrics: dict, n: int) -> None:
+    def _log_block(self, metrics: dict, n: int, base_epoch: int) -> None:
         host = jax.device_get(metrics)
         for i in range(n):
-            e = self.epoch + i
+            e = base_epoch + i
             rec = {k: v[i] for k, v in host.items()}
             self.history.append({"epoch": e, **{k: float(v) for k, v in rec.items()}})
             if e % self.cfg.train.log_every == 0:
