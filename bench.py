@@ -63,7 +63,7 @@ def main() -> None:
     state, metrics = multi(state, jax.random.fold_in(key, 0))
     jax.block_until_ready(metrics)
 
-    n_calls = 6  # 6 × 50 = 300 timed epochs
+    n_calls = 20  # 20 × 50 = 1000 timed epochs
     t0 = time.perf_counter()
     for i in range(1, n_calls + 1):
         state, metrics = multi(state, jax.random.fold_in(key, i))

@@ -117,8 +117,8 @@ class GanTrainer:
             self.epoch += spc
             done += 1
             if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < spc:
+                close_steady()      # sync first: keep host logging out of the window
                 flush_pending()
-                close_steady()
                 self.save_checkpoint()
         close_steady()
         flush_pending()
