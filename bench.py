@@ -3,7 +3,7 @@
 One "step" = one reference epoch (``GAN/MTSS_WGAN_GP.py:260-284``):
 n_critic=5 RMSprop critic updates with exact gradient penalty + 1
 generator update, batch 32, (48, 35) scaled windows, LSTM100×2 G and
-critic.  Here the whole epoch is one jitted XLA program and 25 epochs are
+critic.  Here the whole epoch is one jitted XLA program and 50 epochs are
 scanned per host dispatch (:func:`hfrep_tpu.train.steps.make_multi_step`).
 
 ``vs_baseline`` compares against the reference's own execution model —
