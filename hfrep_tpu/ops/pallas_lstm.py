@@ -24,10 +24,13 @@ so second-order AD — the WGAN-GP gradient penalty's ∂/∂θ ∇_x c path —
 is supported through *nesting*: the VJP rule's residual-producing
 forward (:func:`lstm_fwd_res`) and the backward itself
 (:func:`lstm_bwd_seq`) are each their own differentiable-once
-primitives; ``lstm_bwd_seq``'s VJP falls back to JAX AD over a
-pure-JAX scan twin (:func:`_lstm_bwd_scan`).  Each custom_vjp is
-differentiated at most once, so grad-of-grad through the pallas backend
-is legal and matches the XLA double backward (tests).
+primitives; ``lstm_bwd_seq``'s VJP is a hand-derived pallas *adjoint*
+kernel (:func:`_adj_kernel` — forward-time sweep over the backward's
+dataflow, recomputing gates and the primal cotangents from saved
+per-step carries).  Each custom_vjp is differentiated at most once, so
+grad-of-grad through the pallas backend is legal; the adjoint formulas
+are oracle-tested against JAX AD over the pure-JAX scan twin
+(:func:`_lstm_bwd_scan`) and against the XLA double backward (tests).
 """
 
 from __future__ import annotations
@@ -144,10 +147,15 @@ def _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True):
 
 # -------------------------------------------------------------- backward
 
-def _bwd_kernel(act_name, with_dcs, xz_ref, rec_ref, rec_t_ref, h_prev_ref,
-                c_prev_ref, cs_ref, dhs_ref, *rest):
+def _bwd_kernel(act_name, with_dcs, with_carries, xz_ref, rec_ref, rec_t_ref,
+                h_prev_ref, c_prev_ref, cs_ref, dhs_ref, *rest):
+    # rest = [dcs?] + [dxz, drec] + [dhT, dcT]? + [dh_scr, dc_scr]
+    k = 1 if with_dcs else 0
     dcs_ref = rest[0] if with_dcs else None
-    dxz_ref, drec_ref, dh_scr, dc_scr = rest[-4:]
+    dxz_ref, drec_ref = rest[k], rest[k + 1]
+    if with_carries:   # second-order residuals: per-step dhT/dcT
+        dhT_ref, dcT_ref = rest[k + 2], rest[k + 3]
+    dh_scr, dc_scr = rest[-2], rest[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -184,6 +192,9 @@ def _bwd_kernel(act_name, with_dcs, xz_ref, rec_ref, rec_t_ref, h_prev_ref,
     dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
 
     dxz_ref[0] = dz
+    if with_carries:
+        dhT_ref[0] = dh
+        dcT_ref[0] = dc
     dh_scr[:] = jnp.dot(dz, rec_t_ref[:], preferred_element_type=jnp.float32)
     dc_scr[:] = dc * f
     # (Hp, B) @ (B, 4Hp) accumulated across the reverse sweep.
@@ -197,11 +208,13 @@ def _shifted(hs, cs):
             jnp.concatenate([zero, cs[:-1]], axis=0))
 
 
-def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation):
+def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation, with_carries=False):
     """Reverse-time pallas sweep: (dxz, drec) from output cotangents.
 
     ``dcs`` (optional) is a direct cotangent on the cell-state sequence —
     nonzero only when ``cs`` escapes as a residual (second-order paths).
+    ``with_carries`` additionally returns the per-step (dhT, dcT) carries,
+    the residuals the adjoint kernel (:func:`_adj_call`) needs.
     """
     w, b, g = xz.shape
     hp = g // 4
@@ -210,22 +223,163 @@ def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation):
     t_in = pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM)
     with_dcs = dcs is not None
     operands = [xz, rec, rec.T, h_prev, c_prev, cs, dhs] + ([dcs] if with_dcs else [])
-    dxz, drec = pl.pallas_call(
-        functools.partial(_bwd_kernel, activation, with_dcs),
+    out_specs = [pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
+                 pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((w, b, g), jnp.float32),
+                 jax.ShapeDtypeStruct((hp, g), jnp.float32)]
+    if with_carries:
+        out_specs += [t_in, t_in]
+        out_shape += [jax.ShapeDtypeStruct((w, b, hp), jnp.float32)] * 2
+    out = pl.pallas_call(
+        functools.partial(_bwd_kernel, activation, with_dcs, with_carries),
         grid=(w,),
         in_specs=[pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                   pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM),
                   pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)]
                  + [t_in] * (4 + int(with_dcs)),
-        out_specs=[pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
-                   pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)],
-        out_shape=[jax.ShapeDtypeStruct((w, b, g), jnp.float32),
-                   jax.ShapeDtypeStruct((hp, g), jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
                         pltpu.VMEM((b, hp), jnp.float32)],
         interpret=_interpret(),
     )(*operands)
-    return dxz, drec
+    return tuple(out)
+
+
+def _act_prime_prime_from_value(name, v):
+    """d(act-prime)/d(value): sigmoid p(v)=v(1−v) → 1−2v; tanh → −2v."""
+    if name == "sigmoid":
+        return 1.0 - 2.0 * v
+    if name == "tanh":
+        return -2.0 * v
+    return jnp.zeros_like(v)
+
+
+def _adj_kernel(act_name, xz_ref, rec_ref, rec_t_ref, v_ref, v_t_ref,
+                h_prev_ref, c_prev_ref, cs_ref, u_ref,
+                dhT_ref, dcT_ref,
+                uxz_ref, uhp_ref, ucp_ref, uc_ref, udhs_ref, urec_ref,
+                muh_scr, muc_scr):
+    """Adjoint of one backward step (hand-derived, oracle-validated
+    against ``jax.vjp`` over :func:`_lstm_bwd_scan`).  Runs forward-time
+    t = 0..W-1 — the reverse of the primal backward's execution order —
+    with the adjoint carries (μh, μc) = cotangents of the primal step's
+    (dh′, dc′) carry outputs in VMEM scratch."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        muh_scr[:] = jnp.zeros_like(muh_scr)
+        muc_scr[:] = jnp.zeros_like(muc_scr)
+        urec_ref[:] = jnp.zeros_like(urec_ref)
+
+    act = _ACT[act_name]
+    p = lambda v: _act_prime_from_value(act_name, v)
+    pp = lambda v: _act_prime_prime_from_value(act_name, v)
+    hp_s = h_prev_ref[0]
+    cp_s = c_prev_ref[0]
+    c_s = cs_ref[0]
+    dhT = dhT_ref[0]
+    dcT = dcT_ref[0]
+    muh = muh_scr[:]
+    muc = muc_scr[:]
+    rec = rec_ref[:]
+    v_mat = v_ref[:]
+
+    # ---- recompute the primal backward step-s intermediates
+    z = xz_ref[0] + jnp.dot(hp_s, rec, preferred_element_type=jnp.float32)
+    hp_dim = z.shape[-1] // 4
+    zi, zf, zc, zo = (z[:, :hp_dim], z[:, hp_dim:2 * hp_dim],
+                      z[:, 2 * hp_dim:3 * hp_dim], z[:, 3 * hp_dim:])
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    gcell = act(zc)
+    o = jax.nn.sigmoid(zo)
+    a_c = act(c_s)
+    qi, qf, qo = i * (1.0 - i), f * (1.0 - f), o * (1.0 - o)
+    do = dhT * a_c
+    dzi = dcT * gcell * qi
+    dzf = dcT * cp_s * qf
+    dzc = dcT * i * p(gcell)
+    dzo = do * qo
+    dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
+
+    # ---- adjoint
+    dzbar = (u_ref[0]
+             + jnp.dot(muh, rec, preferred_element_type=jnp.float32)
+             + jnp.dot(hp_s, v_mat, preferred_element_type=jnp.float32))
+    dcTbar = muc * f
+    fbar = muc * dcT
+    hpbar = jnp.dot(dz, v_t_ref[:], preferred_element_type=jnp.float32)
+    urec = lax.dot_general(muh, dz, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    dzbi, dzbf, dzbc, dzbo = (dzbar[:, :hp_dim], dzbar[:, hp_dim:2 * hp_dim],
+                              dzbar[:, 2 * hp_dim:3 * hp_dim], dzbar[:, 3 * hp_dim:])
+    dcTbar += dzbi * gcell * qi
+    gbar = dzbi * dcT * qi
+    ibar = dzbi * dcT * gcell * (1.0 - 2.0 * i)
+    dcTbar += dzbf * cp_s * qf
+    cpbar = dzbf * dcT * qf
+    fbar += dzbf * dcT * cp_s * (1.0 - 2.0 * f)
+    dcTbar += dzbc * i * p(gcell)
+    ibar += dzbc * dcT * p(gcell)
+    gbar += dzbc * dcT * i * pp(gcell)
+    dobar = dzbo * qo
+    obar = dzbo * do * (1.0 - 2.0 * o)
+    dhTbar = dcTbar * o * p(a_c)
+    obar += dcTbar * dhT * p(a_c)
+    aCbar = dcTbar * dhT * o * pp(a_c)
+    dhTbar += dobar * a_c
+    aCbar += dobar * dhT
+    zbar = jnp.concatenate([ibar * qi, fbar * qf, gbar * p(gcell), obar * qo],
+                           axis=-1)
+
+    uxz_ref[0] = zbar
+    udhs_ref[0] = dhTbar
+    uhp_ref[0] = hpbar + jnp.dot(zbar, rec_t_ref[:],
+                                 preferred_element_type=jnp.float32)
+    ucp_ref[0] = cpbar
+    uc_ref[0] = aCbar * p(a_c)
+    urec_ref[:] += urec + lax.dot_general(hp_s, zbar, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+    muh_scr[:] = dhTbar                  # cot of carry-in dh → next step
+    muc_scr[:] = dcTbar                  # cot of carry-in dc → next step
+
+
+def _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation):
+    """Cotangents of (xz, rec, hs, cs, dhs) for the backward sweep, given
+    ``u`` = cot(dxz) and ``v_mat`` = cot(drec).  ``dhs`` itself is not an
+    operand: the kernel recovers each step's dh total from the saved
+    ``dhT_seq`` carries (and ``cot(dhs) = cot(dh)`` falls out directly)."""
+    w, b, g = xz.shape
+    hp = g // 4
+    h_prev, c_prev = _shifted(hs, cs)
+    nat = lambda t: (t, 0, 0)
+    const = lambda t: (0, 0)
+    t_h = pl.BlockSpec((1, b, hp), nat, memory_space=pltpu.VMEM)
+    t_g = pl.BlockSpec((1, b, g), nat, memory_space=pltpu.VMEM)
+    mat_hg = pl.BlockSpec((hp, g), const, memory_space=pltpu.VMEM)
+    mat_gh = pl.BlockSpec((g, hp), const, memory_space=pltpu.VMEM)
+    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    sh_g = jax.ShapeDtypeStruct((w, b, g), jnp.float32)
+    uxz, uhp, ucp, uc, udhs, urec = pl.pallas_call(
+        functools.partial(_adj_kernel, activation),
+        grid=(w,),
+        in_specs=[t_g, mat_hg, mat_gh, mat_hg, mat_gh,
+                  t_h, t_h, t_h, t_g, t_h, t_h],
+        out_specs=[t_g, t_h, t_h, t_h, t_h, mat_hg],
+        out_shape=[sh_g, sh_h, sh_h, sh_h, sh_h,
+                   jax.ShapeDtypeStruct((hp, g), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
+                        pltpu.VMEM((b, hp), jnp.float32)],
+        interpret=_interpret(),
+    )(xz, rec, rec.T, v_mat, v_mat.T, h_prev, c_prev, cs, u,
+      dhT_seq, dcT_seq)
+    # uhp_s is the cotangent of hs_{s-1}; ucp_s of cs_{s-1}; uc_s of cs_s.
+    zero = jnp.zeros_like(uhp[:1])
+    uhs = jnp.concatenate([uhp[1:], zero], axis=0)
+    ucs = uc + jnp.concatenate([ucp[1:], zero], axis=0)
+    return uxz, urec, uhs, ucs, udhs
 
 
 def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation):
@@ -274,21 +428,23 @@ def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def lstm_bwd_seq(xz, rec, hs, cs, dhs, activation):
     """First-order LSTM backward as a differentiable-once primitive:
-    pallas primal, JAX-scan-derived VJP (the genuine second-order math,
-    needed by the WGAN-GP gradient penalty's ∂/∂θ ∇_x c path)."""
+    pallas primal, and a hand-derived pallas *adjoint* kernel as its VJP
+    — the genuine second-order math the WGAN-GP gradient penalty's
+    ∂/∂θ ∇_x c path needs.  The adjoint formulas are oracle-tested
+    against JAX AD over the scan twin (:func:`_lstm_bwd_scan`)."""
     return _bwd_call(xz, rec, hs, cs, dhs, None, activation)
 
 
 def _lstm_bwd_seq_fwd(xz, rec, hs, cs, dhs, activation):
-    return _bwd_call(xz, rec, hs, cs, dhs, None, activation), (xz, rec, hs, cs, dhs)
+    dxz, drec, dhT_seq, dcT_seq = _bwd_call(
+        xz, rec, hs, cs, dhs, None, activation, with_carries=True)
+    return (dxz, drec), (xz, rec, hs, cs, dhs, dhT_seq, dcT_seq)
 
 
 def _lstm_bwd_seq_bwd(activation, residuals, cotangents):
-    xz, rec, hs, cs, dhs = residuals
-    _, vjp = jax.vjp(
-        lambda a, r, h, c, d: _lstm_bwd_scan(a, r, h, c, d, None, activation),
-        xz, rec, hs, cs, dhs)
-    return vjp(cotangents)
+    xz, rec, hs, cs, dhs, dhT_seq, dcT_seq = residuals
+    u, v_mat = cotangents
+    return _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation)
 
 
 lstm_bwd_seq.defvjp(_lstm_bwd_seq_fwd, _lstm_bwd_seq_bwd)

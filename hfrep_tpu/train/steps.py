@@ -98,16 +98,14 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                     axis_name: Optional[str] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
     """Build ``step(state, key) -> (state, metrics)`` for one epoch."""
     g_tx, d_tx = make_optimizers(pair, tcfg)
-    # First-order sites run the resolved backend (pallas on TPU).  The
-    # gradient penalty's second-order ∂/∂θ ∇_x c path works on pallas too
-    # (nested custom_vjp, hfrep_tpu/ops/pallas_lstm.py — tested against
-    # the XLA double backward), but its scan-twin VJP recomputes the
-    # backward primal and measures ~3% slower end-to-end than XLA's
-    # native double backward, so the GP term alone uses the scan backend.
+    # Every site — including the gradient penalty's second-order
+    # ∂/∂θ ∇_x c path — runs the resolved backend: the pallas LSTM is
+    # twice-differentiable end to end (nested custom_vjps with a
+    # hand-derived adjoint kernel, hfrep_tpu/ops/pallas_lstm.py, tested
+    # against the XLA double backward).
     be = resolve_lstm_backend(tcfg.lstm_backend)
     g_apply = lambda p, z, backend=be: pair.generator.apply({"params": p}, z, backend=backend)
     d_apply = lambda p, x, backend=be: pair.discriminator.apply({"params": p}, x, backend=backend)
-    d_apply_gp = lambda p, x: pair.discriminator.apply({"params": p}, x, backend="xla")
     batch = tcfg.batch_size
     window, features = dataset.shape[1], dataset.shape[2]
     noise_shape = (batch, window, features)
@@ -202,7 +200,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         # (outer grad through the GP input-grad) to 3B and measures
         # slower on the chip than the scan it saves.
         scores = d_apply(d_params, jnp.concatenate([real, fake], axis=0))
-        gp = gradient_penalty(d_apply_gp, d_params, interp)
+        gp = gradient_penalty(d_apply, d_params, interp)
         w_loss = jnp.mean(-scores[:b]) + jnp.mean(scores[b:])
         return w_loss + gp_w * gp, (w_loss, gp)
 

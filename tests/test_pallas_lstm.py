@@ -30,6 +30,38 @@ def test_forward_matches_scan(activation, h, f):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", "linear"])
+def test_adjoint_kernel_matches_scan_twin_vjp(activation):
+    """The hand-derived adjoint kernel (`_adj_call`) must agree with JAX
+    AD over the pure-JAX scan twin of the backward — the formula-level
+    oracle that keeps `_lstm_bwd_scan` and `_adj_kernel` in lockstep."""
+    from hfrep_tpu.ops.pallas_lstm import (_adj_call, _bwd_call,
+                                           _lstm_bwd_scan,
+                                           _lstm_seq_fwd_impl)
+
+    key = jax.random.PRNGKey(7)
+    w, b, hp = 5, 4, 128
+    g = 4 * hp
+    ks = jax.random.split(key, 4)
+    xz = 0.3 * jax.random.normal(ks[0], (w, b, g))
+    rec = 0.3 * jax.random.normal(ks[1], (hp, g))
+    dhs = 0.3 * jax.random.normal(ks[2], (w, b, hp))
+    hs, cs = _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True)
+    u = 0.3 * jax.random.normal(ks[3], (w, b, g))
+    v = 0.3 * jax.random.normal(ks[3], (hp, g))
+
+    _, vjp = jax.vjp(lambda *a: _lstm_bwd_scan(*a, None, activation),
+                     xz, rec, hs, cs, dhs)
+    ref = vjp((u, v))
+
+    _, _, dhT_seq, dcT_seq = _bwd_call(xz, rec, hs, cs, dhs, None,
+                                       activation, with_carries=True)
+    got = _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v, activation)
+    for name, a, r in zip(("uxz", "urec", "uhs", "ucs", "udhs"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=1e-5, rtol=1e-4, err_msg=name)
+
+
 def test_bf16_falls_back_to_scan():
     """The kernels are f32-only; a bf16 module must honor its dtype via
     the scan path instead of silently computing in f32."""
@@ -67,8 +99,8 @@ def test_gradients_match_scan(activation, h):
 
 def test_wgan_gp_epoch_matches_xla_backend():
     """One full MTSS-WGAN-GP epoch with the pallas backend lands on the
-    same numbers as the xla backend (the GP path inside is pinned to xla
-    by construction, the rest goes through the kernels)."""
+    same numbers as the xla backend — including the gradient penalty's
+    second-order path, which runs the hand-derived adjoint kernel."""
     import dataclasses
 
     from hfrep_tpu.config import ModelConfig, TrainConfig
@@ -102,8 +134,8 @@ def test_wgan_gp_epoch_matches_xla_backend():
 def test_second_order_matches_xla(activation):
     """Grad-of-grad (the WGAN-GP gradient-penalty pattern, ∂/∂θ ∇_x c)
     through the pallas backend: the nested custom_vjp structure routes
-    the second-order residue through the scan twin, so it must agree
-    with the fully-XLA double backward."""
+    the second-order residue through the hand-derived adjoint kernel,
+    and must agree with the fully-XLA double backward."""
     mod, params, x = _mk(8, 5, activation, jax.random.PRNGKey(5))
 
     def gp_like(p, xx, be):
