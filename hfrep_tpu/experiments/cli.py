@@ -259,6 +259,7 @@ def cmd_sweep(args) -> int:
     if args.plots or args.stats:
         i_best = int(np.argmax(result.oos_r2_mean))
         p = result.post[i_best]
+        a_ante = result.ante[i_best]
         actual = np.asarray(y_test)[-p.shape[0]:]
     if args.plots:
         report.multiplot(p, actual, panel.hf_names,
@@ -275,7 +276,9 @@ def cmd_sweep(args) -> int:
             if not os.path.exists(path):
                 print(f"warning: {flag} file {path} not found — "
                       "FF alpha columns will be omitted", file=sys.stderr)
-        for name, returns in (("replication", p), ("benchmark", actual)):
+        # post (cell 25 second loop), ante (cells 31/65), actual HF (cell 28)
+        for name, returns in (("replication", p), ("replication_ante", a_ante),
+                              ("benchmark", actual)):
             table = report.stats_table(
                 returns, panel.hf_names, rf=rf_aligned,
                 ff3_path=args.ff3, ff5_path=args.ff5, span=span_set,

@@ -140,6 +140,42 @@ class TestCli:
         assert rc == 0
         assert (tmp_path / "cleaned" / "hfd.csv").exists()
 
+    def test_train_gan_cli_tiny(self, tmp_path):
+        """cmd_train_gan end to end: short training, checkpoint, samples,
+        resume completing the schedule, and h5 export when TF is present."""
+        from hfrep_tpu.experiments.cli import main
+
+        ck = str(tmp_path / "ck")
+        args = ["train-gan", "--preset", "gan_1k", "--epochs", "3",
+                "--quiet", "--checkpoint-dir", ck,
+                "--samples-out", str(tmp_path / "gen.npy")]
+        try:
+            import tensorflow  # noqa: F401
+            args += ["--export-h5", str(tmp_path / "gen.h5")]
+            has_tf = True
+        except ImportError:
+            has_tf = False
+        assert main(args) == 0
+        assert np.load(tmp_path / "gen.npy").shape == (10, 48, 35)
+        if has_tf:
+            from hfrep_tpu.utils.keras_import import load_keras_generator
+            _, _, shape = load_keras_generator(str(tmp_path / "gen.h5"))
+            assert shape == (48, 35)
+        # resume with the schedule already met: trains 0 further epochs
+        rc = main(["train-gan", "--preset", "gan_1k", "--epochs", "3",
+                   "--quiet", "--checkpoint-dir", ck, "--resume"])
+        assert rc == 0
+
+    def test_resolve_lstm_backend_validates(self):
+        import pytest as _pytest
+
+        from hfrep_tpu.train.steps import resolve_lstm_backend
+        assert resolve_lstm_backend("xla") == "xla"
+        assert resolve_lstm_backend("pallas") == "pallas"
+        assert resolve_lstm_backend("auto") in ("pallas", "xla")
+        with _pytest.raises(ValueError):
+            resolve_lstm_backend("cuda")
+
     def test_sweep_cli_tiny(self, tmp_path):
         from hfrep_tpu.experiments.cli import main
         rc = main(["sweep", "--latents", "1,2", "--epochs", "15",
