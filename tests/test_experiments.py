@@ -166,15 +166,20 @@ class TestCli:
                    "--quiet", "--checkpoint-dir", ck, "--resume"])
         assert rc == 0
 
-    def test_resolve_lstm_backend_validates(self):
-        import pytest as _pytest
+    def test_train_gan_resume_completes_schedule(self, tmp_path, capsys):
+        """--resume must finish the configured schedule, not retrain the
+        full --epochs count on top of the restored epoch."""
+        from hfrep_tpu.experiments.cli import main
 
-        from hfrep_tpu.train.steps import resolve_lstm_backend
-        assert resolve_lstm_backend("xla") == "xla"
-        assert resolve_lstm_backend("pallas") == "pallas"
-        assert resolve_lstm_backend("auto") in ("pallas", "xla")
-        with _pytest.raises(ValueError):
-            resolve_lstm_backend("cuda")
+        ck = str(tmp_path / "ck")
+        main(["train-gan", "--preset", "gan_1k", "--epochs", "3",
+              "--quiet", "--checkpoint-dir", ck])
+        capsys.readouterr()
+        main(["train-gan", "--preset", "gan_1k", "--epochs", "3",
+              "--quiet", "--checkpoint-dir", ck, "--resume"])
+        out = capsys.readouterr().out
+        assert "resumed from" in out
+        assert "trained gan for 3 epochs (schedule already complete)" in out
 
     def test_sweep_cli_tiny(self, tmp_path):
         from hfrep_tpu.experiments.cli import main

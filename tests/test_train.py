@@ -98,6 +98,15 @@ def test_train_exact_epoch_count(dataset):
     assert len(tr.history) == 6
 
 
+def test_resolve_lstm_backend_validates():
+    from hfrep_tpu.train.steps import resolve_lstm_backend
+    assert resolve_lstm_backend("xla") == "xla"
+    assert resolve_lstm_backend("pallas") == "pallas"
+    assert resolve_lstm_backend("auto") in ("pallas", "xla")
+    with pytest.raises(ValueError):
+        resolve_lstm_backend("cuda")
+
+
 def test_pipelined_history_contiguous_with_checkpoints(tmp_path, dataset):
     """The pipelined logging path (block i's host work deferred behind
     block i+1's dispatch) must keep per-epoch history contiguous and
