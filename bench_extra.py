@@ -38,21 +38,20 @@ def bench_ae_epoch() -> None:
     x_train, _, _, _ = panel.train_test_split()
     _, x_scaled = mm.fit_transform(jnp.asarray(x_train, jnp.float32))
 
-    fns = {}
-    for epochs in (10, 5010):
-        cfg = AEConfig(latent_dim=21, epochs=epochs, patience=10**9)  # no early stop
-        fns[epochs] = jax.jit(lambda k, cfg=cfg: train_autoencoder(k, x_scaled, cfg))
-        jax.block_until_ready(fns[epochs](jax.random.PRNGKey(0)).params)  # compile
+    epochs = 20000
+    cfg = AEConfig(latent_dim=21, epochs=epochs, patience=10**9)  # no early stop
+    fn = jax.jit(lambda k: train_autoencoder(k, x_scaled, cfg))
+    jax.block_until_ready(fn(jax.random.PRNGKey(0)).params)       # compile
 
-    def best(epochs, reps=5):
-        times = []
-        for r in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fns[epochs](jax.random.PRNGKey(r)).params)
-            times.append(time.perf_counter() - t0)
-        return min(times)
-
-    per_epoch = (best(5010) - best(10)) / 5000.0
+    times = []
+    for r in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(jax.random.PRNGKey(r)).params)
+        times.append(time.perf_counter() - t0)
+    # single long run: the one-dispatch overhead (~4 ms through the
+    # tunnel) amortizes to <0.2 us/epoch, far below measurement noise of
+    # a two-point difference.
+    per_epoch = min(times) / epochs
     print(json.dumps({"metric": "ae_epoch_time", "value": round(per_epoch * 1e3, 4),
                       "unit": "ms/epoch", "vs_baseline": None}))
 
