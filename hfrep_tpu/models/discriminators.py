@@ -81,6 +81,24 @@ class DenseFlatCritic(nn.Module):
         return KerasDense(1, dtype=self.dtype)(x)
 
 
+def _plain_stack(parent_dtype, hidden, x, backend):
+    """Two stacked default-activation KerasLSTMs; on the pallas backend
+    the pair runs as ONE fused kernel chain (ops/pallas_lstm_stack) —
+    exactly the plain-stack topology of the MTSS critics
+    (``GAN/MTSS_WGAN_GP.py:237-252``).  Child names pin the param tree so
+    both branches share parameters."""
+    from hfrep_tpu.ops.pallas_lstm import kernel_eligible
+
+    l1 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_0")
+    l2 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_1")
+    if kernel_eligible(backend, parent_dtype or x.dtype):
+        from hfrep_tpu.ops.pallas_lstm_stack import pallas_keras_lstm_stack
+        return pallas_keras_lstm_stack(l1(materialize=x.shape[-1]),
+                                       l2(materialize=hidden),
+                                       x, activation="tanh")
+    return l2(l1(x, backend=backend), backend=backend)
+
+
 class LSTMDiscriminator(nn.Module):
     """MTSS-GAN discriminator; logits (B, W, 1)."""
 
@@ -89,8 +107,7 @@ class LSTMDiscriminator(nn.Module):
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
+        x = _plain_stack(self.dtype, self.hidden, x, backend)
         return KerasDense(1, dtype=self.dtype)(x)
 
 
@@ -120,7 +137,6 @@ class LSTMFlatCritic(nn.Module):
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
-        x = KerasLSTM(self.hidden, dtype=self.dtype)(x, backend=backend)
+        x = _plain_stack(self.dtype, self.hidden, x, backend)
         x = x.reshape(x.shape[0], -1)
         return KerasDense(1, dtype=self.dtype)(x)

@@ -82,22 +82,27 @@ class KerasLSTM(nn.Module):
     backend: str = "xla"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray,
-                 backend: Optional[str] = None) -> jnp.ndarray:
-        """(B, W, F) → (B, W, H) full hidden-state sequence."""
-        b, w, f = x.shape
+    def __call__(self, x: Optional[jnp.ndarray] = None,
+                 backend: Optional[str] = None,
+                 materialize: Optional[int] = None):
+        """(B, W, F) → (B, W, H) full hidden-state sequence.
+
+        ``materialize=<in_features>`` instead returns this layer's raw
+        param dict without running it — for fused multi-layer kernels
+        (:mod:`hfrep_tpu.ops.pallas_lstm_stack`).  Param names/shapes/
+        inits are identical either way, so the tree is mode-independent.
+        """
         h = self.features
+        f = materialize if materialize is not None else x.shape[-1]
         kernel = self.param("kernel", nn.initializers.glorot_uniform(), (f, 4 * h))
         recurrent = self.param("recurrent_kernel", nn.initializers.orthogonal(), (h, 4 * h))
         bias = self.param("bias", _unit_forget_bias, (4 * h,))
+        if materialize is not None:
+            return {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}
+        b, w, _ = x.shape
 
-        eff_dtype = self.dtype or x.dtype
-        if (backend or self.backend) == "pallas" and eff_dtype == jnp.float32:
-            # The kernels compute in f32 only; other dtypes (e.g. a
-            # bf16 ModelConfig) fall through to the scan path so the
-            # configured precision is honored rather than silently
-            # overridden.
-            from hfrep_tpu.ops.pallas_lstm import pallas_keras_lstm
+        from hfrep_tpu.ops.pallas_lstm import kernel_eligible, pallas_keras_lstm
+        if kernel_eligible(backend or self.backend, self.dtype or x.dtype):
             return pallas_keras_lstm(kernel, recurrent, bias, x,
                                      self.activation or "linear",
                                      self.recurrent_activation)

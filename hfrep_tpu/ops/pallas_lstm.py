@@ -83,6 +83,25 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
+def kernel_eligible(backend, eff_dtype) -> bool:
+    """Single source of truth for pallas-kernel dispatch: explicit pallas
+    backend and f32 compute (the kernels are f32-only; other dtypes take
+    the scan path so configured precision is honored)."""
+    return backend == "pallas" and eff_dtype == jnp.float32
+
+
+def pad_keras_params(params: dict, h: int, hp: int) -> tuple:
+    """Keras-layout {kernel, recurrent_kernel, bias} to padded-gate layout
+    (kernel_p, rec_p, bias_p) shared by the single-layer and fused-stack
+    entry points.  ``rec_p`` pads both gate columns and input rows; use it
+    for any weight whose input is a padded hidden state."""
+    kernel_p = pad_gate_cols(params["kernel"], h, hp)
+    bias_p = pad_gate_cols(params["bias"], h, hp)
+    rec_p = jnp.pad(pad_gate_cols(params["recurrent_kernel"], h, hp),
+                    ((0, hp - params["recurrent_kernel"].shape[0]), (0, 0)))
+    return kernel_p, rec_p, bias_p
+
+
 # --------------------------------------------------------------- forward
 
 def _fwd_kernel(act_name, with_cs, xz_ref, rec_ref, hs_ref, *rest):
@@ -505,9 +524,8 @@ def pallas_keras_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray,
     h = recurrent.shape[0]
     hp = ((h + LANE - 1) // LANE) * LANE
 
-    kernel_p = pad_gate_cols(kernel, h, hp)                       # (F, 4Hp)
-    bias_p = pad_gate_cols(bias, h, hp)                           # (4Hp,)
-    rec_p = jnp.pad(pad_gate_cols(recurrent, h, hp), ((0, hp - h), (0, 0)))
+    kernel_p, rec_p, bias_p = pad_keras_params(
+        {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}, h, hp)
 
     xz = (x.reshape(b * w, f) @ kernel_p + bias_p).reshape(b, w, 4 * hp)
     xz = jnp.swapaxes(xz, 0, 1).astype(jnp.float32)               # (W, B, 4Hp)

@@ -1,0 +1,495 @@
+"""Fused two-layer (plain-stack) LSTM pallas kernels.
+
+The MTSS critics are plain stacks — ``LSTM(100) → LSTM(100)``
+(``GAN/MTSS_WGAN_GP.py:237-252``, ``GAN/MTSS_GAN.py:143-157``) — and are
+applied ~6× per WGAN-GP critic iteration (scoring fwd/bwd + the gradient
+penalty's fwd/inner-reverse/adjoint).  Fusing both layers into single
+kernels (layer 2 consumes layer 1's h at the same timestep:
+``z2_t = h1_t@K2 + b2 + h2_{t-1}@R2``) halves kernel launches and keeps
+the inter-layer activation in VMEM.
+
+Same differentiation structure as the single-layer module
+(:mod:`hfrep_tpu.ops.pallas_lstm`): ``stack_seq`` (primal) →
+``stack_fwd_res`` (residual-producing forward, extended backward with
+direct cotangent streams) → ``stack_bwd_seq`` (backward primitive whose
+VJP is the hand-derived fused adjoint kernel).  Every formula is
+oracle-tested against JAX AD over pure-JAX scan twins
+(tests/test_pallas_stack.py).
+
+Generators are NOT fused: their stacks have LayerNorm/LeakyReLU between
+the layers and keep the per-layer kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hfrep_tpu.ops.pallas_lstm import (
+    LANE,
+    _ACT,
+    _act_prime_from_value as P,
+    _act_prime_prime_from_value as PP,
+    _interpret,
+    _shifted,
+    _supported,
+    pad_keras_params,
+)
+
+
+def _gates(z, act_name):
+    hp = z.shape[-1] // 4
+    zi, zf, zc, zo = (z[:, :hp], z[:, hp:2 * hp], z[:, 2 * hp:3 * hp], z[:, 3 * hp:])
+    act = _ACT[act_name]
+    return (jax.nn.sigmoid(zi), jax.nn.sigmoid(zf), act(zc), jax.nn.sigmoid(zo))
+
+
+def _bwd_step(act_name, i, f, g, o, c_prev, c, dhs_t, dh, dc):
+    """Shared primal-backward step from gate values; returns
+    (dz, dcT, dhT) — dh'/dc' derived by the caller."""
+    a_c = _ACT[act_name](c)
+    dhT = dhs_t + dh
+    do = dhT * a_c
+    dzo = do * o * (1.0 - o)
+    dcT = dc + dhT * o * P(act_name, a_c)
+    dzi = dcT * g * i * (1.0 - i)
+    dzf = dcT * c_prev * f * (1.0 - f)
+    dzc = dcT * i * P(act_name, g)
+    return jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1), dcT, dhT
+
+
+# --------------------------------------------------------------- forward
+
+def _stack_fwd_kernel(act_name, with_res, xz1_ref, rec1_ref, k2_ref, b2_ref,
+                      rec2_ref, hs2_ref, *rest):
+    if with_res:
+        hs1_ref, cs1_ref, cs2_ref = rest[0], rest[1], rest[2]
+    h1s, c1s, h2s, c2s = rest[-4:]
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        for s in (h1s, c1s, h2s, c2s):
+            s[:] = jnp.zeros_like(s)
+
+    act = _ACT[act_name]
+    z1 = xz1_ref[0] + jnp.dot(h1s[:], rec1_ref[:], preferred_element_type=jnp.float32)
+    i1, f1, g1, o1 = _gates(z1, act_name)
+    c1 = f1 * c1s[:] + i1 * g1
+    h1 = o1 * act(c1)
+    z2 = (b2_ref[0]
+          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
+          + jnp.dot(h2s[:], rec2_ref[:], preferred_element_type=jnp.float32))
+    i2, f2, g2, o2 = _gates(z2, act_name)
+    c2 = f2 * c2s[:] + i2 * g2
+    h2 = o2 * act(c2)
+    h1s[:], c1s[:], h2s[:], c2s[:] = h1, c1, h2, c2
+    hs2_ref[0] = h2
+    if with_res:
+        hs1_ref[0] = h1
+        cs1_ref[0] = c1
+        cs2_ref[0] = c2
+
+
+def _stack_fwd_impl(xz1, rec1, k2, b2, rec2, activation, with_res):
+    w, b, g = xz1.shape
+    hp = g // 4
+    t_h = pl.BlockSpec((1, b, hp), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    mat = pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    row = pl.BlockSpec((1, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    n_out = 4 if with_res else 1
+    out = pl.pallas_call(
+        functools.partial(_stack_fwd_kernel, activation, with_res),
+        grid=(w,),
+        in_specs=[pl.BlockSpec((1, b, g), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                  mat, mat, row, mat],
+        out_specs=[t_h] * n_out,
+        out_shape=[sh_h] * n_out,
+        scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32)] * 4,
+        interpret=_interpret(),
+    )(xz1, rec1, k2, b2.reshape(1, g), rec2)
+    if with_res:
+        hs2, hs1, cs1, cs2 = out         # kernel emits hs2 first
+        return hs1, cs1, hs2, cs2
+    return out[0]
+
+
+# -------------------------------------------------------------- backward
+
+def _stack_bwd_kernel(act_name, with_direct, with_carries,
+                      xz1_ref, rec1_ref, rec1_t_ref, k2_ref, k2_t_ref, b2_ref,
+                      rec2_ref, rec2_t_ref,
+                      h1p_ref, c1p_ref, cs1_ref, hs1_ref,
+                      h2p_ref, c2p_ref, cs2_ref, dhs2_ref, *rest):
+    k = 3 if with_direct else 0
+    if with_direct:        # direct cotangents on the residual streams
+        dhs1_ref, dcs1_ref, dcs2_ref = rest[0], rest[1], rest[2]
+    dxz1_ref, drec1_ref, dk2_ref, db2_ref, drec2_ref = rest[k:k + 5]
+    if with_carries:
+        dhT1_ref, dcT1_ref, dhT2_ref, dcT2_ref = rest[k + 5:k + 9]
+    dh1s, dc1s, dh2s, dc2s = rest[-4:]
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        for s in (dh1s, dc1s, dh2s, dc2s):
+            s[:] = jnp.zeros_like(s)
+        drec1_ref[:] = jnp.zeros_like(drec1_ref)
+        dk2_ref[:] = jnp.zeros_like(dk2_ref)
+        db2_ref[:] = jnp.zeros_like(db2_ref)
+        drec2_ref[:] = jnp.zeros_like(drec2_ref)
+
+    h1p, c1p, c1, h1 = h1p_ref[0], c1p_ref[0], cs1_ref[0], hs1_ref[0]
+    h2p, c2p, c2 = h2p_ref[0], c2p_ref[0], cs2_ref[0]
+
+    # recompute gates for both layers
+    z1 = xz1_ref[0] + jnp.dot(h1p, rec1_ref[:], preferred_element_type=jnp.float32)
+    i1, f1, g1, o1 = _gates(z1, act_name)
+    z2 = (b2_ref[0]
+          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
+          + jnp.dot(h2p, rec2_ref[:], preferred_element_type=jnp.float32))
+    i2, f2, g2, o2 = _gates(z2, act_name)
+
+    dc2_in = dc2s[:] + (dcs2_ref[0] if with_direct else 0.0)
+    dz2, dcT2, dhT2 = _bwd_step(act_name, i2, f2, g2, o2, c2p, c2,
+                                dhs2_ref[0], dh2s[:], dc2_in)
+    dk2_ref[:] += lax.dot_general(h1, dz2, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    db2_ref[:] += jnp.sum(dz2, axis=0, keepdims=True)
+    drec2_ref[:] += lax.dot_general(h2p, dz2, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    dh1_in = jnp.dot(dz2, k2_t_ref[:], preferred_element_type=jnp.float32)
+    if with_direct:
+        dh1_in = dh1_in + dhs1_ref[0]
+    dc1_in = dc1s[:] + (dcs1_ref[0] if with_direct else 0.0)
+    dz1, dcT1, dhT1 = _bwd_step(act_name, i1, f1, g1, o1, c1p, c1,
+                                dh1_in, dh1s[:], dc1_in)
+    drec1_ref[:] += lax.dot_general(h1p, dz1, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    dxz1_ref[0] = dz1
+    if with_carries:
+        dhT1_ref[0], dcT1_ref[0] = dhT1, dcT1
+        dhT2_ref[0], dcT2_ref[0] = dhT2, dcT2
+    dh1s[:] = jnp.dot(dz1, rec1_t_ref[:], preferred_element_type=jnp.float32)
+    dc1s[:] = dcT1 * f1
+    dh2s[:] = jnp.dot(dz2, rec2_t_ref[:], preferred_element_type=jnp.float32)
+    dc2s[:] = dcT2 * f2
+
+
+def _shift1(a):
+    return _shifted(a, a)[0]
+
+
+def _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
+                    directs, activation, with_carries=False):
+    w, b, g = xz1.shape
+    hp = g // 4
+    rev = lambda t: (w - 1 - t, 0, 0)
+    t_h = pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM)
+    t_g = pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM)
+    mat = pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    mat_t = pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    row = pl.BlockSpec((1, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    with_direct = directs is not None
+    operands = [xz1, rec1, rec1.T, k2, k2.T, b2.reshape(1, g), rec2, rec2.T,
+                _shift1(hs1), _shift1(cs1), cs1, hs1,
+                _shift1(hs2), _shift1(cs2), cs2, dhs2]
+    in_specs = [t_g, mat, mat_t, mat, mat_t, row, mat, mat_t] + [t_h] * 8
+    if with_direct:
+        operands += list(directs)        # (dhs1, dcs1, dcs2)
+        in_specs += [t_h] * 3
+    out_specs = [t_g, mat, mat, row, mat]
+    out_shape = [jax.ShapeDtypeStruct((w, b, g), jnp.float32),
+                 jax.ShapeDtypeStruct((hp, g), jnp.float32),
+                 jax.ShapeDtypeStruct((hp, g), jnp.float32),
+                 jax.ShapeDtypeStruct((1, g), jnp.float32),
+                 jax.ShapeDtypeStruct((hp, g), jnp.float32)]
+    if with_carries:
+        out_specs += [t_h] * 4
+        out_shape += [jax.ShapeDtypeStruct((w, b, hp), jnp.float32)] * 4
+    out = pl.pallas_call(
+        functools.partial(_stack_bwd_kernel, activation, with_direct,
+                          with_carries),
+        grid=(w,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32)] * 4,
+        interpret=_interpret(),
+    )(*operands)
+    out = list(out)
+    out[3] = out[3].reshape(g)           # db2 (1, G) → (G,)
+    return tuple(out)
+
+
+# --------------------------------------------------------------- adjoint
+
+def _stack_adj_kernel(act_name, xz1_ref, rec1_ref, rec1_t_ref, k2_ref,
+                      k2_t_ref, b2_ref, rec2_ref, rec2_t_ref,
+                      vr1_ref, vr1_t_ref, vk2_ref, vk2_t_ref, vb2_ref,
+                      vr2_ref, vr2_t_ref,
+                      h1p_ref, c1p_ref, cs1_ref, hs1_ref,
+                      h2p_ref, c2p_ref, cs2_ref, u1_ref,
+                      dhT1_ref, dcT1_ref, dhT2_ref, dcT2_ref,
+                      uxz1_ref, uh1_ref, uh1p_ref, uc1p_ref, uc1_ref,
+                      uh2p_ref, uc2p_ref, uc2_ref, udhs2_ref,
+                      ur1_ref, uk2_ref, ub2_ref, ur2_ref,
+                      muh1_s, muc1_s, muh2_s, muc2_s):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        for s in (muh1_s, muc1_s, muh2_s, muc2_s):
+            s[:] = jnp.zeros_like(s)
+        for r in (ur1_ref, uk2_ref, ub2_ref, ur2_ref):
+            r[:] = jnp.zeros_like(r)
+
+    act = _ACT[act_name]
+    h1p, c1p, c1, h1 = h1p_ref[0], c1p_ref[0], cs1_ref[0], hs1_ref[0]
+    h2p, c2p, c2 = h2p_ref[0], c2p_ref[0], cs2_ref[0]
+    dhT1, dcT1 = dhT1_ref[0], dcT1_ref[0]
+    dhT2, dcT2 = dhT2_ref[0], dcT2_ref[0]
+
+    def adj_layer(z, c_t, cp_t, hp_t, dhT, dcT, muh, muc, U_t, v, v_t, rec,
+                  rec_t):
+        """Shared single-layer adjoint step; returns
+        (zbar, hpbar, cpbar, cbar, dhTbar, dcTbar, urec_step, dz)."""
+        i, f, g, o = _gates(z, act_name)
+        a_c = act(c_t)
+        qi, qf, qo = i * (1 - i), f * (1 - f), o * (1 - o)
+        do = dhT * a_c
+        hp_dim = z.shape[-1] // 4
+        dzi = dcT * g * qi
+        dzf = dcT * cp_t * qf
+        dzc = dcT * i * P(act_name, g)
+        dzo = do * qo
+        dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
+        dzbar = (U_t + jnp.dot(muh, rec, preferred_element_type=jnp.float32)
+                 + jnp.dot(hp_t, v, preferred_element_type=jnp.float32))
+        dcTbar = muc * f
+        fbar = muc * dcT
+        hpbar = jnp.dot(dz, v_t, preferred_element_type=jnp.float32)
+        urec = lax.dot_general(muh, dz, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        dzbi, dzbf, dzbc, dzbo = (dzbar[:, :hp_dim], dzbar[:, hp_dim:2 * hp_dim],
+                                  dzbar[:, 2 * hp_dim:3 * hp_dim], dzbar[:, 3 * hp_dim:])
+        dcTbar += dzbi * g * qi
+        gbar = dzbi * dcT * qi
+        ibar = dzbi * dcT * g * (1 - 2 * i)
+        dcTbar += dzbf * cp_t * qf
+        cpbar = dzbf * dcT * qf
+        fbar += dzbf * dcT * cp_t * (1 - 2 * f)
+        dcTbar += dzbc * i * P(act_name, g)
+        ibar += dzbc * dcT * P(act_name, g)
+        gbar += dzbc * dcT * i * PP(act_name, g)
+        dobar = dzbo * qo
+        obar = dzbo * do * (1 - 2 * o)
+        dhTbar = dcTbar * o * P(act_name, a_c)
+        obar += dcTbar * dhT * P(act_name, a_c)
+        aCbar = dcTbar * dhT * o * PP(act_name, a_c)
+        dhTbar += dobar * a_c
+        aCbar += dobar * dhT
+        zbar = jnp.concatenate([ibar * qi, fbar * qf, gbar * P(act_name, g),
+                                obar * qo], axis=-1)
+        hpbar = hpbar + jnp.dot(zbar, rec_t, preferred_element_type=jnp.float32)
+        urec = urec + lax.dot_general(hp_t, zbar, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        cbar = aCbar * P(act_name, a_c)
+        return zbar, hpbar, cpbar, cbar, dhTbar, dcTbar, urec, dz
+
+    z1 = xz1_ref[0] + jnp.dot(h1p, rec1_ref[:], preferred_element_type=jnp.float32)
+    z2 = (b2_ref[0]
+          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
+          + jnp.dot(h2p, rec2_ref[:], preferred_element_type=jnp.float32))
+
+    # layer1 adjoint first (it ran last in the backward step)
+    (zbar1, hp1bar, cp1bar, c1bar, dhTbar1, dcTbar1, ur1_s, dz1) = adj_layer(
+        z1, c1, c1p, h1p, dhT1, dcT1, muh1_s[:], muc1_s[:], u1_ref[0],
+        vr1_ref[:], vr1_t_ref[:], rec1_ref[:], rec1_t_ref[:])
+    ur1_ref[:] += ur1_s
+    # layer2's dz2 cotangent: via dh1_in = dz2@K2ᵀ, dk2 = h1ᵀdz2, db2 = Σdz2
+    u2 = (jnp.dot(dhTbar1, k2_ref[:], preferred_element_type=jnp.float32)
+          + jnp.dot(h1, vk2_ref[:], preferred_element_type=jnp.float32)
+          + vb2_ref[0])
+    (zbar2, hp2bar, cp2bar, c2bar, dhTbar2, dcTbar2, ur2_s, dz2) = adj_layer(
+        z2, cs2_ref[0], c2p, h2p, dhT2, dcT2, muh2_s[:], muc2_s[:], u2,
+        vr2_ref[:], vr2_t_ref[:], rec2_ref[:], rec2_t_ref[:])
+    ur2_ref[:] += ur2_s
+    # zbar2 is the cotangent of z2's additive inputs: h1@K2 (+b2)
+    uh1 = (jnp.dot(zbar2, k2_t_ref[:], preferred_element_type=jnp.float32)
+           + jnp.dot(dz2, vk2_t_ref[:], preferred_element_type=jnp.float32))
+    uk2_ref[:] += (lax.dot_general(h1, zbar2, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+                   + lax.dot_general(dhTbar1, dz2, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32))
+    ub2_ref[:] += jnp.sum(zbar2, axis=0, keepdims=True)
+
+    uxz1_ref[0] = zbar1
+    uh1_ref[0] = uh1
+    uh1p_ref[0] = hp1bar
+    uc1p_ref[0] = cp1bar
+    uc1_ref[0] = c1bar
+    uh2p_ref[0] = hp2bar
+    uc2p_ref[0] = cp2bar
+    uc2_ref[0] = c2bar
+    udhs2_ref[0] = dhTbar2
+    muh1_s[:], muc1_s[:] = dhTbar1, dcTbar1
+    muh2_s[:], muc2_s[:] = dhTbar2, dcTbar2
+
+
+def _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                    dhT1s, dcT1s, dhT2s, dcT2s, u1, vr1, vk2, vb2, vr2,
+                    activation):
+    w, b, g = xz1.shape
+    hp = g // 4
+    nat = lambda t: (t, 0, 0)
+    t_h = pl.BlockSpec((1, b, hp), nat, memory_space=pltpu.VMEM)
+    t_g = pl.BlockSpec((1, b, g), nat, memory_space=pltpu.VMEM)
+    mat = pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    mat_t = pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    row = pl.BlockSpec((1, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    outs = pl.pallas_call(
+        functools.partial(_stack_adj_kernel, activation),
+        grid=(w,),
+        in_specs=[t_g, mat, mat_t, mat, mat_t, row, mat, mat_t,
+                  mat, mat_t, mat, mat_t, row, mat, mat_t]
+                 + [t_h] * 7 + [t_g] + [t_h] * 4,
+        out_specs=[t_g] + [t_h] * 8 + [mat, mat, row, mat],
+        out_shape=[jax.ShapeDtypeStruct((w, b, g), jnp.float32)]
+                  + [sh_h] * 8
+                  + [jax.ShapeDtypeStruct((hp, g), jnp.float32),
+                     jax.ShapeDtypeStruct((hp, g), jnp.float32),
+                     jax.ShapeDtypeStruct((1, g), jnp.float32),
+                     jax.ShapeDtypeStruct((hp, g), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32)] * 4,
+        interpret=_interpret(),
+    )(xz1, rec1, rec1.T, k2, k2.T, b2.reshape(1, g), rec2, rec2.T,
+      vr1, vr1.T, vk2, vk2.T, vb2.reshape(1, g), vr2, vr2.T,
+      _shift1(hs1), _shift1(cs1), cs1, hs1,
+      _shift1(hs2), _shift1(cs2), cs2, u1,
+      dhT1s, dcT1s, dhT2s, dcT2s)
+    (uxz1, uh1, uh1p, uc1p, uc1, uh2p, uc2p, uc2, udhs2,
+     ur1, uk2, ub2, ur2) = outs
+    zero = jnp.zeros_like(uh1p[:1])
+    uhs1 = uh1 + jnp.concatenate([uh1p[1:], zero], axis=0)
+    ucs1 = uc1 + jnp.concatenate([uc1p[1:], zero], axis=0)
+    uhs2 = jnp.concatenate([uh2p[1:], zero], axis=0)
+    ucs2 = uc2 + jnp.concatenate([uc2p[1:], zero], axis=0)
+    return uxz1, ur1, uk2, ub2.reshape(g), ur2, uhs1, ucs1, uhs2, ucs2, udhs2
+
+
+# ------------------------------------------------------ custom_vjp layers
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10,))
+def stack_bwd_seq(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
+                  activation):
+    """Fused backward as a differentiable-once primitive (pallas primal,
+    hand-derived pallas adjoint as its VJP)."""
+    return _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                           dhs2, None, activation)[:5]
+
+
+def _stack_bwd_seq_fwd(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
+                       activation):
+    out = _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                          dhs2, None, activation, with_carries=True)
+    res = (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2) + out[5:]
+    return out[:5], res
+
+
+def _stack_bwd_seq_bwd(activation, res, cots):
+    (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+     dhT1s, dcT1s, dhT2s, dcT2s) = res
+    u1, vr1, vk2, vb2, vr2 = cots
+    return _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                           dhT1s, dcT1s, dhT2s, dcT2s, u1, vr1, vk2, vb2,
+                           vr2, activation)
+
+
+stack_bwd_seq.defvjp(_stack_bwd_seq_fwd, _stack_bwd_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def stack_fwd_res(xz1, rec1, k2, b2, rec2, activation):
+    """Forward producing (hs1, cs1, hs2, cs2) with a pallas VJP (extended
+    backward accepting direct cotangents on every residual stream)."""
+    return _stack_fwd_impl(xz1, rec1, k2, b2, rec2, activation, with_res=True)
+
+
+def _stack_fwd_res_fwd(xz1, rec1, k2, b2, rec2, activation):
+    hs1, cs1, hs2, cs2 = _stack_fwd_impl(xz1, rec1, k2, b2, rec2, activation,
+                                         with_res=True)
+    return (hs1, cs1, hs2, cs2), (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2)
+
+
+def _stack_fwd_res_bwd(activation, res, cots):
+    xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2 = res
+    dhs1, dcs1, dhs2, dcs2 = cots
+    out = _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                          dhs2, (dhs1, dcs1, dcs2), activation)
+    return out[:5]
+
+
+stack_fwd_res.defvjp(_stack_fwd_res_fwd, _stack_fwd_res_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def stack_seq(xz1, rec1, k2, b2, rec2, activation):
+    """Fused two-layer recurrence: (W, B, 4Hp) → layer2 hidden (W, B, Hp)."""
+    return _stack_fwd_impl(xz1, rec1, k2, b2, rec2, activation, with_res=False)
+
+
+def _stack_seq_fwd(xz1, rec1, k2, b2, rec2, activation):
+    hs1, cs1, hs2, cs2 = stack_fwd_res(xz1, rec1, k2, b2, rec2, activation)
+    return hs2, (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2)
+
+
+def _stack_seq_bwd(activation, res, dhs2):
+    xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2 = res
+    return stack_bwd_seq(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
+                         activation)
+
+
+stack_seq.defvjp(_stack_seq_fwd, _stack_seq_bwd)
+
+
+# ----------------------------------------------------- Keras-layout entry
+
+def pallas_keras_lstm_stack(params1: dict, params2: dict, x: jnp.ndarray,
+                            activation: Optional[str] = "tanh",
+                            recurrent_activation: str = "sigmoid") -> jnp.ndarray:
+    """Fused plain stack from two Keras-layout param dicts
+    ({kernel, recurrent_kernel, bias}); (B, W, F) → (B, W, H2).
+
+    Numerically matches two chained :class:`~hfrep_tpu.ops.lstm.KerasLSTM`
+    applications; twice-differentiable like the single-layer path.
+    """
+    _supported(activation, recurrent_activation)
+    act = activation or "linear"
+    b, w, f = x.shape
+    h1 = params1["recurrent_kernel"].shape[0]
+    h2 = params2["recurrent_kernel"].shape[0]
+    if h1 != h2:
+        raise NotImplementedError("fused stack requires equal layer widths")
+    hp = ((h1 + LANE - 1) // LANE) * LANE
+
+    k1p, r1p, b1p = pad_keras_params(params1, h1, hp)
+    _, r2p, b2p = pad_keras_params(params2, h2, hp)
+    # layer 2's input kernel consumes the padded hidden state, so it pads
+    # rows exactly like a recurrent matrix (the helper's rec treatment).
+    k2p = pad_keras_params({**params2, "recurrent_kernel": params2["kernel"]},
+                           h2, hp)[1]
+
+    xz1 = (x.reshape(b * w, f) @ k1p + b1p).reshape(b, w, 4 * hp)
+    xz1 = jnp.swapaxes(xz1, 0, 1).astype(jnp.float32)
+    hs2 = stack_seq(xz1, r1p.astype(jnp.float32), k2p.astype(jnp.float32),
+                    b2p.astype(jnp.float32), r2p.astype(jnp.float32), act)
+    return jnp.swapaxes(hs2, 0, 1)[..., :h2]
