@@ -17,7 +17,6 @@ Prints one JSON line per metric.
 from __future__ import annotations
 
 import json
-import pickle
 import time
 
 import jax
@@ -60,8 +59,10 @@ def bench_js_regeneration() -> None:
     from hfrep_tpu.metrics.gan_eval import js_div
     from hfrep_tpu.utils.keras_import import load_keras_generator
 
+    from hfrep_tpu.utils.safe_pickle import safe_pickle_load
+
     with open(GEN_PKL, "rb") as fh:
-        ref_cube = jnp.asarray(pickle.load(fh))              # (10, 168, 36) scaled
+        ref_cube = jnp.asarray(safe_pickle_load(fh))         # (10, 168, 36) scaled
     module, params, shape = load_keras_generator(PROD_H5)
     z = jax.random.normal(jax.random.PRNGKey(0), (10,) + shape, jnp.float32)
     ours = module.apply({"params": params}, z)

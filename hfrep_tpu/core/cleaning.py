@@ -188,7 +188,7 @@ def run_cleaning(raw_dir: str, out_dir: Optional[str] = None) -> CleanResult:
     return res
 
 
-def validate_against(res: CleanResult, ref_dir: str) -> Dict[str, float]:
+def validate_against(res: CleanResult, ref_dir: str) -> Dict[str, object]:
     """Max-abs deviation of each derived artifact vs a reference
     ``cleaned_data/`` checkout; approximate (missing-source) factor
     columns are reported separately."""
@@ -205,14 +205,17 @@ def validate_against(res: CleanResult, ref_dir: str) -> Dict[str, float]:
     ref_hfd_total = ref_hfd.add(ref_rf["RF"], axis=0)
     fac_total = res.factor_etf[exact_cols].add(res.rf["RF"], axis=0)
     ref_fac_total = ref_fac[exact_cols].add(ref_rf["RF"], axis=0)
+    approx_corr = {
+        c: float(np.corrcoef(res.factor_etf[c].iloc[1:],
+                             ref_fac[c].iloc[1:])[0, 1])
+        for c in sorted(APPROXIMATE_TICKERS)}
     report = {
         "hfd_total": float(np.abs(hfd_total.values - ref_hfd_total.values).max()),
         "hfd_excess": float(np.abs(res.hfd.values - ref_hfd.values).max()),
         "rf": float(np.abs(res.rf.values - ref_rf.values).max()),
         "factor_total_exact_cols": float(
             np.abs(fac_total.values - ref_fac_total.values).max()),
-        "factor_approx_corr_min": float(min(
-            np.corrcoef(res.factor_etf[c].iloc[1:], ref_fac[c].iloc[1:])[0, 1]
-            for c in APPROXIMATE_TICKERS)),
+        "factor_approx_corr_min": min(approx_corr.values()),
+        "factor_approx_corr": approx_corr,
     }
     return report

@@ -26,6 +26,7 @@ import pandas as pd
 from hfrep_tpu.config import DataConfig
 from hfrep_tpu.core import scaler as mm
 from hfrep_tpu.core.sampling import sample_windows
+from hfrep_tpu.utils.safe_pickle import safe_pickle_load
 
 
 def read_csv(loc, date: bool = True) -> pd.DataFrame:
@@ -38,13 +39,20 @@ def read_csv(loc, date: bool = True) -> pd.DataFrame:
 
 
 def dic_read(loc) -> dict:
-    """Pickle load (``helper.py:26-29``)."""
+    """Pickle load (``helper.py:26-29``) via the restricted unpickler —
+    reference pickles are untrusted, plain-data-only content."""
     with open(loc, "rb") as f:
-        return pickle.load(f)
+        return safe_pickle_load(f)
 
 
 def dic_save(dic: dict, loc) -> dict:
-    """Pickle dump with read-back verification (``helper.py:155-162``)."""
+    """Pickle dump with read-back verification (``helper.py:155-162``).
+
+    The read-back goes through the restricted unpickler, which doubles as
+    an invariant check: anything saved here must stay loadable from an
+    *untrusted* checkout, so only plain data (builtins + numpy arrays) is
+    accepted — a dict holding e.g. datetime objects fails the read-back
+    by design."""
     with open(loc, "wb") as f:
         pickle.dump(dic, f)
     return dic_read(loc)

@@ -265,6 +265,15 @@ def cmd_sweep(args) -> int:
         report.multiplot(p, actual, panel.hf_names,
                          os.path.join(args.out, "cumulative_returns.png"))
         print(f"plot: {os.path.join(args.out, 'cumulative_returns.png')}")
+        # AE training diagnostics (Autoencoder_encapsulate.py:97-105 parity)
+        path = report.ae_loss_curves(result.train_loss, result.val_loss,
+                                     result.latent_dims,
+                                     os.path.join(args.out, "ae_loss_curves.png"))
+        print(f"plot: {path}")
+        # Omega curves of the best-latent replication vs the actual index
+        path = report.omega_curve_grid(p, actual, panel.hf_names,
+                                       os.path.join(args.out, "omega_curves.png"))
+        print(f"plot: {path}")
     if args.stats:
         rf_aligned = np.asarray(rf_test).reshape(-1)[-p.shape[0]:]
         # Spanning set = the factor/ETF universe, exactly the notebook's

@@ -45,6 +45,75 @@ def multiplot(replication: np.ndarray, actual: np.ndarray,
     return path
 
 
+def ae_loss_curves(train_loss: np.ndarray, val_loss: np.ndarray,
+                   latent_dims: Sequence[int], path: str, ncols: int = 4) -> str:
+    """Per-latent AE train/val loss curves — parity with the reference's
+    training-diagnostic plots (``Autoencoder_encapsulate.py:97-105``,
+    rendered per model at ``autoencoder_v4.ipynb`` cell 6).  Loss traces
+    are NaN after the early stop, so each panel naturally ends at its own
+    stopping epoch."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    n = len(latent_dims)
+    nrows = -(-n // ncols)
+    fig, axes = plt.subplots(nrows, ncols, figsize=(3.6 * ncols, 2.6 * nrows),
+                             squeeze=False)
+    for j in range(nrows * ncols):
+        ax = axes[j // ncols][j % ncols]
+        if j >= n:
+            ax.axis("off")
+            continue
+        tl, vl = np.asarray(train_loss[j]), np.asarray(val_loss[j])
+        live = np.isfinite(tl)
+        ax.plot(np.arange(len(tl))[live], tl[live], label="train")
+        ax.plot(np.arange(len(vl))[live], vl[live], label="val")
+        ax.set_title(f"latent={latent_dims[j]}", fontsize=9)
+        ax.set_yscale("log")
+        ax.legend(fontsize=7)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def omega_curve_grid(replication: np.ndarray, actual: np.ndarray,
+                     names: Sequence[str], path: str, ncols: int = 3,
+                     thresholds=None,
+                     labels: tuple = ("replication", "actual")) -> str:
+    """Omega-ratio curves per strategy (the notebook's ``Omega_Curve``
+    flow, cell 23/38): Ω(τ) for replication vs actual index over a
+    threshold grid."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    thresholds = thresholds if thresholds is not None else np.linspace(0, 0.2, 50)
+    rep_curves = perf_stats.omega_curve(replication, thresholds)   # (T, S)
+    act_curves = perf_stats.omega_curve(actual, thresholds)
+    s = replication.shape[1]
+    nrows = -(-s // ncols)
+    fig, axes = plt.subplots(nrows, ncols, figsize=(4.2 * ncols, 3.0 * nrows),
+                             squeeze=False)
+    for j in range(nrows * ncols):
+        ax = axes[j // ncols][j % ncols]
+        if j >= s:
+            ax.axis("off")
+            continue
+        ax.plot(thresholds, rep_curves[:, j], label=labels[0])
+        ax.plot(thresholds, act_curves[:, j], label=labels[1])
+        ax.set_title(names[j], fontsize=9)
+        ax.set_xlabel("threshold", fontsize=7)
+        ax.legend(fontsize=7)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def stats_table(returns: np.ndarray, names: Sequence[str], rf=None,
                 ff3_path: Optional[str] = None, ff5_path: Optional[str] = None,
                 span: Optional[np.ndarray] = None,

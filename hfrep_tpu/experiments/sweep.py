@@ -7,8 +7,9 @@ strategy (``ante``), cost-adjust it (``post``), compute turnover, and
 tabulate performance stats; finally ``res_sort`` picks the best latent
 per strategy by Sharpe (cell 27).  That is 21 serial Keras fits plus
 O(T) ``predict`` loops; here all 21 trainings run as ONE vmapped XLA
-program (:func:`hfrep_tpu.replication.engine.sweep_autoencoders`) and the
-per-latent evaluations reuse a single engine's jitted evaluators.
+program (:func:`hfrep_tpu.replication.engine.sweep_autoencoders`) and all
+21 evaluations as ONE more
+(:func:`hfrep_tpu.replication.engine.sweep_evaluate`).
 """
 
 from __future__ import annotations
@@ -24,7 +25,11 @@ import numpy as np
 
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.models.autoencoder import latent_mask
-from hfrep_tpu.replication.engine import ReplicationEngine, sweep_autoencoders
+from hfrep_tpu.replication.engine import (
+    ReplicationEngine,
+    sweep_autoencoders,
+    sweep_evaluate,
+)
 from hfrep_tpu.replication import perf_stats
 
 
@@ -45,6 +50,8 @@ class SweepResult:
     sharpe_ante: np.ndarray     # (L, S)
     sharpe_post: np.ndarray     # (L, S)
     stop_epoch: np.ndarray      # (L,) early-stopping epoch per training
+    train_loss: Optional[np.ndarray] = None   # (L, epochs), NaN after stop
+    val_loss: Optional[np.ndarray] = None     # (L, epochs)
 
     def best_by_sharpe(self, ex_post: bool = True) -> Dict[str, dict]:
         """``res_sort`` (cell 27): best latent per strategy by Sharpe."""
@@ -80,6 +87,9 @@ class SweepResult:
                 os.path.join(out_dir, f"{name}.csv"))
         np.save(os.path.join(out_dir, "ante.npy"), self.ante)
         np.save(os.path.join(out_dir, "post.npy"), self.post)
+        if self.train_loss is not None:
+            np.save(os.path.join(out_dir, "train_loss.npy"), self.train_loss)
+            np.save(os.path.join(out_dir, "val_loss.npy"), self.val_loss)
         with open(os.path.join(out_dir, "summary.json"), "w") as f:
             json.dump(self.summary(), f, indent=2, default=str)
 
@@ -105,41 +115,29 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
     engine = ReplicationEngine(x_train, y_train, x_test, y_test, cfg)
     swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
 
-    n_l = len(latent_dims)
-    rows = {k: [] for k in ["is_r2", "is_rmse", "oos_r2_mean", "oos_r2_max",
-                            "oos_rmse_mean", "ante", "post", "turnover",
-                            "sharpe_ante", "sharpe_post"]}
-    for i, d in enumerate(latent_dims):
-        params_i = jax.tree_util.tree_map(lambda a: a[i], swept.params)
-        engine.use_params(params_i, latent_mask(d, max_latent))
-        rows["is_r2"].append(engine.model_IS_r2())
-        rows["is_rmse"].append(engine.model_IS_RMSE())
-        oos_r2 = engine.model_OOS_r2()
-        oos_rmse = engine.model_OOS_RMSE()
-        rows["oos_r2_mean"].append(float(np.mean(oos_r2)))
-        rows["oos_r2_max"].append(float(np.max(oos_r2)))
-        rows["oos_rmse_mean"].append(float(np.mean(oos_rmse)))
-        ante = engine.ante(rf_test)
-        post = engine.post(factor_full)
-        rows["ante"].append(ante)
-        rows["post"].append(post)
-        rows["turnover"].append(engine.turnover())
-        rows["sharpe_ante"].append(np.asarray(perf_stats.annualized_sharpe(
-            jnp.asarray(ante), jnp.asarray(rf_test, jnp.float32)[-ante.shape[0]:])))
-        rows["sharpe_post"].append(np.asarray(perf_stats.annualized_sharpe(
-            jnp.asarray(post), jnp.asarray(rf_test, jnp.float32)[-post.shape[0]:])))
+    # One compiled program evaluates every latent dim (IS/OOS metrics,
+    # ante/post, turnover, Sharpe) — vs the reference's 21-serial eval
+    # loop (autoencoder_v4.ipynb cell 24) and round 1's host-serial
+    # use_params loop.
+    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    ev = jax.device_get(sweep_evaluate(
+        engine.model, cfg, engine.x_train, engine.x_test, engine.y_test,
+        jnp.asarray(rf_test, jnp.float32), jnp.asarray(factor_full, jnp.float32),
+        swept.params, masks))
 
     names = list(strategy_names) if strategy_names is not None else [
-        f"strategy_{j}" for j in range(rows["ante"][0].shape[1])]
+        f"strategy_{j}" for j in range(ev["ante"].shape[2])]
     return SweepResult(
         latent_dims=latent_dims, strategy_names=names,
-        is_r2=np.asarray(rows["is_r2"]), is_rmse=np.asarray(rows["is_rmse"]),
-        oos_r2_mean=np.asarray(rows["oos_r2_mean"]),
-        oos_r2_max=np.asarray(rows["oos_r2_max"]),
-        oos_rmse_mean=np.asarray(rows["oos_rmse_mean"]),
-        ante=np.stack(rows["ante"]), post=np.stack(rows["post"]),
-        turnover=np.asarray(rows["turnover"]),
-        sharpe_ante=np.asarray(rows["sharpe_ante"]),
-        sharpe_post=np.asarray(rows["sharpe_post"]),
+        is_r2=np.asarray(ev["is_r2"]), is_rmse=np.asarray(ev["is_rmse"]),
+        oos_r2_mean=np.asarray(ev["oos_r2"]).mean(axis=1),
+        oos_r2_max=np.asarray(ev["oos_r2"]).max(axis=1),
+        oos_rmse_mean=np.asarray(ev["oos_rmse"]).mean(axis=1),
+        ante=np.asarray(ev["ante"]), post=np.asarray(ev["post"]),
+        turnover=np.asarray(ev["turnover"]),
+        sharpe_ante=np.asarray(ev["sharpe_ante"]),
+        sharpe_post=np.asarray(ev["sharpe_post"]),
         stop_epoch=np.asarray(swept.stop_epoch),
+        train_loss=np.asarray(swept.train_loss),
+        val_loss=np.asarray(swept.val_loss),
     )

@@ -21,6 +21,7 @@ Two reference bugs are fixed by default, each behind a
 from __future__ import annotations
 
 import functools
+import math
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -177,22 +178,66 @@ def _kolmogorov_sf(x: np.ndarray, terms: int = 101) -> np.ndarray:
     return np.clip(s, 0.0, 1.0)
 
 
+def _exact_ks2_pvalue(n: int, m: int, d: float) -> float:
+    """Exact two-sided two-sample KS p-value P(D ≥ d), in-repo.
+
+    Lattice-path count: a merged ordering of the two samples is a monotone
+    path (0,0)→(n,m); the KS statistic stays below ``d`` iff the path keeps
+    ``|i·m − j·n| < h·g`` where ``h = round(d·lcm(n,m))`` snaps ``d`` onto
+    the achievable lattice (all achievable statistics are multiples of
+    ``g/(n·m)``, ``g = gcd``).  The number of strictly-inside paths follows
+    the row recursion ``A[i][j] = A[i−1][j] + A[i][j−1]``, which over the
+    contiguous in-band column window is a plain cumulative sum — one numpy
+    cumsum per row, O(n·m) total.  Counts are renormalized against a running
+    log-scale so the DP cannot overflow (scipy's exact path can, and then
+    silently falls back); the final ratio to ``C(n+m, n)`` is formed in log
+    space via lgamma.  Matches ``scipy.stats.ks_2samp(method='exact')`` to
+    float precision (oracle-tested) without touching any private scipy API.
+    Absolute accuracy floors at ~1e-12 (the inside/total cancellation limit);
+    smaller p-values are reported as that noise floor rather than their true
+    magnitude — indistinguishable for any accept/reject use of the metric.
+    Reference semantics: ``GAN_eval.py:267-288`` uses ``scipy.stats.kstest``
+    whose auto mode takes this exact path at these sample sizes.
+    """
+    g = math.gcd(n, m)
+    lcm = (n // g) * m
+    h = int(round(d * lcm))
+    if h == 0:
+        return 1.0
+    band_lim = h * g  # inside ⇔ |i·m − j·n| < band_lim
+    j_idx = np.arange(m + 1)
+    # row i = 0: inside while j·n < band_lim — a contiguous prefix of ones.
+    row = ((j_idx * n) < band_lim).astype(np.float64)
+    log_scale = 0.0
+    for i in range(1, n + 1):
+        inside = np.abs(i * m - j_idx * n) < band_lim
+        lo = int(np.argmax(inside))             # band is one contiguous window
+        hi = lo + int(np.sum(inside))
+        nxt = np.zeros(m + 1)
+        nxt[lo:hi] = np.cumsum(row[lo:hi])
+        row = nxt
+        peak = row[hi - 1] if hi > lo else 0.0
+        if peak > 1e290:
+            row *= 1e-290
+            log_scale += 290.0 * math.log(10.0)
+        elif peak == 0.0:                       # band pinched shut: no inside path
+            return 1.0
+    if row[m] <= 0.0:
+        return 1.0
+    log_inside = math.log(row[m]) + log_scale
+    log_total = math.lgamma(n + m + 1) - math.lgamma(n + 1) - math.lgamma(m + 1)
+    return float(np.clip(-math.expm1(log_inside - log_total), 0.0, 1.0))
+
+
 def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto") -> np.ndarray:
     if method not in ("auto", "exact", "asymp"):
         raise ValueError(f"method must be auto|exact|asymp, got {method!r}")
+    if method == "exact" or (method == "auto" and max(n, m) <= 10000):
+        return np.array([_exact_ks2_pvalue(n, m, float(d)) for d in stats])
     try:
         from scipy.stats import distributions as _dist
     except ImportError:  # pragma: no cover - scipy is present in CI image
         return _kolmogorov_sf(np.sqrt(n * m / (n + m)) * stats)
-    if method == "exact" or (method == "auto" and max(n, m) <= 10000):
-        # scipy's exact two-sample path (hypergeometric recursion)
-        import scipy.stats._stats_py as _sp
-        g = np.gcd(n, m)
-        out = np.empty_like(stats)
-        for i, d in enumerate(stats):
-            success, _, prob = _sp._attempt_exact_2kssamp(n, m, g, float(d), "two-sided")
-            out[i] = prob if success else _dist.kstwo.sf(d, np.round(n * m / (n + m)))
-        return np.clip(out, 0.0, 1.0)
     return np.clip(_dist.kstwo.sf(stats, np.round(n * m / (n + m))), 0.0, 1.0)
 
 

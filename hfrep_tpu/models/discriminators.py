@@ -93,9 +93,13 @@ def _plain_stack(parent_dtype, hidden, x, backend):
     l2 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_1")
     if kernel_eligible(backend, parent_dtype or x.dtype):
         from hfrep_tpu.ops.pallas_lstm_stack import pallas_keras_lstm_stack
+        # The fused kernel takes one activation for both layers; feed the
+        # layers' own setting so the fused and layer-by-layer branches can
+        # never silently diverge if the KerasLSTM default changes.
+        assert l1.activation == l2.activation, (l1.activation, l2.activation)
         return pallas_keras_lstm_stack(l1(materialize=x.shape[-1]),
                                        l2(materialize=hidden),
-                                       x, activation="tanh")
+                                       x, activation=l1.activation)
     return l2(l1(x, backend=backend), backend=backend)
 
 

@@ -120,6 +120,7 @@ class KerasLSTM(nn.Module):
         def cell(carry, xz_t):
             return lstm_cell_step(carry, xz_t, recurrent=rec, act=act, rec_act=rec_act)
 
-        init = (jnp.zeros((b, h), dtype), jnp.zeros((b, h), dtype))
+        from hfrep_tpu.utils.vma import match_vma
+        init = match_vma((jnp.zeros((b, h), dtype), jnp.zeros((b, h), dtype)), xz)
         _, hs = lax.scan(cell, init, xz)
         return jnp.swapaxes(hs, 0, 1)              # back to (B, W, H)

@@ -45,6 +45,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from hfrep_tpu.utils.vma import shape_struct
+
 LANE = 128
 
 _ACT = {
@@ -149,7 +151,7 @@ def _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True):
     w, b, g = xz.shape
     hp = g // 4
     t_spec = pl.BlockSpec((1, b, hp), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
-    t_shape = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    t_shape = shape_struct((w, b, hp), jnp.float32, (xz, rec))
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, activation, with_cs),
         grid=(w,),
@@ -244,11 +246,11 @@ def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation, with_carries=False):
     operands = [xz, rec, rec.T, h_prev, c_prev, cs, dhs] + ([dcs] if with_dcs else [])
     out_specs = [pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                  pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)]
-    out_shape = [jax.ShapeDtypeStruct((w, b, g), jnp.float32),
-                 jax.ShapeDtypeStruct((hp, g), jnp.float32)]
+    out_shape = [shape_struct((w, b, g), jnp.float32, operands),
+                 shape_struct((hp, g), jnp.float32, operands)]
     if with_carries:
         out_specs += [t_in, t_in]
-        out_shape += [jax.ShapeDtypeStruct((w, b, hp), jnp.float32)] * 2
+        out_shape += [shape_struct((w, b, hp), jnp.float32, operands)] * 2
     out = pl.pallas_call(
         functools.partial(_bwd_kernel, activation, with_dcs, with_carries),
         grid=(w,),
@@ -379,8 +381,9 @@ def _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation):
     t_g = pl.BlockSpec((1, b, g), nat, memory_space=pltpu.VMEM)
     mat_hg = pl.BlockSpec((hp, g), const, memory_space=pltpu.VMEM)
     mat_gh = pl.BlockSpec((g, hp), const, memory_space=pltpu.VMEM)
-    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
-    sh_g = jax.ShapeDtypeStruct((w, b, g), jnp.float32)
+    _ops = (xz, rec, v_mat, h_prev, c_prev, cs, u, dhT_seq, dcT_seq)
+    sh_h = shape_struct((w, b, hp), jnp.float32, _ops)
+    sh_g = shape_struct((w, b, g), jnp.float32, _ops)
     uxz, uhp, ucp, uc, udhs, urec = pl.pallas_call(
         functools.partial(_adj_kernel, activation),
         grid=(w,),
@@ -388,7 +391,7 @@ def _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation):
                   t_h, t_h, t_h, t_g, t_h, t_h],
         out_specs=[t_g, t_h, t_h, t_h, t_h, mat_hg],
         out_shape=[sh_g, sh_h, sh_h, sh_h, sh_h,
-                   jax.ShapeDtypeStruct((hp, g), jnp.float32)],
+                   shape_struct((hp, g), jnp.float32, _ops)],
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
                         pltpu.VMEM((b, hp), jnp.float32)],
         interpret=_interpret(),

@@ -41,6 +41,7 @@ from hfrep_tpu.ops.pallas_lstm import (
     _supported,
     pad_keras_params,
 )
+from hfrep_tpu.utils.vma import shape_struct
 
 
 def _gates(z, act_name):
@@ -101,7 +102,7 @@ def _stack_fwd_impl(xz1, rec1, k2, b2, rec2, activation, with_res):
     w, b, g = xz1.shape
     hp = g // 4
     t_h = pl.BlockSpec((1, b, hp), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
-    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    sh_h = shape_struct((w, b, hp), jnp.float32, (xz1, rec1, k2, b2, rec2))
     mat = pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
     row = pl.BlockSpec((1, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
     n_out = 4 if with_res else 1
@@ -206,14 +207,14 @@ def _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
         operands += list(directs)        # (dhs1, dcs1, dcs2)
         in_specs += [t_h] * 3
     out_specs = [t_g, mat, mat, row, mat]
-    out_shape = [jax.ShapeDtypeStruct((w, b, g), jnp.float32),
-                 jax.ShapeDtypeStruct((hp, g), jnp.float32),
-                 jax.ShapeDtypeStruct((hp, g), jnp.float32),
-                 jax.ShapeDtypeStruct((1, g), jnp.float32),
-                 jax.ShapeDtypeStruct((hp, g), jnp.float32)]
+    out_shape = [shape_struct((w, b, g), jnp.float32, operands),
+                 shape_struct((hp, g), jnp.float32, operands),
+                 shape_struct((hp, g), jnp.float32, operands),
+                 shape_struct((1, g), jnp.float32, operands),
+                 shape_struct((hp, g), jnp.float32, operands)]
     if with_carries:
         out_specs += [t_h] * 4
-        out_shape += [jax.ShapeDtypeStruct((w, b, hp), jnp.float32)] * 4
+        out_shape += [shape_struct((w, b, hp), jnp.float32, operands)] * 4
     out = pl.pallas_call(
         functools.partial(_stack_bwd_kernel, activation, with_direct,
                           with_carries),
@@ -355,7 +356,9 @@ def _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
     mat = pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
     mat_t = pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)
     row = pl.BlockSpec((1, g), lambda t: (0, 0), memory_space=pltpu.VMEM)
-    sh_h = jax.ShapeDtypeStruct((w, b, hp), jnp.float32)
+    _ops = (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+            dhT1s, dcT1s, dhT2s, dcT2s, u1, vr1, vk2, vb2, vr2)
+    sh_h = shape_struct((w, b, hp), jnp.float32, _ops)
     outs = pl.pallas_call(
         functools.partial(_stack_adj_kernel, activation),
         grid=(w,),
@@ -363,12 +366,12 @@ def _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
                   mat, mat_t, mat, mat_t, row, mat, mat_t]
                  + [t_h] * 7 + [t_g] + [t_h] * 4,
         out_specs=[t_g] + [t_h] * 8 + [mat, mat, row, mat],
-        out_shape=[jax.ShapeDtypeStruct((w, b, g), jnp.float32)]
+        out_shape=[shape_struct((w, b, g), jnp.float32, _ops)]
                   + [sh_h] * 8
-                  + [jax.ShapeDtypeStruct((hp, g), jnp.float32),
-                     jax.ShapeDtypeStruct((hp, g), jnp.float32),
-                     jax.ShapeDtypeStruct((1, g), jnp.float32),
-                     jax.ShapeDtypeStruct((hp, g), jnp.float32)],
+                  + [shape_struct((hp, g), jnp.float32, _ops),
+                     shape_struct((hp, g), jnp.float32, _ops),
+                     shape_struct((1, g), jnp.float32, _ops),
+                     shape_struct((hp, g), jnp.float32, _ops)],
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32)] * 4,
         interpret=_interpret(),
     )(xz1, rec1, rec1.T, k2, k2.T, b2.reshape(1, g), rec2, rec2.T,

@@ -3,18 +3,24 @@
 Design (SURVEY §5.8): the global batch (reference: 32,
 ``GAN/MTSS_WGAN_GP.py:292``) is split evenly across the ``dp`` axis; each
 device samples its own batch shard and noise with a per-device folded
-PRNG key, computes local gradients, and the train step `pmean`s gradients
-inside — so every device applies the identical update and parameter /
-optimizer state stay replicated without any explicit broadcast.  Losses
-are `pmean`'d for logging.  The window dataset (≤7 MB) is replicated;
-sampling indices differ per device, which is exactly the reference's
-i.i.d.-batch semantics at global-batch granularity.
+PRNG key and computes local gradients.  Under ``check_vma=True``'s type
+system the backward pass cross-device-sums those gradients automatically
+(the transpose of broadcasting replicated params into varying data is a
+psum), so the train step only divides by the axis size
+(``steps._psum_if``) — every device then applies the identical
+global-batch-mean update and parameter / optimizer state stay replicated
+without any explicit broadcast, a fact the static checker *proves* at
+trace time.  Losses are `pmean`'d for logging.  The window dataset
+(≤7 MB) is replicated; sampling indices differ per device, which is
+exactly the reference's i.i.d.-batch semantics at global-batch
+granularity.
 
-Single-device equivalence: with mean-of-shard losses, pmean-of-gradients
-equals the global-batch gradient, so dp=N at global batch B matches dp=1
-at batch B in expectation (bitwise for the loss surface; batch membership
-differs because each device draws its own indices).  This is tested on an
-8-way virtual CPU mesh in ``tests/test_parallel.py``.
+Single-device equivalence: axis-normalized gradients of mean-of-shard
+losses equal the global-batch gradient, so dp=N at global batch B
+matches dp=1 at batch B in expectation — and *exactly* (to f32
+round-off) under ``controlled_sampling=True``, which
+``tests/test_parallel.py`` uses to assert full trajectory + final-params
+equivalence on an 8-way virtual CPU mesh.
 """
 
 from __future__ import annotations
@@ -34,12 +40,26 @@ from hfrep_tpu.train.states import GanState
 from hfrep_tpu.train.steps import make_multi_step
 
 
-def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray, mesh: Mesh):
+def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
+                       mesh: Mesh, controlled_sampling: bool = False):
     """Build the jitted data-parallel multi-epoch step.
 
     Returns ``fn(state, key) -> (state, metrics)`` where ``state`` is
     replicated over the mesh and ``metrics`` are global (pmean'd) with one
     entry per inner epoch.
+
+    ``controlled_sampling=True`` draws the *global* batch identically on
+    every device (shared key) and feeds each device its shard — the dp
+    run then follows the exact sample stream of a single-device run at
+    the same global batch, making full trajectories comparable
+    (``tests/test_parallel.py``).  Default is i.i.d. per-device sampling
+    (key folded by mesh position): cheaper, same semantics at
+    global-batch granularity.
+
+    Static replication safety: ``check_vma=True`` — the checker proves at
+    trace time that parameters and optimizer state stay replicated across
+    the mesh (pmean'd gradients ⇒ invariant updates), with loop carries
+    pre-cast to their true variance (:mod:`hfrep_tpu.utils.vma`).
     """
     (axis_name,) = mesh.axis_names
     n_dev = mesh.devices.size
@@ -47,10 +67,13 @@ def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray, m
         raise ValueError(
             f"global batch {tcfg.batch_size} not divisible by dp={n_dev}")
     local_tcfg = dataclasses.replace(tcfg, batch_size=tcfg.batch_size // n_dev)
-    inner = make_multi_step(pair, local_tcfg, dataset, axis_name=axis_name, jit=False)
+    inner = make_multi_step(
+        pair, local_tcfg, dataset, axis_name=axis_name, jit=False,
+        sample_batch=tcfg.batch_size if controlled_sampling else None)
 
     def per_device(state: GanState, key: jax.Array) -> Tuple[GanState, dict]:
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        if not controlled_sampling:
+            key = jax.random.fold_in(key, lax.axis_index(axis_name))
         state, metrics = inner(state, key)
         return state, lax.pmean(metrics, axis_name)
 
@@ -58,9 +81,6 @@ def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray, m
         per_device, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P()),
-        # The varying-manual-axis checker would demand pcast annotations in
-        # every scan carry (LSTM cells, fori_loop); replication of the
-        # outputs is guaranteed dynamically by the pmean'd gradients.
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(fn, donate_argnums=(0,))

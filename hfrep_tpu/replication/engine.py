@@ -136,6 +136,127 @@ def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfi
     return jax.vmap(lambda k, m: train_autoencoder(k, x_train_scaled, cfg, m))(keys, masks)
 
 
+# ----------------------------------------------------- pure evaluation
+def oos_prefix_metrics(model: Autoencoder, x_test: jnp.ndarray,
+                       params: dict, mask: jnp.ndarray):
+    """Per-prefix OOS R² and RMSE, all expanding windows as one batch.
+
+    Vectorization of ``Autoencoder_encapsulate.py:115-131``: for prefix
+    length i ∈ [2, T], MinMax-scale ``x_test[:i]`` with its own min/max
+    (prefix scans, no 167 scaler refits), reconstruct, and score — the
+    R²/RMSE reductions happen *inside* each prefix lane so nothing
+    (T, F)-sized leaves the program.  Pure in (params, mask): vmappable
+    across a latent sweep."""
+    t = x_test.shape[0]
+    mins, maxs = expanding_minmax_scale(x_test)
+    scale = jnp.where(maxs - mins == 0.0, 1.0, maxs - mins)
+
+    def one_prefix(i):
+        scaled = (x_test - mins[i - 1]) / scale[i - 1]
+        mask_rows = (jnp.arange(t) < i)[:, None]
+        pred = model.apply({"params": params}, scaled, mask)
+        r2 = _r2_columns_mean_masked(scaled, pred, mask_rows)
+        sq = jnp.sum((scaled - pred) ** 2 * mask_rows)
+        rmse = jnp.sqrt(sq / (jnp.sum(mask_rows) * x_test.shape[1]))
+        return r2, rmse
+
+    return jax.vmap(one_prefix)(jnp.arange(2, t))
+
+
+def ante_weights(model: Autoencoder, cfg: AEConfig, params: dict,
+                 mask: Optional[jnp.ndarray], x_test: jnp.ndarray,
+                 y_test: jnp.ndarray, rf: jnp.ndarray, window: int):
+    """Ex-ante replication returns + strategy weights, pure in
+    (params, mask) — the body of ``Autoencoder_encapsulate.py:133-201``
+    shared by :meth:`ReplicationEngine.ante` and the vmapped sweep
+    evaluation.  Returns ``(ante (P, S), weights (P, F, S))``."""
+    rf = jnp.asarray(rf, jnp.float32).reshape(-1, 1)
+    factors = model.apply({"params": params}, x_test, mask,
+                          method=Autoencoder.encode)            # raw-input encode, :140
+    betas = rolling_ols_beta(y_test, factors, window)           # (T-w+1, L, S)
+    n_windows = x_test.shape[0] - window                        # :148 range
+    betas = betas[:n_windows]
+
+    def norm_factor(i):
+        xw = lax.dynamic_slice_in_dim(factors, i, window)
+        yw = lax.dynamic_slice_in_dim(y_test, i, window)
+        return costs.normalization(yw, xw, betas[i], window)
+
+    norms = jax.vmap(norm_factor)(jnp.arange(n_windows))        # (n_windows, S)
+
+    w_dec = params["decoder_kernel"]                            # (L, F) factor→ETF map, :159
+    if mask is not None:
+        w_dec = w_dec * mask[:, None]
+
+    def month_weights(i, beta, norm):
+        # LeakyReLU mask from the *current* month's decoded sign, :163-166
+        decoded = factors[window + i] @ w_dec                   # (F,)
+        leaky = jnp.where(decoded < 0, cfg.leaky_slope, 1.0)
+        return (jnp.swapaxes(beta, 0, 1) @ w_dec * leaky[None, :]).T * norm[None, :]
+
+    if cfg.beta_mode == "first":
+        beta_used = jnp.broadcast_to(betas[0], betas.shape)
+        norm_used = jnp.broadcast_to(norms[0], norms.shape)
+    else:
+        beta_used, norm_used = betas, norms
+    weights = jax.vmap(month_weights)(jnp.arange(n_windows), beta_used, norm_used)
+
+    # last window has no realized month — drop it (:179-180)
+    weights = weights[:-1]                                      # (P, F, S)
+    p = weights.shape[0]
+    delta = 1.0 - jnp.sum(weights, axis=1)                      # (P, S)
+    ante = delta * rf[-p:] + jnp.einsum("pf,pfs->ps", x_test[-p:], weights)
+    return ante, weights
+
+
+def evaluate_params(model: Autoencoder, cfg: AEConfig, x_train_scaled, x_test,
+                    y_test, rf, factor_full, params: dict,
+                    mask: jnp.ndarray) -> dict:
+    """Every per-latent number the notebook's result cells need, as one
+    pure jnp program: IS/OOS fit metrics, ex-ante/ex-post replication
+    returns, turnover, and Sharpe ratios.  Pure in (params, mask) so a
+    latent sweep evaluates as a single vmapped XLA program
+    (:func:`sweep_evaluate`) instead of 21 host-serial eval passes."""
+    from hfrep_tpu.replication import perf_stats
+
+    pred_train = model.apply({"params": params}, x_train_scaled, mask)
+    is_r2 = _r2_columns_mean(x_train_scaled, pred_train)
+    is_rmse = jnp.sqrt(jnp.mean((x_train_scaled - pred_train) ** 2))
+    oos_r2, oos_rmse = oos_prefix_metrics(model, x_test, params, mask)
+
+    window = cfg.ols_window
+    ante, weights = ante_weights(model, cfg, params, mask, x_test, y_test,
+                                 rf, window)
+    p = ante.shape[0]
+    panel = jnp.asarray(factor_full, jnp.float32)[-(p + window):]
+    post = costs.ex_post_return(ante, window,
+                                jnp.transpose(weights, (2, 0, 1)), panel)
+    rf_tail = jnp.asarray(rf, jnp.float32).reshape(-1)[-p:]
+    return {
+        "is_r2": is_r2, "is_rmse": is_rmse,
+        "oos_r2": oos_r2, "oos_rmse": oos_rmse,
+        "ante": ante, "post": post,
+        "turnover": costs.turnover(weights),
+        "sharpe_ante": perf_stats.annualized_sharpe(ante, rf_tail),
+        "sharpe_post": perf_stats.annualized_sharpe(post, rf_tail),
+    }
+
+
+def sweep_evaluate(model: Autoencoder, cfg: AEConfig, x_train_scaled, x_test,
+                   y_test, rf, factor_full, stacked_params: dict,
+                   masks: jnp.ndarray) -> dict:
+    """Evaluate every latent dim of a sweep in ONE compiled program.
+
+    ``stacked_params``/``masks`` carry a leading sweep axis (the output of
+    :func:`sweep_autoencoders`); the result dict's arrays all lead with
+    that axis.  Replaces the reference's 21-serial eval loop
+    (``autoencoder_v4.ipynb`` cells 6/24) *and* round 1's host-serial
+    ``use_params → IS/OOS/ante/post/turnover`` loop."""
+    fn = lambda p, m: evaluate_params(model, cfg, x_train_scaled, x_test,
+                                      y_test, rf, factor_full, p, m)
+    return jax.jit(jax.vmap(fn))(stacked_params, masks)
+
+
 # ---------------------------------------------------------------- engine
 class ReplicationEngine:
     """The reference ``AE`` wrapper's full API on one trained model.
@@ -213,48 +334,25 @@ class ReplicationEngine:
         pred = self._apply(self.x_train)
         return float(jnp.sqrt(jnp.mean((self.x_train - pred) ** 2)))
 
-    def _oos_scaled_prefix_eval(self, params, mask):
-        """All expanding-window rescale+predict passes as one batch
-        (``Autoencoder_encapsulate.py:115-131`` vectorized): for prefix
-        length i ∈ [2, T], scale x_test[:i] with its own min/max, predict,
-        score — returns masked (T-2, T, F) actual/pred tensors.
-
-        ``params``/``mask`` are traced arguments (not baked constants) so
-        the compiled program survives retraining / param swaps."""
-        x = self.x_test
-        t = x.shape[0]
-        mins, maxs = expanding_minmax_scale(x)
-        scale = jnp.where(maxs - mins == 0.0, 1.0, maxs - mins)
-
-        def one_prefix(i):
-            scaled = (x - mins[i - 1]) / scale[i - 1]
-            mask_rows = (jnp.arange(t) < i)[:, None]
-            pred = self.model.apply({"params": params}, scaled, mask)
-            return scaled, pred, mask_rows
-
-        idx = jnp.arange(2, t)
-        return jax.vmap(one_prefix)(idx)
-
     def _oos_eval(self):
-        """Cached one-shot evaluation of the full expanding-window batch —
-        r2 and RMSE share the same forward pass and compiled program."""
+        """Cached expanding-window metrics — R² and RMSE share one
+        compiled program (:func:`oos_prefix_metrics`); ``params``/``mask``
+        are traced arguments (not baked constants) so the program survives
+        retraining / param swaps."""
         if self._oos_cache is None:
             if self._oos_eval_fn is None:
-                self._oos_eval_fn = jax.jit(self._oos_scaled_prefix_eval)
+                self._oos_eval_fn = jax.jit(
+                    lambda p, m: oos_prefix_metrics(self.model, self.x_test, p, m))
             mask = self.mask if self.mask is not None else jnp.ones(
                 (self.params["encoder_kernel"].shape[1],), jnp.float32)
             self._oos_cache = self._oos_eval_fn(self.params, mask)
         return self._oos_cache
 
     def model_OOS_r2(self) -> np.ndarray:
-        scaled, pred, mask_rows = self._oos_eval()
-        return np.asarray(jax.vmap(_r2_columns_mean_masked)(scaled, pred, mask_rows))
+        return np.asarray(self._oos_eval()[0])
 
     def model_OOS_RMSE(self) -> np.ndarray:
-        scaled, pred, mask_rows = self._oos_eval()
-        sq = jnp.sum((scaled - pred) ** 2 * mask_rows, axis=(1, 2))
-        n_elems = jnp.sum(mask_rows, axis=(1, 2)) * scaled.shape[2]
-        return np.asarray(jnp.sqrt(sq / n_elems))
+        return np.asarray(self._oos_eval()[1])
 
     # ------------------------------------------------------------ strategy
     def ante(self, rf, window: Optional[int] = None) -> np.ndarray:
@@ -264,49 +362,14 @@ class ReplicationEngine:
         the OLS beta and normalization factor of the *first* 24-month
         window are reused for every month (``:167`` indexes
         ``ae_ols_beta[0]``), only the LeakyReLU activation mask varies.
-        ``beta_mode='rolling'`` uses each window's own beta.
+        ``beta_mode='rolling'`` uses each window's own beta.  Body shared
+        with the vmapped sweep path via :func:`ante_weights`.
         """
         window = window or self.cfg.ols_window
-        rf = jnp.asarray(rf, jnp.float32).reshape(-1, 1)
-
-        factors = self._encode(self.x_test)                     # (T, L) raw-input encode, :140
-        betas = rolling_ols_beta(self.y_test, factors, window)  # (T-w+1, L, S)
-        n_windows = self.x_test.shape[0] - window               # :148 range
-        betas = betas[:n_windows]
-
-        def norm_factor(i):
-            xw = lax.dynamic_slice_in_dim(factors, i, window)
-            yw = lax.dynamic_slice_in_dim(self.y_test, i, window)
-            return costs.normalization(yw, xw, betas[i], window)
-
-        norms = jax.vmap(norm_factor)(jnp.arange(n_windows))    # (n_windows, S)
-
-        w_dec = self.params["decoder_kernel"]                   # (L, F) factor→ETF map, :159
-        if self.mask is not None:
-            w_dec = w_dec * self.mask[:, None]
-
-        def month_weights(i, beta, norm):
-            # LeakyReLU mask from the *current* month's decoded sign, :163-166
-            decoded = factors[window + i] @ w_dec               # (F,)
-            leaky = jnp.where(decoded < 0, self.cfg.leaky_slope, 1.0)
-            sw = (jnp.swapaxes(beta, 0, 1) @ w_dec * leaky[None, :]).T * norm[None, :]
-            return sw                                           # (F, S)
-
-        if self.cfg.beta_mode == "first":
-            beta_used = jnp.broadcast_to(betas[0], betas.shape)
-            norm_used = jnp.broadcast_to(norms[0], norms.shape)
-        else:
-            beta_used, norm_used = betas, norms
-        weights = jax.vmap(month_weights)(jnp.arange(n_windows), beta_used, norm_used)
-
-        # last window has no realized month — drop it (:179-180)
-        weights = weights[:-1]                                   # (P, F, S)
+        ante, weights = ante_weights(self.model, self.cfg, self.params,
+                                     self.mask, self.x_test, self.y_test,
+                                     jnp.asarray(rf, jnp.float32), window)
         p = weights.shape[0]
-        delta = 1.0 - jnp.sum(weights, axis=1)                   # (P, S)
-        oos_etf = self.x_test[-p:]
-        oos_rf = rf[-p:]
-        ante = delta * oos_rf + jnp.einsum("pf,pfs->ps", oos_etf, weights)
-
         self._strat_weights = weights
         self._ante = ante
         self.window = window

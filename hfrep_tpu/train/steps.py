@@ -52,14 +52,39 @@ from jax import lax
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
 from hfrep_tpu.train.states import GanState, make_optimizers
+from hfrep_tpu.utils.vma import match_vma
 
 Metrics = dict
 
 
 def _psum_if(axis_name: Optional[str], grads):
+    """Per-shard gradients → global-batch-mean gradients.
+
+    Under `shard_map(check_vma=True)`'s type system the backward pass may
+    have *already* cross-device-summed a gradient leaf: replicated params
+    are implicitly pcast into the varying batch at every mixing op, and
+    the transpose of that broadcast is a psum — `jax.grad` of a shard-mean
+    loss w.r.t. replicated params then returns Σ_d ∂(shard-mean), typed
+    *invariant*.  Custom-vjp paths (the pallas LSTM kernels) return their
+    hand-computed per-device cotangents instead, typed *varying*.  Each
+    leaf's vma says exactly which case it is: varying leaves need the
+    explicit pmean, invariant leaves only the axis-size division.  (A
+    blanket pmean would be an identity on already-invariant leaves and
+    leave those gradients n_dev× too large — masked by Adam/RMSprop's
+    scale invariance except through eps, but wrong; the dp-vs-single
+    trajectory test pins both cases.)
+    """
     if axis_name is None:
         return grads
-    return lax.pmean(grads, axis_name)
+    from hfrep_tpu.utils.vma import vma_of
+    n = lax.axis_size(axis_name)
+
+    def norm(g):
+        if axis_name in vma_of(g):
+            return lax.pmean(g, axis_name)      # per-device grad → mean
+        return g / n                            # AD already psum'd
+
+    return jax.tree_util.tree_map(norm, grads)
 
 
 def _bce_logits(logits: jnp.ndarray, label: float) -> jnp.ndarray:
@@ -95,8 +120,20 @@ def resolve_lstm_backend(choice: str) -> str:
 
 
 def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                    axis_name: Optional[str] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
-    """Build ``step(state, key) -> (state, metrics)`` for one epoch."""
+                    axis_name: Optional[str] = None,
+                    sample_batch: Optional[int] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
+    """Build ``step(state, key) -> (state, metrics)`` for one epoch.
+
+    ``sample_batch`` (> ``tcfg.batch_size``, dp only) switches to
+    *controlled global sampling*: every device draws the identical
+    ``sample_batch``-row batch/noise/α with the shared key and then takes
+    its own ``batch``-row shard by mesh position.  With pmean'd gradients
+    this makes a dp=N run consume exactly the same sample stream as a
+    single-device run at ``batch_size=sample_batch`` — the basis of the
+    dp-vs-single-device trajectory equivalence test.  Default (None) is
+    i.i.d. per-device sampling: same semantics at global-batch
+    granularity, no duplicated sampling work.
+    """
     g_tx, d_tx = make_optimizers(pair, tcfg)
     # Every site — including the gradient penalty's second-order
     # ∂/∂θ ∇_x c path — runs the resolved backend: the pallas LSTM is
@@ -107,8 +144,42 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     g_apply = lambda p, z, backend=be: pair.generator.apply({"params": p}, z, backend=backend)
     d_apply = lambda p, x, backend=be: pair.discriminator.apply({"params": p}, x, backend=backend)
     batch = tcfg.batch_size
+    sample_b = sample_batch if sample_batch is not None else batch
+    if sample_b != batch and axis_name is None:
+        raise ValueError("sample_batch != batch_size requires a mesh axis")
     window, features = dataset.shape[1], dataset.shape[2]
-    noise_shape = (batch, window, features)
+    noise_shape = (sample_b, window, features)
+
+    def _shard(x):
+        """Global (sample_b, …) tensor → this device's (batch, …) rows."""
+        if sample_b == batch:
+            return x
+        n = lax.axis_size(axis_name)    # static at trace time
+        if sample_b != batch * n:
+            raise ValueError(
+                f"sample_batch={sample_b} must equal batch_size={batch} × "
+                f"axis_size={n}; dynamic_slice would silently clamp "
+                "out-of-range shards onto duplicated rows")
+        i = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(x, i * batch, batch, axis=0)
+
+    def _real(key):
+        return _shard(_sample_real(key, dataset, sample_b))
+
+    def _noise(key):
+        return _shard(jax.random.normal(key, noise_shape))
+
+    def _alpha(key):
+        return _shard(jax.random.uniform(key, (sample_b, 1, 1)))
+
+    def _loop_init(key):
+        """Initial (noise, d_loss) carry for the critic fori_loops, cast to
+        the per-device variance the loop body will produce: the body's
+        values vary over the mesh through the folded key (i.i.d. mode) or
+        through the axis_index batch shard (controlled mode), so the plain
+        zeros init must be pre-cast for `shard_map(check_vma=True)`."""
+        noise0 = match_vma(_shard(jnp.zeros(noise_shape)), key)
+        return noise0, match_vma(jnp.zeros(()), noise0)
 
     def d_update(d_params, d_opt, loss_fn):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(d_params)
@@ -126,8 +197,8 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     # ------------------------------------------------------------------ bce
     def bce_step(state: GanState, key: jax.Array):
         k_idx, k_z1, k_z2 = jax.random.split(key, 3)
-        real = _sample_real(k_idx, dataset, batch)
-        fake = g_apply(state.g_params, jax.random.normal(k_z1, noise_shape))
+        real = _real(k_idx)
+        fake = g_apply(state.g_params, _noise(k_z1))
 
         def loss_real(p):
             logits = d_apply(p, real)
@@ -142,7 +213,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
-            return _bce_logits(d_apply(state.d_params, g_apply(p, jax.random.normal(k_z2, noise_shape))), 1.0), None
+            return _bce_logits(d_apply(state.d_params, g_apply(p, _noise(k_z2))), 1.0), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": 0.5 * (l_real + l_fake),
@@ -156,8 +227,8 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             d_params, d_opt, _ = carry
             k = jax.random.fold_in(key, i)
             k_idx, k_z = jax.random.split(k)
-            real = _sample_real(k_idx, dataset, batch)
-            noise = jax.random.normal(k_z, noise_shape)
+            real = _real(k_idx)
+            noise = _noise(k_z)
             fake = lax.stop_gradient(g_apply(state.g_params, noise))
 
             def loss_real(p):
@@ -171,10 +242,9 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             d_params = jax.tree_util.tree_map(lambda w: jnp.clip(w, -clip, clip), d_params)
             return d_params, d_opt, (noise, 0.5 * (l_real + l_fake))
 
-        dummy_noise = jnp.zeros(noise_shape)
         d_params, d_opt, (noise, d_loss) = lax.fori_loop(
             0, tcfg.n_critic, critic_iter,
-            (state.d_params, state.d_opt, (dummy_noise, jnp.zeros(()))))
+            (state.d_params, state.d_opt, _loop_init(key)))
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
@@ -209,18 +279,17 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             d_params, d_opt, _ = carry
             k = jax.random.fold_in(key, i)
             k_idx, k_z, k_a = jax.random.split(k, 3)
-            real = _sample_real(k_idx, dataset, batch)
-            noise = jax.random.normal(k_z, noise_shape)
-            alpha = jax.random.uniform(k_a, (batch, 1, 1))
+            real = _real(k_idx)
+            noise = _noise(k_z)
+            alpha = _alpha(k_a)
 
             loss_fn = lambda p: gp_critic_loss(p, state.g_params, real, noise, alpha)
             d_params, d_opt, loss, _ = d_update(d_params, d_opt, loss_fn)
             return d_params, d_opt, (noise, loss)
 
-        dummy_noise = jnp.zeros(noise_shape)
         d_params, d_opt, (noise, d_loss) = lax.fori_loop(
             0, tcfg.n_critic, critic_iter,
-            (state.d_params, state.d_opt, (dummy_noise, jnp.zeros(()))))
+            (state.d_params, state.d_opt, _loop_init(key)))
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
@@ -234,13 +303,14 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
 
 
 def make_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                    axis_name: Optional[str] = None, jit: bool = True):
+                    axis_name: Optional[str] = None, jit: bool = True,
+                    sample_batch: Optional[int] = None):
     """Scan ``steps_per_call`` epochs into one compiled program.
 
     Returns ``fn(state, key) -> (state, stacked_metrics)``; metrics carry
     one entry per inner epoch so per-epoch logging survives the batching.
     """
-    step = make_train_step(pair, tcfg, dataset, axis_name)
+    step = make_train_step(pair, tcfg, dataset, axis_name, sample_batch)
     n = tcfg.steps_per_call
 
     def multi(state: GanState, key: jax.Array):

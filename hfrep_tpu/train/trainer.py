@@ -93,35 +93,58 @@ class GanTrainer:
                 self.timer.stop(steady_steps, sync_on=self.state.g_params)
                 steady_steps = 0
 
-        while done < n_full:
-            self.key, sub = jax.random.split(self.key)
-            warm_block = not self._multi_warm
-            if warm_block or self.nan_guard:
-                close_steady()
-                self.timer.start()
-                metrics = self._guarded(self._multi, sub)
-                if metrics is None:
-                    continue                # guard tripped: block retried
-                self.timer.stop(spc, sync_on=self.state.g_params,
-                                warmup=warm_block)
-                self._multi_warm = True
-                flush_pending()
-                self._log_block(metrics, spc, self.epoch)
-            else:
-                if steady_steps == 0:
+        pipeline_ok = False
+        try:
+            while done < n_full:
+                self.key, sub = jax.random.split(self.key)
+                warm_block = not self._multi_warm
+                if warm_block or self.nan_guard:
+                    close_steady()
                     self.timer.start()
-                metrics = self._guarded(self._multi, sub)   # async dispatch
-                flush_pending()             # overlaps with device compute
-                pending = (metrics, self.epoch)
-                steady_steps += spc
-            self.epoch += spc
-            done += 1
-            if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < spc:
-                close_steady()      # sync first: keep host logging out of the window
-                flush_pending()
-                self.save_checkpoint()
-        close_steady()
-        flush_pending()
+                    metrics = self._guarded(self._multi, sub)
+                    if metrics is None:
+                        continue                # guard tripped: block retried
+                    self.timer.stop(spc, sync_on=self.state.g_params,
+                                    warmup=warm_block)
+                    self._multi_warm = True
+                    flush_pending()
+                    self._log_block(metrics, spc, self.epoch)
+                else:
+                    if steady_steps == 0:
+                        self.timer.start()
+                    metrics = self._guarded(self._multi, sub)   # async dispatch
+                    flush_pending()             # overlaps with device compute
+                    pending = (metrics, self.epoch)
+                    steady_steps += spc
+                self.epoch += spc
+                done += 1
+                if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < spc:
+                    close_steady()  # sync first: keep host logging out of the window
+                    flush_pending()
+                    self.save_checkpoint()
+            close_steady()
+            flush_pending()
+            pipeline_ok = True
+        finally:
+            if not pipeline_ok:
+                # An exception escaped the pipelined loop (device error
+                # surfacing on a later dispatch, or a checkpoint failure):
+                # drain the pending block's metrics and the open timing
+                # window best-effort so history/JSONL don't silently drop
+                # up to steps_per_call epochs, without masking the
+                # propagating exception with a cleanup failure.
+                try:
+                    close_steady()
+                except Exception:
+                    pass
+                try:
+                    flush_pending()
+                except Exception:
+                    pass
+                try:
+                    self.logger.flush()
+                except Exception:
+                    pass
         done = 0
         while done < remainder:
             # exact epoch counts: leftover epochs run on a cached 1-epoch step

@@ -35,9 +35,10 @@ from hfrep_tpu.ops.lstm import KerasLSTM
 
 # A spec is a hashable tuple so it can live in a Flax module field:
 #   ("lstm", units, activation, recurrent_activation)
-#   ("dense", units, activation)
+#   ("dense", units, activation, use_bias)
 #   ("layer_norm", epsilon)
 #   ("leaky_relu", alpha)
+#   ("flatten" | "activation" | "dropout", activation_or_None)
 Spec = Tuple[Any, ...]
 
 _WEIGHTED = {"lstm", "dense", "layer_norm"}
@@ -45,6 +46,17 @@ _WEIGHTED = {"lstm", "dense", "layer_norm"}
 
 def _as_str(x) -> str:
     return x.decode() if isinstance(x, bytes) else str(x)
+
+
+def _checked_activation(name, cls: str):
+    """Validate an activation name at parse time, so an unsupported
+    artifact fails with the artifact path (via :func:`parse_model_config`)
+    instead of a bare ``KeyError`` at apply time."""
+    from hfrep_tpu.ops.layers import ACTIVATIONS
+
+    if name not in ACTIVATIONS:
+        raise ValueError(f"unsupported activation {name!r} on {cls} layer")
+    return name
 
 
 def _flatten_layers(layers: Sequence[dict], specs: List[Spec],
@@ -67,10 +79,12 @@ def _flatten_layers(layers: Sequence[dict], specs: List[Spec],
                     raise ValueError(
                         f"unsupported LSTM config {field}={cfg[field]!r}")
             specs.append(("lstm", int(cfg["units"]),
-                          cfg.get("activation", "tanh"),
-                          cfg.get("recurrent_activation", "sigmoid")))
+                          _checked_activation(cfg.get("activation", "tanh"), cls),
+                          _checked_activation(
+                              cfg.get("recurrent_activation", "sigmoid"), cls)))
         elif cls == "Dense":
-            specs.append(("dense", int(cfg["units"]), cfg.get("activation"),
+            specs.append(("dense", int(cfg["units"]),
+                          _checked_activation(cfg.get("activation"), cls),
                           bool(cfg.get("use_bias", True))))
         elif cls == "LayerNormalization":
             specs.append(("layer_norm", float(cfg.get("epsilon", 1e-3))))
@@ -79,7 +93,10 @@ def _flatten_layers(layers: Sequence[dict], specs: List[Spec],
                           float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))))
         elif cls in ("Flatten", "Activation", "Dropout"):
             # Flatten appears only in critics (not saved); tolerate anyway.
-            specs.append((cls.lower(), cfg.get("activation")))
+            act = cfg.get("activation")
+            if cls == "Activation":
+                _checked_activation(act, cls)
+            specs.append((cls.lower(), act))
         else:
             raise ValueError(f"unsupported Keras layer in artifact: {cls}")
 
@@ -92,8 +109,11 @@ def parse_model_config(path: str) -> Tuple[Tuple[Spec, ...], Tuple[int, ...]]:
         cfg = json.loads(_as_str(f.attrs["model_config"]))
     specs: List[Spec] = []
     input_shapes: List[Tuple[int, ...]] = []
-    _flatten_layers([cfg] if "class_name" in cfg else cfg["config"]["layers"],
-                    specs, input_shapes)
+    try:
+        _flatten_layers([cfg] if "class_name" in cfg else cfg["config"]["layers"],
+                        specs, input_shapes)
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from None
     if not input_shapes:
         raise ValueError(f"no InputLayer shape found in {path}")
     return tuple(specs), input_shapes[0]

@@ -1,0 +1,44 @@
+"""Restricted unpickling for artifacts read from ``/root/reference``.
+
+The reference tree is treated as untrusted public content; ``pickle.load``
+executes arbitrary callables named in the stream.  The reference's pickles
+are plain data — str→str name dicts (``cleaned_data/*_fullname.pkl``,
+written by ``helper.py:155-162``) and a numpy cube
+(``GAN/generated_data2022-07-09.pkl``) — so an allowlist of numpy
+reconstruction globals covers everything legitimately present while any
+smuggled callable raises ``UnpicklingError`` instead of executing.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+_ALLOWED_GLOBALS = {
+    # numpy ndarray/dtype reconstruction (module path moved in numpy 2.x)
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"blocked pickle global {module}.{name!r}: only plain-data "
+            "pickles (builtins + numpy arrays) may be loaded from the "
+            "untrusted reference tree")
+
+
+def safe_pickle_load(fh) -> object:
+    """``pickle.load`` with the restricted allowlist."""
+    return _RestrictedUnpickler(fh).load()
+
+
+def safe_pickle_loads(data: bytes) -> object:
+    return _RestrictedUnpickler(io.BytesIO(data)).load()

@@ -1,0 +1,57 @@
+"""Varying-manual-axis (vma) helpers for `shard_map(check_vma=True)`.
+
+Under `jax.shard_map`'s static replication checker every value carries the
+set of mesh axes it *varies* over.  Loop carries initialized with plain
+`jnp.zeros` are replicated (vma = ∅), but a scan/fori body fed per-device
+data returns varying carries — a static type mismatch the checker rejects.
+The fix is to pre-cast each initial carry to the variance of the data that
+will flow into it; :func:`match_vma` does that generically by reading the
+reference value's vma with `jax.typeof`, so call sites never need to know
+the mesh axis names (and outside `shard_map` it is a no-op).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def vma_of(x) -> frozenset:
+    """The union of manual mesh axes the leaves of ``x`` vary over
+    (∅ outside shard_map)."""
+    axes: frozenset = frozenset()
+    for leaf in jax.tree_util.tree_leaves(x):
+        try:
+            axes |= frozenset(jax.typeof(leaf).vma)
+        except (AttributeError, TypeError):  # non-jax value
+            pass
+    return axes
+
+
+def shape_struct(shape, dtype, like) -> "jax.ShapeDtypeStruct":
+    """`jax.ShapeDtypeStruct` carrying the vma of ``like`` — required for
+    `pallas_call` out_shapes under `shard_map(check_vma=True)`, where
+    every output aval must state how it varies over the mesh (a kernel
+    output varies exactly as much as its inputs do)."""
+    axes = vma_of(like)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=axes if axes else None)
+
+
+def match_vma(x, like):
+    """Cast ``x`` (pytree) to vary over the same manual axes as ``like``.
+
+    ``like`` may be any array already carrying the intended variance (for
+    a scan carry: the scanned-over input).  Equal-or-superset variance is
+    required by pcast, so only the *missing* axes are added; values
+    already varying are returned untouched.  A no-op when not inside
+    `shard_map` or when ``like`` is replicated.
+    """
+    target = vma_of(like)
+    if not target:
+        return x
+
+    def cast(leaf):
+        missing = target - vma_of(leaf)
+        return lax.pcast(leaf, tuple(missing), to="varying") if missing else leaf
+
+    return jax.tree_util.tree_map(cast, x)

@@ -198,3 +198,17 @@ class TestCli:
         for col in cols:
             assert col in bench.columns, col
         np.testing.assert_allclose(bench.loc["HEDG", "Sharpe"], 0.725, atol=2e-3)
+
+    def test_sweep_cli_plots(self, tmp_path):
+        """--plots writes all three report PNGs: cumulative returns,
+        AE train/val loss curves (Autoencoder_encapsulate.py:97-105
+        parity) and the Omega-curve grid (cell 23/38)."""
+        from hfrep_tpu.experiments.cli import main
+        rc = main(["sweep", "--latents", "1,2", "--epochs", "15",
+                   "--out", str(tmp_path / "sweep"), "--plots"])
+        assert rc == 0
+        for png in ("cumulative_returns.png", "ae_loss_curves.png",
+                    "omega_curves.png"):
+            f = tmp_path / "sweep" / png
+            assert f.exists() and f.stat().st_size > 1000, png
+        assert (tmp_path / "sweep" / "train_loss.npy").exists()

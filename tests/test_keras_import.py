@@ -111,6 +111,42 @@ def test_forward_matches_keras_oracle():
     np.testing.assert_allclose(got, expected, atol=1e-4)
 
 
+def test_unsupported_activation_fails_at_parse_time(tmp_path):
+    """A config naming an activation our primitives don't implement must
+    fail while parsing, citing the artifact path — not as a bare KeyError
+    at apply time."""
+    import json
+
+    import h5py
+
+    cfg = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "InputLayer", "config": {"batch_input_shape": [None, 8, 3]}},
+        {"class_name": "Activation", "config": {"activation": "gelu"}},
+    ]}}
+    path = str(tmp_path / "bad.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+    with pytest.raises(ValueError, match="bad.h5.*gelu"):
+        parse_model_config(path)
+
+
+def test_safe_pickle_blocks_callables(tmp_path):
+    """Reference pickles are untrusted: any global outside the numpy
+    plain-data allowlist must be refused, not resolved."""
+    from hfrep_tpu.utils.safe_pickle import safe_pickle_load, safe_pickle_loads
+
+    assert safe_pickle_loads(pickle.dumps({"HEDG": "Hedge Fund Index"})) == {
+        "HEDG": "Hedge Fund Index"}
+    arr = np.arange(6.0).reshape(2, 3)
+    np.testing.assert_array_equal(safe_pickle_loads(pickle.dumps(arr)), arr)
+    with pytest.raises(pickle.UnpicklingError, match="blocked"):
+        safe_pickle_loads(pickle.dumps(os.system))
+    p = tmp_path / "d.pkl"
+    p.write_bytes(pickle.dumps({"a": 1}))
+    with open(p, "rb") as fh:
+        assert safe_pickle_load(fh) == {"a": 1}
+
+
 @needs_ref
 @pytest.mark.skipif(not os.path.exists(GEN_PKL), reason="generated pkl missing")
 def test_regenerates_reference_generated_cube():

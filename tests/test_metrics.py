@@ -100,6 +100,30 @@ class TestMetricOracles:
             ref_a = ks_2samp(r[:, i], f[:, i], method="asymp")
             np.testing.assert_allclose(pvals_a[i], ref_a.pvalue, atol=1e-6)
 
+    @pytest.mark.parametrize("n,m", [(10, 10), (30, 47), (128, 96), (17, 513)])
+    def test_exact_ks2_pvalue_matches_scipy_exact(self, n, m):
+        """In-repo exact two-sample KS recursion vs scipy's public exact mode
+        (the path ``GAN_eval.py:267-288``'s ``kstest`` takes at these sizes)."""
+        from scipy.stats import ks_2samp
+
+        g = np.random.default_rng(n * 1000 + m)
+        for shift in (0.0, 0.3, 3.0):
+            a, b = g.normal(size=n), g.normal(shift, 1.2, size=m)
+            ref = ks_2samp(a, b, method="exact")
+            ours = ge._exact_ks2_pvalue(n, m, float(ref.statistic))
+            np.testing.assert_allclose(ours, ref.pvalue, atol=1e-10)
+        assert ge._exact_ks2_pvalue(n, m, 0.0) == 1.0
+        # full separation: P(D ≥ 1) = 2·n!·m!/(n+m)! exactly; below the
+        # documented ~1e-12 cancellation floor we only assert ≈0
+        import math
+        full = 2.0 * math.exp(math.lgamma(n + 1) + math.lgamma(m + 1)
+                              - math.lgamma(n + m + 1))
+        got = ge._exact_ks2_pvalue(n, m, 1.0)
+        if full > 1e-10:
+            np.testing.assert_allclose(got, full, rtol=1e-6)
+        else:
+            assert got < 1e-11
+
     def test_wasserstein_matches_scipy(self, cubes):
         from scipy.stats import wasserstein_distance
 

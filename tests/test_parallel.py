@@ -66,11 +66,15 @@ def test_dp_trainer_end_to_end(dataset):
 
 
 def test_dp_gradient_is_global_batch_mean(dataset):
-    """pmean'd per-shard gradients must equal the global-batch gradient.
+    """Axis-normalized per-shard gradients must equal the global-batch
+    gradient.
 
     Verified directly on a BCE discriminator loss: compute the gradient of
     the mean loss over a fixed global batch on one device, and via 8-way
-    sharded pmean; they must agree."""
+    sharding.  Under `check_vma=True` the backward pass auto-psums the
+    per-shard gradients (transpose of the implicit replicated→varying
+    broadcast), so the shard side divides by the axis size — the same
+    normalization `hfrep_tpu.train.steps._psum_if` applies."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -88,11 +92,97 @@ def test_dp_gradient_is_global_batch_mean(dataset):
     g_ref = jax.grad(loss)(params, batch)
 
     def shard_grad(p, x):
-        g = jax.grad(loss)(p, x)
-        return jax.lax.pmean(g, "dp")
+        g = jax.grad(loss)(p, x)     # already psum'd across the mesh
+        return jax.tree_util.tree_map(lambda t: t / jax.lax.axis_size("dp"), g)
 
-    fn = shard_map(shard_grad, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
-                   check_vma=False)
+    fn = shard_map(shard_grad, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P())
     g_dp = fn(params, batch)
     for a, b in zip(jax.tree_util.tree_leaves(g_ref), jax.tree_util.tree_leaves(g_dp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_psum_if_handles_both_vma_cases(dataset):
+    """`steps._psum_if` must produce the global-batch-mean gradient for
+    BOTH backward-pass flavors: autodiff'd paths (grads auto-psum'd by the
+    vma transpose, typed invariant → divide by axis size) and custom_vjp
+    paths (hand-computed per-device cotangents, typed varying → pmean).
+    The pallas LSTM kernels are custom_vjp, so the second case is what a
+    multi-chip pallas run hits; this exercises it without a TPU."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from hfrep_tpu.train.steps import _psum_if
+
+    @jax.custom_vjp
+    def matvec(w, x):
+        return x @ w
+
+    def fwd(w, x):
+        return x @ w, (w, x)
+
+    def bwd(res, ct):
+        w, x = res
+        return x.T @ ct, ct @ w.T       # hand-written: NOT auto-psum'd
+
+    matvec.defvjp(fwd, bwd)
+
+    mesh = make_mesh()
+    w = jnp.asarray(np.random.default_rng(3).normal(size=(5, 3)).astype(np.float32))
+    batch = np.asarray(dataset[:16]).reshape(16, -1)[:, :5]
+    batch = jnp.asarray(batch)
+
+    def loss_ad(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    def loss_cvjp(w, x):
+        return jnp.mean(matvec(w, x) ** 2)
+
+    g_ref = jax.grad(loss_ad)(w, batch)
+
+    def body(w, x):
+        g_inv = jax.grad(loss_ad)(w, x)      # invariant leaf (auto-psum'd)
+        g_var = jax.grad(loss_cvjp)(w, x)    # varying leaf (custom_vjp)
+        return _psum_if("dp", {"inv": g_inv, "var": g_var})
+
+    out = shard_map(body, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P())(w, batch)
+    np.testing.assert_allclose(np.asarray(out["inv"]), np.asarray(g_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["var"]), np.asarray(g_ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("family", ["wgan", "mtss_wgan_gp"])
+def test_dp_trajectory_matches_single_device(family, dataset):
+    """dp=8 with controlled global sampling must follow the *whole* loss
+    trajectory (and land on the same parameters) as a single-device run at
+    the same global batch and key — not just one gradient.
+
+    This is the strong form of the replication guarantee: every epoch's
+    sampled batch, noise and α are identical and the axis-normalized
+    auto-psum'd gradients equal the global-batch gradient, so any
+    divergence anywhere in the step (optimizer, clip, GP, metrics) would
+    surface here.  It caught a real bug: pmean on top of the vma system's
+    auto-psum left gradients n_dev× too large, invisible in loss curves
+    because Adam/RMSprop are scale-invariant except through eps."""
+    mesh = make_mesh()
+    mcfg = dataclasses.replace(MCFG, family=family)
+    tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=4)
+    pair = build_gan(mcfg)
+    from hfrep_tpu.train.steps import make_multi_step
+
+    state0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    dp_fn = make_dp_multi_step(pair, tcfg, dataset, mesh, controlled_sampling=True)
+    dp_state, dp_metrics = dp_fn(state0, jax.random.PRNGKey(1))
+
+    state0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    single_fn = make_multi_step(pair, tcfg, dataset)
+    s_state, s_metrics = single_fn(state0, jax.random.PRNGKey(1))
+
+    for k in s_metrics:
+        np.testing.assert_allclose(np.asarray(dp_metrics[k]),
+                                   np.asarray(s_metrics[k]), atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(dp_state.g_params),
+                    jax.tree_util.tree_leaves(s_state.g_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(dp_state.d_params),
+                    jax.tree_util.tree_leaves(s_state.d_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert int(dp_state.step) == int(s_state.step) == 4

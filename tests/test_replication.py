@@ -75,6 +75,48 @@ class TestTraining:
                                        np.asarray(solo.params[k]), atol=2e-5)
 
 
+class TestSweepEvaluate:
+    def test_vmapped_eval_matches_engine_loop(self, panels):
+        """The one-program sweep evaluation must reproduce the per-latent
+        engine path (use_params → IS/OOS/ante/post/turnover) exactly — the
+        vmapped program is the same math, batched."""
+        from hfrep_tpu.models.autoencoder import latent_mask
+        from hfrep_tpu.replication.engine import sweep_evaluate
+
+        x, y, rf = panels
+        half = len(x) // 2
+        dims = [1, 2, 4]
+        cfg = dataclasses.replace(CFG, latent_dim=max(dims))
+        eng = ReplicationEngine(x[:half], y[:half], x[half:], y[half:], cfg)
+        swept = sweep_autoencoders(jax.random.PRNGKey(3), eng.x_train, cfg, dims)
+        masks = jnp.stack([latent_mask(d, max(dims)) for d in dims])
+        ev = jax.device_get(sweep_evaluate(
+            eng.model, cfg, eng.x_train, eng.x_test, eng.y_test,
+            jnp.asarray(rf[half:], jnp.float32), jnp.asarray(x, jnp.float32),
+            swept.params, masks))
+
+        for i, d in enumerate(dims):
+            params_i = jax.tree_util.tree_map(lambda a: a[i], swept.params)
+            eng.use_params(params_i, latent_mask(d, max(dims)))
+            np.testing.assert_allclose(ev["is_r2"][i], eng.model_IS_r2(),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(ev["oos_r2"][i], eng.model_OOS_r2(),
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(ev["oos_rmse"][i], eng.model_OOS_RMSE(),
+                                       rtol=1e-4, atol=1e-6)
+            ante = eng.ante(rf[half:])
+            post = eng.post(x)
+            np.testing.assert_allclose(ev["ante"][i], ante, atol=1e-5)
+            np.testing.assert_allclose(ev["post"][i], post, atol=1e-5)
+            np.testing.assert_allclose(ev["turnover"][i], eng.turnover(),
+                                       rtol=1e-4)
+            np.testing.assert_allclose(
+                ev["sharpe_ante"][i],
+                np.asarray(perf_stats.annualized_sharpe(
+                    jnp.asarray(ante), jnp.asarray(rf[half:])[-ante.shape[0]:])),
+                rtol=1e-4, atol=1e-5)
+
+
 class TestMetrics:
     def test_is_r2_matches_sklearn(self, panels):
         from sklearn.metrics import r2_score

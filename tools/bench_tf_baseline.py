@@ -1,0 +1,129 @@
+"""Reference-execution-model baseline: MTSS-WGAN-GP epochs/sec in TF/Keras.
+
+Measures the semantic equivalent of the reference's hot loop
+(``GAN/MTSS_WGAN_GP.py:254-287``): 5 RMSprop(5e-5) critic updates on the
+3-term WGAN-GP loss (λ=10, per-sample α) + 1 generator update, batch 32,
+(48, 35) windows, LSTM100×2 generator and LSTM100×2+Flatten critic — as
+one tf.function per critic/generator step (already a *faster* execution
+model than the reference's per-call ``train_on_batch`` graph launches).
+
+Two anchors, selected with ``--threads``:
+
+* ``--threads 1`` — the reference's own declared config: single-threaded
+  session for reproducibility (``helper.py:38``,
+  ``ConfigProto(intra_op_parallelism_threads=1, inter_op=1)``).
+* ``--threads 0`` — TF defaults (unpinned): what a competently-run TF
+  baseline would use.  NOTE: this host exposes a single CPU core
+  (``nproc`` = 1), so unpinned ≈ pinned here; on a many-core host the
+  unpinned anchor would be several× higher.
+
+Threading must be configured before TF initializes, hence one process per
+anchor.  Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", type=int, default=1,
+                    help="intra/inter op threads; 0 = TF defaults (unpinned)")
+    ap.add_argument("--epochs", type=int, default=20)
+    args = ap.parse_args()
+
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    import numpy as np
+    import tensorflow as tf
+
+    if args.threads > 0:
+        tf.config.threading.set_intra_op_parallelism_threads(args.threads)
+        tf.config.threading.set_inter_op_parallelism_threads(args.threads)
+
+    tf.random.set_seed(123)
+    np.random.seed(123)
+
+    window, features, hidden, batch, n_critic, gp_w = 48, 35, 100, 32, 5, 10.0
+
+    def build_generator():
+        return tf.keras.Sequential([
+            tf.keras.layers.Input((window, features)),
+            tf.keras.layers.LSTM(hidden, activation="sigmoid", return_sequences=True),
+            tf.keras.layers.LayerNormalization(),
+            tf.keras.layers.LSTM(hidden, activation="sigmoid", return_sequences=True),
+            tf.keras.layers.LeakyReLU(),
+            tf.keras.layers.LayerNormalization(),
+            tf.keras.layers.Dense(features),
+        ])
+
+    def build_critic():
+        return tf.keras.Sequential([
+            tf.keras.layers.Input((window, features)),
+            tf.keras.layers.LSTM(hidden, return_sequences=True),
+            tf.keras.layers.LSTM(hidden, return_sequences=True),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(1),
+        ])
+
+    gen, critic = build_generator(), build_critic()
+    g_opt = tf.keras.optimizers.RMSprop(5e-5)
+    d_opt = tf.keras.optimizers.RMSprop(5e-5)
+    dataset = tf.constant(np.random.uniform(0, 1, (1000, window, features)),
+                          tf.float32)
+
+    @tf.function
+    def critic_step(real, noise, alpha):
+        fake = gen(noise, training=True)
+        with tf.GradientTape() as tape:
+            interp = alpha * real + (1.0 - alpha) * fake
+            with tf.GradientTape() as gp_tape:
+                gp_tape.watch(interp)
+                s_interp = critic(interp, training=True)
+            g = gp_tape.gradient(s_interp, interp)
+            norms = tf.sqrt(tf.reduce_sum(g ** 2, axis=[1, 2]) + 1e-12)
+            gp = tf.reduce_mean((1.0 - norms) ** 2)
+            loss = (-tf.reduce_mean(critic(real, training=True))
+                    + tf.reduce_mean(critic(fake, training=True)) + gp_w * gp)
+        grads = tape.gradient(loss, critic.trainable_variables)
+        d_opt.apply_gradients(zip(grads, critic.trainable_variables))
+        return loss
+
+    @tf.function
+    def gen_step(noise):
+        with tf.GradientTape() as tape:
+            loss = -tf.reduce_mean(critic(gen(noise, training=True), training=True))
+        grads = tape.gradient(loss, gen.trainable_variables)
+        g_opt.apply_gradients(zip(grads, gen.trainable_variables))
+        return loss
+
+    def epoch():
+        for _ in range(n_critic):
+            idx = np.random.randint(0, 1000, batch)
+            real = tf.gather(dataset, idx)
+            noise = tf.constant(np.random.normal(0, 1, (batch, window, features)),
+                                tf.float32)
+            alpha = tf.constant(np.random.uniform(size=(batch, 1, 1)), tf.float32)
+            critic_step(real, noise, alpha)
+        gen_step(tf.constant(np.random.normal(0, 1, (batch, window, features)),
+                             tf.float32))
+
+    epoch()                                  # trace + warmup
+    t0 = time.perf_counter()
+    for _ in range(args.epochs):
+        epoch()
+    dt = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "tf_baseline_epochs_per_sec",
+        "threads": args.threads or "default",
+        "value": round(args.epochs / dt, 4),
+        "epochs": args.epochs,
+    }))
+
+
+if __name__ == "__main__":
+    main()
