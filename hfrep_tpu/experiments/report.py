@@ -15,34 +15,46 @@ import numpy as np
 from hfrep_tpu.replication import perf_stats
 
 
-def multiplot(replication: np.ndarray, actual: np.ndarray,
-              names: Sequence[str], path: str, ncols: int = 3,
-              labels: tuple = ("replication", "actual")) -> str:
-    """Cumulative-return grid, one panel per strategy (cell 38's
-    ``multiplot``): replicated vs actual index, compounded from monthly
-    returns."""
+def _panel_grid(n_panels: int, ncols: float, panel_size: tuple,
+                draw, path: str) -> str:
+    """Shared scaffolding for the per-strategy/per-latent report grids:
+    lay out ``n_panels`` axes, call ``draw(ax, j)`` on each, blank the
+    leftovers, and save."""
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    s = replication.shape[1]
-    nrows = -(-s // ncols)
-    fig, axes = plt.subplots(nrows, ncols, figsize=(4.2 * ncols, 3.0 * nrows),
-                             squeeze=False)
+    ncols = int(ncols)
+    nrows = -(-n_panels // ncols)
+    fig, axes = plt.subplots(
+        nrows, ncols, figsize=(panel_size[0] * ncols, panel_size[1] * nrows),
+        squeeze=False)
     for j in range(nrows * ncols):
         ax = axes[j // ncols][j % ncols]
-        if j >= s:
+        if j >= n_panels:
             ax.axis("off")
             continue
-        ax.plot(np.cumprod(1.0 + replication[:, j]) - 1.0, label=labels[0])
-        ax.plot(np.cumprod(1.0 + actual[:, j]) - 1.0, label=labels[1])
-        ax.set_title(names[j], fontsize=9)
+        draw(ax, j)
         ax.legend(fontsize=7)
     fig.tight_layout()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def multiplot(replication: np.ndarray, actual: np.ndarray,
+              names: Sequence[str], path: str, ncols: int = 3,
+              labels: tuple = ("replication", "actual")) -> str:
+    """Cumulative-return grid, one panel per strategy (cell 38's
+    ``multiplot``): replicated vs actual index, compounded from monthly
+    returns."""
+    def draw(ax, j):
+        ax.plot(np.cumprod(1.0 + replication[:, j]) - 1.0, label=labels[0])
+        ax.plot(np.cumprod(1.0 + actual[:, j]) - 1.0, label=labels[1])
+        ax.set_title(names[j], fontsize=9)
+
+    return _panel_grid(replication.shape[1], ncols, (4.2, 3.0), draw, path)
 
 
 def ae_loss_curves(train_loss: np.ndarray, val_loss: np.ndarray,
@@ -52,31 +64,15 @@ def ae_loss_curves(train_loss: np.ndarray, val_loss: np.ndarray,
     rendered per model at ``autoencoder_v4.ipynb`` cell 6).  Loss traces
     are NaN after the early stop, so each panel naturally ends at its own
     stopping epoch."""
-    import matplotlib
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    n = len(latent_dims)
-    nrows = -(-n // ncols)
-    fig, axes = plt.subplots(nrows, ncols, figsize=(3.6 * ncols, 2.6 * nrows),
-                             squeeze=False)
-    for j in range(nrows * ncols):
-        ax = axes[j // ncols][j % ncols]
-        if j >= n:
-            ax.axis("off")
-            continue
+    def draw(ax, j):
         tl, vl = np.asarray(train_loss[j]), np.asarray(val_loss[j])
         live = np.isfinite(tl)
         ax.plot(np.arange(len(tl))[live], tl[live], label="train")
         ax.plot(np.arange(len(vl))[live], vl[live], label="val")
         ax.set_title(f"latent={latent_dims[j]}", fontsize=9)
         ax.set_yscale("log")
-        ax.legend(fontsize=7)
-    fig.tight_layout()
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    fig.savefig(path, dpi=120)
-    plt.close(fig)
-    return path
+
+    return _panel_grid(len(latent_dims), ncols, (3.6, 2.6), draw, path)
 
 
 def omega_curve_grid(replication: np.ndarray, actual: np.ndarray,
@@ -86,32 +82,17 @@ def omega_curve_grid(replication: np.ndarray, actual: np.ndarray,
     """Omega-ratio curves per strategy (the notebook's ``Omega_Curve``
     flow, cell 23/38): Ω(τ) for replication vs actual index over a
     threshold grid."""
-    import matplotlib
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
     thresholds = thresholds if thresholds is not None else np.linspace(0, 0.2, 50)
     rep_curves = perf_stats.omega_curve(replication, thresholds)   # (T, S)
     act_curves = perf_stats.omega_curve(actual, thresholds)
-    s = replication.shape[1]
-    nrows = -(-s // ncols)
-    fig, axes = plt.subplots(nrows, ncols, figsize=(4.2 * ncols, 3.0 * nrows),
-                             squeeze=False)
-    for j in range(nrows * ncols):
-        ax = axes[j // ncols][j % ncols]
-        if j >= s:
-            ax.axis("off")
-            continue
+
+    def draw(ax, j):
         ax.plot(thresholds, rep_curves[:, j], label=labels[0])
         ax.plot(thresholds, act_curves[:, j], label=labels[1])
         ax.set_title(names[j], fontsize=9)
         ax.set_xlabel("threshold", fontsize=7)
-        ax.legend(fontsize=7)
-    fig.tight_layout()
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    fig.savefig(path, dpi=120)
-    plt.close(fig)
-    return path
+
+    return _panel_grid(replication.shape[1], ncols, (4.2, 3.0), draw, path)
 
 
 def stats_table(returns: np.ndarray, names: Sequence[str], rf=None,

@@ -87,8 +87,12 @@ def _interpret() -> bool:
 
 def kernel_eligible(backend, eff_dtype) -> bool:
     """Single source of truth for pallas-kernel dispatch: explicit pallas
-    backend and f32 compute (the kernels are f32-only; other dtypes take
-    the scan path so configured precision is honored)."""
+    backend and f32 compute.  The *forward* kernel also accepts bf16
+    operand streams (f32 scratch/accumulation), but training dispatch
+    stays f32 by measured choice: at H=100/B=32 the recurrence is
+    latency-bound, not matmul-throughput-bound, and end-to-end bf16 gains
+    nothing (RESULTS.md "bf16: measured decision").  Other dtypes take
+    the scan path so configured precision is honored."""
     return backend == "pallas" and eff_dtype == jnp.float32
 
 
@@ -117,7 +121,14 @@ def _fwd_kernel(act_name, with_cs, xz_ref, rec_ref, hs_ref, *rest):
         c_scr[:] = jnp.zeros_like(c_scr)
 
     act = _ACT[act_name]
-    z = xz_ref[0] + jnp.dot(h_scr[:], rec_ref[:], preferred_element_type=jnp.float32)
+    # Mixed precision: xz/rec may arrive bf16 (halved HBM stream for the
+    # (W, B, 4Hp) projection, MXU-rate matmul); state and gate math stay
+    # f32 in VMEM/registers.
+    lhs = h_scr[:]
+    if rec_ref.dtype != lhs.dtype:
+        lhs = lhs.astype(rec_ref.dtype)
+    z = (xz_ref[0].astype(jnp.float32)
+         + jnp.dot(lhs, rec_ref[:], preferred_element_type=jnp.float32))
     hp = z.shape[-1] // 4        # gate blocks are hp-padded → slices stay 128-aligned
     zi, zf, zc, zo = (z[:, :hp], z[:, hp:2 * hp], z[:, 2 * hp:3 * hp], z[:, 3 * hp:])
     i = jax.nn.sigmoid(zi)

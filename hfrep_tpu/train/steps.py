@@ -57,7 +57,7 @@ from hfrep_tpu.utils.vma import match_vma
 Metrics = dict
 
 
-def _psum_if(axis_name: Optional[str], grads):
+def _psum_if(axis_name: Optional[str], grads, loss):
     """Per-shard gradients → global-batch-mean gradients.
 
     Under `shard_map(check_vma=True)`'s type system the backward pass may
@@ -73,10 +73,24 @@ def _psum_if(axis_name: Optional[str], grads):
     leave those gradients n_dev× too large — masked by Adam/RMSprop's
     scale invariance except through eps, but wrong; the dp-vs-single
     trajectory test pins both cases.)
+
+    ``loss`` is the per-device scalar the gradients came from; it is
+    consulted only as a canary: it depends on per-device data, so under
+    the required ``check_vma=True`` typing it is always *varying*.  If
+    its vma is empty the step is being traced in an SPMD context without
+    vma typing (``check_vma=False`` shard_map, pmap), where the
+    invariant-leaf division would silently shrink unsummed gradients by
+    n_dev — refuse loudly instead.
     """
     if axis_name is None:
         return grads
     from hfrep_tpu.utils.vma import vma_of
+    if axis_name not in vma_of(loss):
+        raise ValueError(
+            f"axis {axis_name!r} carries no vma on the loss: the train "
+            "step's gradient normalization requires shard_map("
+            "check_vma=True); running it under pmap or check_vma=False "
+            "would silently mis-scale gradients")
     n = lax.axis_size(axis_name)
 
     def norm(g):
@@ -183,13 +197,13 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
 
     def d_update(d_params, d_opt, loss_fn):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(d_params)
-        grads = _psum_if(axis_name, grads)
+        grads = _psum_if(axis_name, grads, loss)
         updates, d_opt = d_tx.update(grads, d_opt, d_params)
         return optax.apply_updates(d_params, updates), d_opt, loss, aux
 
     def g_update(state: GanState, loss_fn):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.g_params)
-        grads = _psum_if(axis_name, grads)
+        grads = _psum_if(axis_name, grads, loss)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
         return state.replace(g_params=optax.apply_updates(state.g_params, updates),
                              g_opt=g_opt, step=state.step + 1), loss

@@ -140,13 +140,18 @@ def test_psum_if_handles_both_vma_cases(dataset):
     g_ref = jax.grad(loss_ad)(w, batch)
 
     def body(w, x):
-        g_inv = jax.grad(loss_ad)(w, x)      # invariant leaf (auto-psum'd)
-        g_var = jax.grad(loss_cvjp)(w, x)    # varying leaf (custom_vjp)
-        return _psum_if("dp", {"inv": g_inv, "var": g_var})
+        lv, g_inv = jax.value_and_grad(loss_ad)(w, x)   # invariant leaf (auto-psum'd)
+        g_var = jax.grad(loss_cvjp)(w, x)               # varying leaf (custom_vjp)
+        return _psum_if("dp", {"inv": g_inv, "var": g_var}, lv)
 
     out = shard_map(body, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P())(w, batch)
     np.testing.assert_allclose(np.asarray(out["inv"]), np.asarray(g_ref), atol=1e-6)
     np.testing.assert_allclose(np.asarray(out["var"]), np.asarray(g_ref), atol=1e-6)
+
+    # the canary: without vma typing the normalization must refuse loudly
+    with pytest.raises(ValueError, match="check_vma"):
+        shard_map(body, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
+                  check_vma=False)(w, batch)
 
 
 @pytest.mark.parametrize("family", ["wgan", "mtss_wgan_gp"])

@@ -1,0 +1,75 @@
+"""Decision probe for the bf16 kernel question (VERDICT r1 item 3).
+
+Times the pallas LSTM forward traversal with f32 vs bf16 operand
+streams at the two real shapes, plus the end-to-end MTSS-WGAN-GP train
+step in f32-pallas vs bf16-scan, on the real chip.  The outcome decides
+whether the full bf16 backward/adjoint kernel path is worth building or
+whether f32 is already optimal at these shapes (documented either way in
+RESULTS.md).
+"""
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from hfrep_tpu.ops.pallas_lstm import LANE, _lstm_seq_fwd_impl, pad_keras_params
+
+
+def time_fn(fn, *args, iters=50):
+    out = jax.block_until_ready(fn(*args))          # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    print("backend:", jax.default_backend())
+    fwd = jax.jit(lambda xz, rec: _lstm_seq_fwd_impl(xz, rec, "sigmoid",
+                                                     with_cs=False))
+    for (b, w, h) in [(32, 48, 100), (32, 168, 100)]:
+        hp = ((h + LANE - 1) // LANE) * LANE
+        key = jax.random.PRNGKey(0)
+        xz32 = jax.random.normal(key, (w, b, 4 * hp), jnp.float32)
+        rec32 = jax.random.normal(key, (hp, 4 * hp), jnp.float32) * 0.05
+        t32, h32 = time_fn(fwd, xz32, rec32)
+        t16, h16 = time_fn(fwd, xz32.astype(jnp.bfloat16), rec32.astype(jnp.bfloat16))
+        err = float(jnp.abs(h32 - h16).max())
+        print(f"fwd traversal (B={b}, W={w}, Hp={hp}): "
+              f"f32 {t32*1e6:.1f}us  bf16-operands {t16*1e6:.1f}us "
+              f"({t32/t16:.2f}x)  max|Δh|={err:.2e}")
+
+    # End-to-end: one flagship train epoch, f32+pallas vs bf16+scan.
+    import dataclasses
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_multi_step
+
+    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35), jnp.float32)
+    for label, dtype, backend in [("f32/pallas", "float32", "pallas"),
+                                  ("bf16/scan", "bfloat16", "xla"),
+                                  ("f32/scan", "float32", "xla")]:
+        mcfg = ModelConfig(family="mtss_wgan_gp", dtype=dtype)
+        tcfg = TrainConfig(steps_per_call=50, lstm_backend=backend)
+        pair = build_gan(mcfg)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+        step = make_multi_step(pair, tcfg, data)
+        state, m = step(state, jax.random.PRNGKey(1))
+        jax.block_until_ready(m)
+        t0 = time.perf_counter()
+        for i in range(4):
+            state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(2), i))
+        jax.block_until_ready(m)
+        dt = time.perf_counter() - t0
+        print(f"train epoch {label}: {200/dt:.1f} steps/s "
+              f"(d_loss {float(m['d_loss'][-1]):.3f})")
+
+
+if __name__ == "__main__":
+    main()
