@@ -15,7 +15,7 @@ import numpy as np
 from hfrep_tpu.replication import perf_stats
 
 
-def _panel_grid(n_panels: int, ncols: float, panel_size: tuple,
+def _panel_grid(n_panels: int, ncols: int, panel_size: tuple,
                 draw, path: str) -> str:
     """Shared scaffolding for the per-strategy/per-latent report grids:
     lay out ``n_panels`` axes, call ``draw(ax, j)`` on each, blank the
@@ -24,7 +24,6 @@ def _panel_grid(n_panels: int, ncols: float, panel_size: tuple,
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    ncols = int(ncols)
     nrows = -(-n_panels // ncols)
     fig, axes = plt.subplots(
         nrows, ncols, figsize=(panel_size[0] * ncols, panel_size[1] * nrows),

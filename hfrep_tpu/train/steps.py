@@ -85,13 +85,19 @@ def _psum_if(axis_name: Optional[str], grads, loss):
     if axis_name is None:
         return grads
     from hfrep_tpu.utils.vma import vma_of
-    if axis_name not in vma_of(loss):
-        raise ValueError(
-            f"axis {axis_name!r} carries no vma on the loss: the train "
-            "step's gradient normalization requires shard_map("
-            "check_vma=True); running it under pmap or check_vma=False "
-            "would silently mis-scale gradients")
     n = lax.axis_size(axis_name)
+    if n > 1 and axis_name not in vma_of(loss):
+        # On a >1 mesh the loss always varies under check_vma=True typing
+        # (it depends on per-device data); an empty vma means the typing
+        # is absent and the division below would mis-scale.  n == 1 is
+        # exempt: g/1 is the identity, and a dp=1 controlled-sampling
+        # trace legitimately has an invariant loss (_shard is the
+        # identity there).
+        raise ValueError(
+            f"axis {axis_name!r} (size {n}) carries no vma on the loss: "
+            "the train step's gradient normalization requires "
+            "shard_map(check_vma=True); running it under pmap or "
+            "check_vma=False would silently mis-scale gradients")
 
     def norm(g):
         if axis_name in vma_of(g):
@@ -187,13 +193,13 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         return _shard(jax.random.uniform(key, (sample_b, 1, 1)))
 
     def _loop_init(key):
-        """Initial (noise, d_loss) carry for the critic fori_loops, cast to
-        the per-device variance the loop body will produce: the body's
-        values vary over the mesh through the folded key (i.i.d. mode) or
+        """Initial d_loss carry for the critic fori_loops, cast to the
+        per-device variance the loop body will produce: the body's loss
+        varies over the mesh through the folded key (i.i.d. mode) or
         through the axis_index batch shard (controlled mode), so the plain
         zeros init must be pre-cast for `shard_map(check_vma=True)`."""
-        noise0 = match_vma(_shard(jnp.zeros(noise_shape)), key)
-        return noise0, match_vma(jnp.zeros(()), noise0)
+        probe = match_vma(_shard(jnp.zeros((sample_b,))), key)
+        return match_vma(jnp.zeros(()), probe)
 
     def d_update(d_params, d_opt, loss_fn):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(d_params)
@@ -236,14 +242,39 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     # ------------------------------------------------------------ wgan_clip
     clip = tcfg.clip_value
 
+    def _critic_loop_inputs(key, g_params, with_alpha: bool):
+        """Everything the n_critic loop consumes that does not depend on
+        the loop carry, hoisted out of it.
+
+        The generator parameters are constant across the critic
+        iterations (only d_params/d_opt update inside), so the n_critic
+        fake batches are ONE (n_critic·B)-row generator traversal instead
+        of n_critic sequential ones — per-sample math and the
+        per-iteration RNG streams are unchanged (the keys are derived
+        exactly as the loop derived them, just precomputed), but
+        n_critic−1 serial LSTM scans leave the critical path and the one
+        that remains runs at n_critic× the MXU row occupancy.
+        """
+        # 2-way vs 3-way split preserves each family's exact RNG streams
+        # (wgan drew k_idx, k_z; wgan_gp drew k_idx, k_z, k_a).
+        ks = [jax.random.split(jax.random.fold_in(key, i), 3 if with_alpha else 2)
+              for i in range(tcfg.n_critic)]
+        k_idx = jnp.stack([k[0] for k in ks])
+        noises = jnp.stack([_noise(k[1]) for k in ks])   # (n_critic, B, W, F)
+        n, b = noises.shape[0], noises.shape[1]
+        fakes = lax.stop_gradient(
+            g_apply(g_params, noises.reshape(n * b, window, features))
+        ).reshape(noises.shape)
+        alphas = jnp.stack([_alpha(k[2]) for k in ks]) if with_alpha else None
+        return k_idx, noises, fakes, alphas
+
     def wgan_step(state: GanState, key: jax.Array):
+        k_idx, noises, fakes, _ = _critic_loop_inputs(key, state.g_params, False)
+
         def critic_iter(i, carry):
             d_params, d_opt, _ = carry
-            k = jax.random.fold_in(key, i)
-            k_idx, k_z = jax.random.split(k)
-            real = _real(k_idx)
-            noise = _noise(k_z)
-            fake = lax.stop_gradient(g_apply(state.g_params, noise))
+            real = _real(k_idx[i])
+            fake = fakes[i]
 
             def loss_real(p):
                 return jnp.mean(-d_apply(p, real)), None
@@ -254,16 +285,16 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             d_params, d_opt, l_real, _ = d_update(d_params, d_opt, loss_real)
             d_params, d_opt, l_fake, _ = d_update(d_params, d_opt, loss_fake)
             d_params = jax.tree_util.tree_map(lambda w: jnp.clip(w, -clip, clip), d_params)
-            return d_params, d_opt, (noise, 0.5 * (l_real + l_fake))
+            return d_params, d_opt, 0.5 * (l_real + l_fake)
 
-        d_params, d_opt, (noise, d_loss) = lax.fori_loop(
+        d_params, d_opt, d_loss = lax.fori_loop(
             0, tcfg.n_critic, critic_iter,
             (state.d_params, state.d_opt, _loop_init(key)))
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/WGAN.py:203)
-            return jnp.mean(-d_apply(state.d_params, g_apply(p, noise))), None
+            return jnp.mean(-d_apply(state.d_params, g_apply(p, noises[-1]))), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}
@@ -271,8 +302,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     # -------------------------------------------------------------- wgan_gp
     gp_w = tcfg.gp_weight
 
-    def gp_critic_loss(d_params, g_params, real, noise, alpha):
-        fake = lax.stop_gradient(g_apply(g_params, noise))
+    def gp_critic_loss(d_params, real, fake, alpha):
         interp = alpha * real + (1.0 - alpha) * fake
         b = real.shape[0]
 
@@ -289,26 +319,25 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         return w_loss + gp_w * gp, (w_loss, gp)
 
     def wgan_gp_step(state: GanState, key: jax.Array):
+        k_idx, noises, fakes, alphas = _critic_loop_inputs(
+            key, state.g_params, True)
+
         def critic_iter(i, carry):
             d_params, d_opt, _ = carry
-            k = jax.random.fold_in(key, i)
-            k_idx, k_z, k_a = jax.random.split(k, 3)
-            real = _real(k_idx)
-            noise = _noise(k_z)
-            alpha = _alpha(k_a)
+            real = _real(k_idx[i])
 
-            loss_fn = lambda p: gp_critic_loss(p, state.g_params, real, noise, alpha)
+            loss_fn = lambda p: gp_critic_loss(p, real, fakes[i], alphas[i])
             d_params, d_opt, loss, _ = d_update(d_params, d_opt, loss_fn)
-            return d_params, d_opt, (noise, loss)
+            return d_params, d_opt, loss
 
-        d_params, d_opt, (noise, d_loss) = lax.fori_loop(
+        d_params, d_opt, d_loss = lax.fori_loop(
             0, tcfg.n_critic, critic_iter,
             (state.d_params, state.d_opt, _loop_init(key)))
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/MTSS_WGAN_GP.py:281)
-            return jnp.mean(-d_apply(state.d_params, g_apply(p, noise))), None
+            return jnp.mean(-d_apply(state.d_params, g_apply(p, noises[-1]))), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}

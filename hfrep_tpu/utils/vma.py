@@ -32,9 +32,12 @@ def shape_struct(shape, dtype, like) -> "jax.ShapeDtypeStruct":
     """`jax.ShapeDtypeStruct` carrying the vma of ``like`` — required for
     `pallas_call` out_shapes under `shard_map(check_vma=True)`, where
     every output aval must state how it varies over the mesh (a kernel
-    output varies exactly as much as its inputs do)."""
-    axes = vma_of(like)
-    return jax.ShapeDtypeStruct(shape, dtype, vma=axes if axes else None)
+    output varies exactly as much as its inputs do).  The vma is always a
+    (possibly empty) frozenset, never None: inside a check_vma shard_map
+    an all-invariant kernel (e.g. dp=1 controlled sampling) still needs
+    an explicit empty vma, and outside shard_map the empty set is
+    equivalent to the default."""
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma_of(like))
 
 
 def match_vma(x, like):

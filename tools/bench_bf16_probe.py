@@ -45,7 +45,6 @@ def main():
               f"({t32/t16:.2f}x)  max|Δh|={err:.2e}")
 
     # End-to-end: one flagship train epoch, f32+pallas vs bf16+scan.
-    import dataclasses
     from hfrep_tpu.config import ModelConfig, TrainConfig
     from hfrep_tpu.models.registry import build_gan
     from hfrep_tpu.train.states import init_gan_state

@@ -62,5 +62,5 @@ def report(w, f, steps_per_sec):
 
 
 if __name__ == "__main__":
-    report(48, 35, float(sys.argv[1]) if len(sys.argv) > 1 else 518.0)
-    report(168, 36, float(sys.argv[2]) if len(sys.argv) > 2 else 160.1)
+    report(48, 35, float(sys.argv[1]) if len(sys.argv) > 1 else 553.0)
+    report(168, 36, float(sys.argv[2]) if len(sys.argv) > 2 else 168.8)

@@ -37,7 +37,7 @@ for i, d in enumerate(dims):
     params_i = jax.tree_util.tree_map(lambda a: a[i], swept.params)
     eng.use_params(params_i, latent_mask(d, 21))
     eng.model_IS_r2(); eng.model_IS_RMSE()
-    r2 = eng.model_OOS_r2(); eng.model_OOS_RMSE()
+    eng.model_OOS_r2(); eng.model_OOS_RMSE()
     ante = eng.ante(rf_test); eng.post(panel.factors); eng.turnover()
     np.asarray(perf_stats.annualized_sharpe(jnp.asarray(ante),
                jnp.asarray(rf_test, jnp.float32)[-ante.shape[0]:]))
