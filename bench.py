@@ -90,10 +90,8 @@ def measure_dp(n_calls: int) -> float:
     through `make_dp_multi_step` (shard_map over a Mesh of the available
     chips — dp=1 on a single-chip host, where the delta vs the plain jit
     number is pure shard_map/collective overhead)."""
-    import numpy as np
-    from jax.sharding import Mesh
-
     from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+    from hfrep_tpu.parallel.mesh import make_mesh
 
     mcfg = ModelConfig(family="mtss_wgan_gp")
     tcfg = TrainConfig(steps_per_call=50)
@@ -101,7 +99,7 @@ def measure_dp(n_calls: int) -> float:
     pair = build_gan(mcfg)
     key = jax.random.PRNGKey(tcfg.seed)
     state = init_gan_state(key, mcfg, tcfg, pair)
-    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    mesh = make_mesh()
     multi = make_dp_multi_step(pair, tcfg, dataset, mesh)
 
     # TWO warmup calls: the first compile runs with unsharded inputs, the

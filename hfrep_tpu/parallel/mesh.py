@@ -37,10 +37,39 @@ def initialize_distributed(coordinator: Optional[str] = None,
     """Multi-host entry: join the pod-wide runtime before building meshes.
 
     Thin wrapper over `jax.distributed.initialize` so experiment CLIs can
-    expose ``--coordinator`` flags; on single-host it is a no-op.
+    expose ``--coordinator`` flags; on single-host it is a no-op.  After
+    it returns, `jax.devices()` spans every process (ICI within a host,
+    DCN across hosts on TPU pods; Gloo over TCP on CPU — how
+    ``tests/test_distributed.py`` exercises this path with two real
+    processes), and `make_mesh` builds the pod-wide ``('dp',)`` mesh with
+    no further code change.
     """
     if coordinator is None:
         return
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices owned by other processes — the
+    multi-host case where host-local arrays must be promoted to global
+    arrays before entering a jitted computation."""
+    pid = jax.process_index()
+    return any(d.process_index != pid for d in mesh.devices.flat)
+
+
+def replicate_to_global(tree, mesh: Mesh):
+    """Identical-per-process host data → mesh-replicated *global* arrays.
+
+    Multi-host jit rejects process-local arrays for cross-process meshes;
+    training state initialized from the same PRNG on every process is
+    byte-identical, so promoting it is a pure metadata operation (each
+    local device already holds the full copy).
+    """
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda x: multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, P()), tree)

@@ -41,7 +41,13 @@ class GanTrainer:
         if mesh is not None:
             # local import: parallel depends on train.states, avoid a cycle
             from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+            from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
             self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            if spans_processes(mesh):
+                # multi-host: promote the (identically-seeded) state and
+                # key to replicated global arrays for the pod-wide jit
+                self.state = replicate_to_global(self.state, mesh)
+                self.key = replicate_to_global(self.key, mesh)
         else:
             self._multi = make_multi_step(self.pair, cfg.train, self.windows)
         style = {"bce": "gan", "wgan_clip": "wgan", "wgan_gp": "wgan_gp"}[self.pair.loss]
@@ -224,10 +230,25 @@ class GanTrainer:
                               "data_max": self.scaler.data_max}
         return tree
 
+    def _multihost(self) -> bool:
+        if self.mesh is None:
+            return False
+        from hfrep_tpu.parallel.mesh import spans_processes
+        return spans_processes(self.mesh)
+
     def save_checkpoint(self, path: Optional[str] = None) -> str:
         path = path or f"{self.cfg.train.checkpoint_dir}/ckpt_{self.epoch}"
+        # Multi-host: state is replicated, so the leader's copy is the
+        # whole checkpoint — every other process writing the same path
+        # concurrently would race on shared storage.  The leader writes
+        # the coordination-free format: orbax's saver runs its own
+        # cross-process barrier, which a single-process save never exits.
+        multihost = self._multihost()
+        if multihost and jax.process_index() != 0:
+            return path
         ckpt.save(path, self._ckpt_tree(),
-                  metadata={"family": self.cfg.model.family, "epoch": self.epoch})
+                  metadata={"family": self.cfg.model.family, "epoch": self.epoch},
+                  coordination_free=multihost)
         return path
 
     def restore_checkpoint(self, path: Optional[str] = None) -> None:
@@ -242,6 +263,12 @@ class GanTrainer:
                                      ("g_params", "d_params", "g_opt", "d_opt", "step")})
         self.key = jnp.asarray(restored["key"])
         self.epoch = int(restored["epoch"])
+        if self._multihost():
+            # re-apply the global-array promotion __init__ performed: the
+            # cross-process jit rejects the host-local arrays restore built
+            from hfrep_tpu.parallel.mesh import replicate_to_global
+            self.state = replicate_to_global(self.state, self.mesh)
+            self.key = replicate_to_global(self.key, self.mesh)
 
     # ------------------------------------------------------------ sampling
     def generate(self, key: jax.Array, n_samples: int,

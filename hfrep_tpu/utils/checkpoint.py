@@ -27,17 +27,29 @@ def _ocp():
     return ocp
 
 
-def save(path: str, pytree: Any, metadata: Optional[dict] = None) -> None:
+def save(path: str, pytree: Any, metadata: Optional[dict] = None,
+         coordination_free: bool = False) -> None:
+    """``coordination_free=True`` writes the msgpack format directly —
+    required for leader-only multi-host checkpointing of replicated
+    state, where orbax's internal cross-process barrier would deadlock a
+    single-process save (the other processes never reach it)."""
     p = Path(path).absolute()
     p.parent.mkdir(parents=True, exist_ok=True)
     pytree = jax.device_get(pytree)
-    try:
-        ckptr = _ocp().PyTreeCheckpointer()
-        ckptr.save(p, pytree, force=True)
-    except Exception:
+
+    def _msgpack():
         import flax.serialization as ser
         p.mkdir(parents=True, exist_ok=True)
         (p / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
+
+    if coordination_free:
+        _msgpack()
+    else:
+        try:
+            ckptr = _ocp().PyTreeCheckpointer()
+            ckptr.save(p, pytree, force=True)
+        except Exception:
+            _msgpack()
     if metadata is not None:
         (p.parent / (p.name + ".meta.json")).write_text(json.dumps(metadata))
 
