@@ -40,6 +40,13 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--n-samples", type=int, default=10)
     t.add_argument("--eval", action="store_true", help="run the 12-metric suite after training")
     t.add_argument("--mesh", action="store_true", help="data-parallel over all devices")
+    t.add_argument("--coordinator", default=None,
+                   help="multi-host: coordinator address host:port — every "
+                        "process runs this same command with its own "
+                        "--process-id; implies --mesh over the pod-wide "
+                        "devices (parallel/mesh.py::initialize_distributed)")
+    t.add_argument("--num-processes", type=int, default=None)
+    t.add_argument("--process-id", type=int, default=None)
     t.add_argument("--quiet", action="store_true")
     t.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint in --checkpoint-dir "
@@ -133,6 +140,13 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
 def cmd_train_gan(args) -> int:
     import jax
 
+    if args.coordinator:
+        # multi-host: join the pod before any device/mesh use; the mesh
+        # then spans every process's devices
+        from hfrep_tpu.parallel.mesh import initialize_distributed
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id)
+        args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh, args.quiet)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
@@ -152,20 +166,26 @@ def cmd_train_gan(args) -> int:
     rate = (f" ({trainer.steps_per_sec:.2f} steps/s)"
             if trainer.timer.samples else " (schedule already complete)")
     print(f"trained {cfg.model.family} for {trainer.epoch} epochs{rate}")
+    # Multi-host: the replicated state makes every process's artifacts
+    # identical — jitted computations (generate/eval) must still run on
+    # every process (SPMD), but only the leader touches shared storage.
+    leader = not args.coordinator or jax.process_index() == 0
     if args.checkpoint_dir:
-        path = trainer.save_checkpoint()
+        path = trainer.save_checkpoint()     # leader-gated internally
         print(f"checkpoint: {path}")
     if args.samples_out:
         cube = trainer.generate(jax.random.PRNGKey(9), args.n_samples)
-        np.save(args.samples_out, np.asarray(cube))
+        if leader:
+            np.save(args.samples_out, np.asarray(cube))
         print(f"samples: {args.samples_out} {tuple(cube.shape)}")
     if args.eval:
         _eval_trainer_samples(trainer, ds, out=None)
     if args.export_h5:
         from hfrep_tpu.utils.keras_export import export_keras_generator
-        path = export_keras_generator(cfg.model, trainer.state.g_params,
-                                      args.export_h5)
-        print(f"keras artifact: {path}")
+        if leader:
+            path = export_keras_generator(cfg.model, trainer.state.g_params,
+                                          args.export_h5)
+            print(f"keras artifact: {path}")
     return 0
 
 
@@ -334,6 +354,14 @@ def _enable_compilation_cache() -> None:
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+    # HFREP_PLATFORM overrides the backend before jax initializes — the
+    # only override that beats a sitecustomize-pinned jax_platforms (the
+    # JAX_PLATFORMS env var loses to it).  Needed e.g. to run several
+    # CLI processes on CPU for a multi-host drill on one machine.
+    platform = os.environ.get("HFREP_PLATFORM")
+    if platform and args.cmd != "clean":
+        import jax
+        jax.config.update("jax_platforms", platform)
     if args.cmd != "clean":            # clean is jax-free; keep startup light
         _enable_compilation_cache()
     return {"clean": cmd_clean, "train-gan": cmd_train_gan,

@@ -140,3 +140,59 @@ def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
     x = jax.device_put(x, sharding)
     return sp_lstm(params["kernel"], params["recurrent_kernel"], params["bias"],
                    x, mesh, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _sp_ln(p: dict, v: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Window-sharded LayerNorm between the pipelined recurrences — the
+    same :class:`~hfrep_tpu.ops.layers.KerasLayerNorm` module the
+    single-device generator runs, so the two paths cannot drift; jitted
+    once at module level (per-timestep math partitions with zero
+    communication under GSPMD)."""
+    from hfrep_tpu.ops.layers import KerasLayerNorm
+
+    return KerasLayerNorm(epsilon=eps).apply({"params": p}, v)
+
+
+@functools.partial(jax.jit, static_argnames=("slope", "eps"))
+def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.ndarray:
+    """LeakyReLU → LN → Dense tail of the generator, on sharded operands,
+    built from the same primitives as the single-device model."""
+    from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
+
+    v = leaky_relu(v, slope)
+    v = KerasLayerNorm(epsilon=eps).apply(
+        {"params": g_params["KerasLayerNorm_1"]}, v)
+    features = g_params["KerasDense_0"]["Dense_0"]["kernel"].shape[1]
+    return KerasDense(features).apply({"params": g_params["KerasDense_0"]}, v)
+
+
+def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
+                axis_name: str = "sp", slope: float = 0.2,
+                activation: str = "sigmoid",
+                ln_eps: float = 1e-3) -> jnp.ndarray:
+    """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
+    Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
+    window axis sharded over ``axis_name`` — long-window synthesis
+    (W ≫ 168) on a mesh.
+
+    The two recurrences run the pipelined carry-handoff scan
+    (:func:`sp_lstm`); every other layer is per-timestep, so under GSPMD
+    with window-sharded operands it partitions with zero communication —
+    only the two LSTMs' (h, c) ppermutes touch ICI.  ``g_params`` is the
+    LSTMGenerator tree (``KerasLSTM_0/1``, ``KerasLayerNorm_0/1``,
+    ``KerasDense_0``); output matches the single-device
+    ``generator.apply`` to f32 round-off (tests/test_sequence.py).
+    """
+    sharding = NamedSharding(mesh, P(None, axis_name, None))
+    z = jax.device_put(z, sharding)
+
+    kw = dict(axis_name=axis_name, activation=activation)
+    x = sp_lstm(g_params["KerasLSTM_0"]["kernel"],
+                g_params["KerasLSTM_0"]["recurrent_kernel"],
+                g_params["KerasLSTM_0"]["bias"], z, mesh, **kw)
+    x = _sp_ln(g_params["KerasLayerNorm_0"], x, ln_eps)
+    x = sp_lstm(g_params["KerasLSTM_1"]["kernel"],
+                g_params["KerasLSTM_1"]["recurrent_kernel"],
+                g_params["KerasLSTM_1"]["bias"], x, mesh, **kw)
+    return _sp_head(g_params, x, slope, ln_eps)

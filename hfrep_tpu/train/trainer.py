@@ -278,6 +278,11 @@ class GanTrainer:
         (``autoencoder_v4.ipynb`` cell 43), inverse-scaled by default."""
         w, f = self.windows.shape[1], self.windows.shape[2]
         noise = jax.random.normal(key, (n_samples, w, f))
+        if self._multihost():
+            # params are pod-global arrays; the jit rejects mixing them
+            # with a process-local noise array
+            from hfrep_tpu.parallel.mesh import replicate_to_global
+            noise = replicate_to_global(noise, self.mesh)
         if self._generate_fn is None:
             from hfrep_tpu.train.steps import resolve_lstm_backend
             be = resolve_lstm_backend(self.cfg.train.lstm_backend)
@@ -287,4 +292,9 @@ class GanTrainer:
         if unscale and self.scaler is not None:
             from hfrep_tpu.core import scaler as mm
             out = mm.inverse_transform(self.scaler, out)
+        if self._multihost():
+            # hand every process a plain local copy (the output is
+            # replicated) so downstream numpy/eval code needs no
+            # global-array awareness
+            out = jnp.asarray(jax.device_get(out))
         return out

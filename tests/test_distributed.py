@@ -94,9 +94,12 @@ CHILD = textwrap.dedent("""
     assert tr2.epoch == 4
     tr2.train(epochs=2)
     assert int(tr2.state.step) == 6
+    cube = tr2.generate(jax.random.PRNGKey(5), 2, unscale=False)
+    assert cube.shape == (2, 8, 5)
     print("TRAINER " + json.dumps({"process": pid,
                                    "g_loss": last["g_loss"],
-                                   "resumed_g_loss": tr2.history[-1]["g_loss"]}),
+                                   "resumed_g_loss": tr2.history[-1]["g_loss"],
+                                   "gen_sum": float(jnp.sum(cube))}),
           flush=True)
 """)
 
@@ -146,6 +149,8 @@ def test_two_process_dp_matches_single_device(tmp_path):
                                trainer_results[1]["g_loss"], rtol=1e-6)
     np.testing.assert_allclose(trainer_results[0]["resumed_g_loss"],
                                trainer_results[1]["resumed_g_loss"], rtol=1e-6)
+    np.testing.assert_allclose(trainer_results[0]["gen_sum"],
+                               trainer_results[1]["gen_sum"], rtol=1e-6)
 
     # both processes computed the identical replicated result
     np.testing.assert_allclose(results[0]["d_loss"], results[1]["d_loss"],
@@ -175,3 +180,30 @@ def test_two_process_dp_matches_single_device(tmp_path):
     leaf0 = jax.tree_util.tree_leaves(state.g_params)[0]
     np.testing.assert_allclose(results[0]["g_leaf0_sum"],
                                float(jnp.sum(leaf0)), atol=1e-4)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
+@pytest.mark.skipif(not os.path.isdir("/root/reference/cleaned_data"),
+                    reason="reference data not mounted")
+def test_cli_multihost_drill():
+    """The user-facing multi-host entry: two CLI processes joined with
+    --coordinator/--process-id train the same schedule on one pod-wide
+    mesh (HFREP_PLATFORM=cpu pins both off the tunneled TPU)."""
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "HFREP_PLATFORM": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "JAX_PLATFORMS": ""}
+    cmd = [sys.executable, "-m", "hfrep_tpu", "train-gan", "--preset", "wgan",
+           "--epochs", "4", "--quiet",
+           "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2"]
+    procs = [subprocess.Popen(cmd + ["--process-id", str(pid)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              env=env, text=True, cwd=repo_root)
+             for pid in (0, 1)]
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"process {pid} failed:\n{out}\n{err}"
+        assert "trained wgan for 4 epochs" in out, out

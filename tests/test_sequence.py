@@ -50,6 +50,25 @@ def test_sigmoid_variant():
 
 
 @needs_8
+@pytest.mark.parametrize("b,w", [(8, 16), (8, 128)])
+def test_sp_full_generator_matches_single_device(b, w):
+    """The complete MTSS generator (both LSTMs + LN/LeakyReLU/Dense head)
+    window-sharded over the sp mesh must equal the single-device apply —
+    the long-window synthesis path (W=128 case is 16 timesteps/device)."""
+    from hfrep_tpu.models.generators import LSTMGenerator
+    from hfrep_tpu.parallel.sequence import sp_generate
+
+    gen = LSTMGenerator(features=6, hidden=8)
+    key = jax.random.PRNGKey(9)
+    z = jax.random.normal(jax.random.fold_in(key, 1), (b, w, 6))
+    params = gen.init(key, z)["params"]
+    want = gen.apply({"params": params}, z)
+    got = sp_generate(params, z, _mesh(8))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_8
 def test_sharded_input_wrapper():
     key = jax.random.PRNGKey(4)
     mod, p = _params(key, 4, 8)
