@@ -130,6 +130,41 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
     return mapped(kernel, recurrent, bias, x)
 
 
+def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: str = "sp", jit: bool = True):
+    """Sequence-parallel MTSS-WGAN-GP training: the full epoch (n_critic
+    GP critic updates + generator update) with the window axis sharded.
+
+    Long-window training, not just synthesis: every generator/critic
+    forward — including the gradient penalty's input-grad and the
+    second-order path through it — runs the pipelined window-sharded
+    recurrences (:func:`sp_generate` / :func:`sp_critic`); AD transposes
+    the ppermute carry handoffs and the psum'd critic head
+    automatically.  All other step semantics (sampling streams, critic
+    loop, optimizer updates) are shared verbatim with the single-device
+    step via ``make_train_step(apply_fns=...)``, so a moderate-W sp run
+    is trajectory-comparable to the plain step (tests/test_sequence.py).
+
+    Requires the flagship ``mtss_wgan_gp`` family (the sp modules mirror
+    its LSTMGenerator / LSTMFlatCritic trees).
+    """
+    from hfrep_tpu.train.steps import make_train_step
+
+    if pair.family != "mtss_wgan_gp":
+        raise ValueError(f"sequence-parallel step supports the "
+                         f"mtss_wgan_gp family, got {pair.family!r}")
+    if (pair.generator.dtype or jnp.float32) != jnp.float32:
+        raise NotImplementedError(
+            "sequence-parallel step runs f32; configure dtype=float32")
+    slope = pair.generator.slope
+
+    g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=axis_name,
+                                       activation="sigmoid", slope=slope)
+    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name)
+    step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
+
+
 def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
                           **kw) -> jnp.ndarray:
     """Convenience wrapper taking a KerasLSTM param dict
@@ -165,6 +200,48 @@ def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.nd
         {"params": g_params["KerasLayerNorm_1"]}, v)
     features = g_params["KerasDense_0"]["Dense_0"]["kernel"].shape[1]
     return KerasDense(features).apply({"params": g_params["KerasDense_0"]}, v)
+
+
+def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
+              axis_name: str = "sp") -> jnp.ndarray:
+    """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
+    :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
+    window axis sharded — (B, W, F) → (B, 1) scores.
+
+    The two recurrences pipeline via :func:`sp_lstm`; the flattened
+    (W·H → 1) head is a window-sharded contraction: each device dots its
+    local (B, Wl, H) chunk with its Wl·H slice of the Dense kernel and a
+    single `psum` over ``axis_name`` completes the reduction — the only
+    collective beyond the carry handoffs.  Differentiable end to end
+    (ppermute/psum transposes), which is what sequence-parallel WGAN-GP
+    *training* needs; exactness and gradient tests in
+    tests/test_sequence.py.
+    """
+    h1 = sp_lstm(d_params["KerasLSTM_0"]["kernel"],
+                 d_params["KerasLSTM_0"]["recurrent_kernel"],
+                 d_params["KerasLSTM_0"]["bias"], x, mesh,
+                 axis_name=axis_name)
+    h2 = sp_lstm(d_params["KerasLSTM_1"]["kernel"],
+                 d_params["KerasLSTM_1"]["recurrent_kernel"],
+                 d_params["KerasLSTM_1"]["bias"], h1, mesh,
+                 axis_name=axis_name)
+
+    dense = d_params["KerasDense_0"]["Dense_0"]
+    b, w, h = h2.shape
+    kernel_w = dense["kernel"].reshape(w, h, -1)     # (W, H, 1): shardable by W
+
+    def local_head(h_local, k_local):
+        bb, wl, hh = h_local.shape
+        part = h_local.reshape(bb, wl * hh) @ k_local.reshape(wl * hh, -1)
+        return lax.psum(part, axis_name)
+
+    scores = shard_map(
+        local_head, mesh=mesh,
+        in_specs=(P(None, axis_name, None), P(axis_name, None, None)),
+        out_specs=P())(h2, kernel_w)
+    if "bias" in dense:
+        scores = scores + dense["bias"]
+    return scores
 
 
 def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,

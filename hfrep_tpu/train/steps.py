@@ -141,8 +141,16 @@ def resolve_lstm_backend(choice: str) -> str:
 
 def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                     axis_name: Optional[str] = None,
-                    sample_batch: Optional[int] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
+                    sample_batch: Optional[int] = None,
+                    apply_fns: Optional[Tuple[Callable, Callable]] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
     """Build ``step(state, key) -> (state, metrics)`` for one epoch.
+
+    ``apply_fns=(g_apply, d_apply)`` overrides how the generator/critic
+    are evaluated while keeping every other step semantic (sampling
+    streams, critic loop, GP, optimizer updates) — how the
+    sequence-parallel long-window step reuses this machinery with
+    window-sharded forward passes
+    (:func:`hfrep_tpu.parallel.sequence.make_sp_train_step`).
 
     ``sample_batch`` (> ``tcfg.batch_size``, dp only) switches to
     *controlled global sampling*: every device draws the identical
@@ -160,9 +168,12 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     # twice-differentiable end to end (nested custom_vjps with a
     # hand-derived adjoint kernel, hfrep_tpu/ops/pallas_lstm.py, tested
     # against the XLA double backward).
-    be = resolve_lstm_backend(tcfg.lstm_backend)
-    g_apply = lambda p, z, backend=be: pair.generator.apply({"params": p}, z, backend=backend)
-    d_apply = lambda p, x, backend=be: pair.discriminator.apply({"params": p}, x, backend=backend)
+    if apply_fns is not None:
+        g_apply, d_apply = apply_fns
+    else:
+        be = resolve_lstm_backend(tcfg.lstm_backend)
+        g_apply = lambda p, z, backend=be: pair.generator.apply({"params": p}, z, backend=backend)
+        d_apply = lambda p, x, backend=be: pair.discriminator.apply({"params": p}, x, backend=backend)
     batch = tcfg.batch_size
     sample_b = sample_batch if sample_batch is not None else batch
     if sample_b != batch and axis_name is None:

@@ -69,6 +69,95 @@ def test_sp_full_generator_matches_single_device(b, w):
 
 
 @needs_8
+def test_sp_critic_matches_single_device_with_grads():
+    """Window-sharded critic (pipelined LSTMs + psum'd flatten-Dense)
+    must match LSTMFlatCritic in value AND in gradients w.r.t. both
+    params and inputs — the pieces sequence-parallel WGAN-GP training
+    needs (input-grad is the gradient-penalty path)."""
+    from hfrep_tpu.models.discriminators import LSTMFlatCritic
+    from hfrep_tpu.parallel.sequence import sp_critic
+
+    critic = LSTMFlatCritic(hidden=8)
+    key = jax.random.PRNGKey(11)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 6))
+    params = critic.init(key, x)["params"]
+    mesh = _mesh(8)
+
+    want = critic.apply({"params": params}, x)
+    got = sp_critic(params, x, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_ref(p, v):
+        return jnp.sum(critic.apply({"params": p}, v) ** 2)
+
+    def loss_sp(p, v):
+        return jnp.sum(sp_critic(p, v, mesh) ** 2)
+
+    gp_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(params, x)
+    gp_sp, gx_sp = jax.grad(loss_sp, argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(gp_sp),
+                    jax.tree_util.tree_leaves(gp_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx_sp), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@needs_8
+def test_sp_train_step_matches_plain_step():
+    """Sequence-parallel WGAN-GP training (window sharded over 8 devices,
+    GP second-order through the pipelined recurrences) must follow the
+    plain single-device step's trajectory at the same key — long-window
+    *training*, exact."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_train_step
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2)
+    dataset = jnp.asarray(np.random.default_rng(3).uniform(
+        0, 1, (32, 16, 5)).astype(np.float32))
+    pair = build_gan(mcfg)
+    mesh = _mesh(8)
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    sp_state, sp_m = make_sp_train_step(pair, tcfg, dataset, mesh)(
+        s0, jax.random.PRNGKey(1))
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    ref_state, ref_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+
+    for k in ref_m:
+        np.testing.assert_allclose(float(sp_m[k]), float(ref_m[k]),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(sp_state.g_params)
+                    + jax.tree_util.tree_leaves(sp_state.d_params),
+                    jax.tree_util.tree_leaves(ref_state.g_params)
+                    + jax.tree_util.tree_leaves(ref_state.d_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    assert int(sp_state.step) == 1
+
+
+@needs_8
+def test_sp_train_step_rejects_wrong_family():
+    """The Dense 'wgan_gp' family shares the loss kind but not the param
+    trees the sp modules mirror — must fail loudly at build time."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_train_step
+
+    pair = build_gan(ModelConfig(family="wgan_gp", features=5, window=16, hidden=8))
+    data = jnp.zeros((8, 16, 5))
+    with pytest.raises(ValueError, match="mtss_wgan_gp"):
+        make_sp_train_step(pair, TrainConfig(batch_size=8), data, _mesh(8))
+
+
+@needs_8
 def test_sharded_input_wrapper():
     key = jax.random.PRNGKey(4)
     mod, p = _params(key, 4, 8)
