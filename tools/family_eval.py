@@ -1,0 +1,54 @@
+"""Train all six GAN families from scratch on chip and score each with
+the 12-metric suite vs the real windows — the producer of
+``results/family_eval.json`` (RESULTS.md "All six families" table; the
+reference's model-selection experiment, ``README.md:8`` + the six
+``GAN/*.py`` ``__main__`` blocks at 5000 epochs / batch 32).
+"""
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import time
+
+import jax
+
+
+def main(out="results/family_eval.json"):
+
+    from hfrep_tpu.config import get_preset
+    from hfrep_tpu.core.data import build_gan_dataset, load_panel
+    from hfrep_tpu.metrics.gan_eval import GanEval
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    panel = load_panel()
+    results = {}
+    for preset in ("gan_1k", "wgan", "wgan_gp", "mtss_gan", "mtss_wgan",
+                   "mtss_wgan_gp"):
+        cfg = get_preset(preset)
+        ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
+        tr = GanTrainer(cfg, ds)
+        t0 = time.perf_counter()
+        tr.train()
+        wall = time.perf_counter() - t0
+        n = min(500, ds.windows.shape[0])
+        fake = tr.generate(jax.random.PRNGKey(11), n, unscale=False)
+        suite = GanEval(ds.windows[:n], fake, ds.windows,
+                        model_name=[cfg.model.family])
+        res = suite.run_all()
+        res["train_wall_s"] = round(wall, 2)
+        res["epochs"] = tr.epoch
+        results[cfg.model.family] = res
+        print(f"{cfg.model.family}: {tr.epoch} epochs in {wall:.1f}s  "
+              f"FID={res.get('FID'):.4g}  JS={res.get('js_div'):.4g}",
+              flush=True)
+
+    if os.path.dirname(out):
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, default=str)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
