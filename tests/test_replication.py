@@ -269,8 +269,8 @@ class TestSpanning:
         alpha = b[0][:, None]
         fm = fac.mean(axis=0, keepdims=True)
         omega = (fac - fm).T @ (fac - fm) / (t - 1)
-        tem1 = float((alpha.T @ np.linalg.inv(sigma) @ alpha).item())
-        tem2 = 1 + float((fm @ np.linalg.inv(omega) @ fm.T).item())
+        tem1 = (alpha.T @ np.linalg.inv(sigma) @ alpha).item()
+        tem2 = 1 + (fm @ np.linalg.inv(omega) @ fm.T).item()
         return (t / n) * ((t - n - k) / (t - k - 1)) * tem1 / tem2
 
     def test_grs_matches_numpy(self, rng):
