@@ -54,6 +54,13 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--export-h5", default=None,
                    help="after training, write the generator as a reference-"
                         "compatible Keras .h5 (loads in the notebook's cell 42)")
+    t.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace into this directory "
+                        "(view with tensorboard/xprof). Only the first "
+                        "couple of dispatch blocks are traced — compile + "
+                        "steady state — so the trace stays loadable and "
+                        "host memory bounded even for 5000-epoch runs; "
+                        "the rest of the schedule trains untraced")
 
     e = sub.add_parser("eval-gan", help="score a saved sample cube")
     e.add_argument("--samples", required=True, help=".npy cube, inverse-scaled returns")
@@ -162,7 +169,19 @@ def cmd_train_gan(args) -> int:
             print(f"resumed from {path} (epoch {trainer.epoch})")
             # recovery completes the original schedule, not epochs on top
             target = max(0, target - trainer.epoch)
-    trainer.train(epochs=target)
+    if args.profile_dir:
+        from hfrep_tpu.utils.profiling import trace
+
+        # Trace a bounded window (compile + one steady-state block): an
+        # unbounded trace of a 5000-epoch run buffers millions of events
+        # on the host and produces a file xprof can't open.
+        traced = min(target, 2 * cfg.train.steps_per_call)
+        with trace(args.profile_dir):
+            trainer.train(epochs=traced)
+        print(f"profile: {args.profile_dir} (first {traced} epochs)")
+        trainer.train(epochs=target - traced)
+    else:
+        trainer.train(epochs=target)
     rate = (f" ({trainer.steps_per_sec:.2f} steps/s)"
             if trainer.timer.samples else " (schedule already complete)")
     print(f"trained {cfg.model.family} for {trainer.epoch} epochs{rate}")

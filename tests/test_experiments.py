@@ -148,6 +148,7 @@ class TestCli:
         ck = str(tmp_path / "ck")
         args = ["train-gan", "--preset", "gan_1k", "--epochs", "3",
                 "--quiet", "--checkpoint-dir", ck,
+                "--profile-dir", str(tmp_path / "prof"),
                 "--samples-out", str(tmp_path / "gen.npy")]
         try:
             import tensorflow  # noqa: F401
@@ -157,6 +158,8 @@ class TestCli:
             has_tf = False
         assert main(args) == 0
         assert np.load(tmp_path / "gen.npy").shape == (10, 48, 35)
+        assert any((tmp_path / "prof").rglob("*.xplane.pb")), \
+            "profiler trace not written"
         if has_tf:
             from hfrep_tpu.utils.keras_import import load_keras_generator
             _, _, shape = load_keras_generator(str(tmp_path / "gen.h5"))
