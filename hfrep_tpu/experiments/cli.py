@@ -169,7 +169,7 @@ def cmd_train_gan(args) -> int:
             print(f"resumed from {path} (epoch {trainer.epoch})")
             # recovery completes the original schedule, not epochs on top
             target = max(0, target - trainer.epoch)
-    if args.profile_dir:
+    if args.profile_dir and target:
         from hfrep_tpu.utils.profiling import trace
 
         # Trace a bounded window (compile + one steady-state block): an
@@ -181,6 +181,8 @@ def cmd_train_gan(args) -> int:
         print(f"profile: {args.profile_dir} (first {traced} epochs)")
         trainer.train(epochs=target - traced)
     else:
+        if args.profile_dir:
+            print("no epochs to run; nothing to profile")
         trainer.train(epochs=target)
     rate = (f" ({trainer.steps_per_sec:.2f} steps/s)"
             if trainer.timer.samples else " (schedule already complete)")

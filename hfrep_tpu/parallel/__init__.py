@@ -1,2 +1,13 @@
-from hfrep_tpu.parallel.mesh import make_mesh  # noqa: F401
+from hfrep_tpu.parallel.mesh import (  # noqa: F401
+    initialize_distributed,
+    make_mesh,
+    replicate_to_global,
+    spans_processes,
+)
 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step  # noqa: F401
+from hfrep_tpu.parallel.sequence import (  # noqa: F401
+    make_sp_train_step,
+    sp_critic,
+    sp_generate,
+    sp_lstm,
+)

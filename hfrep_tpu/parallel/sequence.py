@@ -52,12 +52,27 @@ def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarr
     return lax.scan(cell, carry, xz_chunk)
 
 
+def _resolve_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
+    """Default the sharded-window axis: the mesh's only axis for a 1-D
+    mesh (dp- or sp-named — callers need not thread axis names), or an
+    axis literally named ``"sp"`` on a multi-axis mesh."""
+    if axis_name is not None:
+        return axis_name
+    if len(mesh.axis_names) == 1:
+        return mesh.axis_names[0]
+    if "sp" in mesh.axis_names:
+        return "sp"
+    raise ValueError(
+        f"pass axis_name explicitly for multi-axis mesh {mesh.axis_names}")
+
+
 def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
-            x: jnp.ndarray, mesh: Mesh, *, axis_name: str = "sp",
+            x: jnp.ndarray, mesh: Mesh, *, axis_name: Optional[str] = None,
             microbatches: Optional[int] = None,
             activation: str = "tanh",
             recurrent_activation: str = "sigmoid") -> jnp.ndarray:
-    """LSTM over (B, W, F) with W sharded across ``axis_name``.
+    """LSTM over (B, W, F) with W sharded across ``axis_name`` (defaults
+    to the mesh's only axis).
 
     Returns the full hidden sequence (B, W, H), sharded over W the same
     way.  ``microbatches`` defaults to the number of ``sp`` devices
@@ -66,6 +81,7 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
     transform, sigmoid gates); the reference's generators override the
     candidate transform with sigmoid (``GAN/MTSS_WGAN_GP.py:224-226``).
     """
+    axis_name = _resolve_axis(mesh, axis_name)
     n_dev = mesh.shape[axis_name]
     b, w, f = x.shape
     h = recurrent.shape[0]
@@ -131,7 +147,7 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
 
 
 def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
-                       axis_name: str = "sp", jit: bool = True):
+                       axis_name: Optional[str] = None, jit: bool = True):
     """Sequence-parallel MTSS-WGAN-GP training: the full epoch (n_critic
     GP critic updates + generator update) with the window axis sharded.
 
@@ -150,6 +166,7 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     """
     from hfrep_tpu.train.steps import make_train_step
 
+    axis_name = _resolve_axis(mesh, axis_name)
     if pair.family != "mtss_wgan_gp":
         raise ValueError(f"sequence-parallel step supports the "
                          f"mtss_wgan_gp family, got {pair.family!r}")
@@ -203,7 +220,7 @@ def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.nd
 
 
 def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
-              axis_name: str = "sp") -> jnp.ndarray:
+              axis_name: Optional[str] = None) -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
@@ -217,6 +234,7 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     *training* needs; exactness and gradient tests in
     tests/test_sequence.py.
     """
+    axis_name = _resolve_axis(mesh, axis_name)
     h1 = sp_lstm(d_params["KerasLSTM_0"]["kernel"],
                  d_params["KerasLSTM_0"]["recurrent_kernel"],
                  d_params["KerasLSTM_0"]["bias"], x, mesh,
@@ -245,7 +263,7 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
 
 
 def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
-                axis_name: str = "sp", slope: float = 0.2,
+                axis_name: Optional[str] = None, slope: float = 0.2,
                 activation: str = "sigmoid",
                 ln_eps: float = 1e-3) -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
@@ -261,6 +279,7 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     ``KerasDense_0``); output matches the single-device
     ``generator.apply`` to f32 round-off (tests/test_sequence.py).
     """
+    axis_name = _resolve_axis(mesh, axis_name)
     sharding = NamedSharding(mesh, P(None, axis_name, None))
     z = jax.device_put(z, sharding)
 

@@ -200,8 +200,10 @@ def test_psum_if_handles_both_vma_cases(dataset):
                   check_vma=False)(w, batch)
 
 
-@pytest.mark.parametrize("family", ["wgan", "mtss_wgan_gp"])
-def test_dp_trajectory_matches_single_device(family, dataset):
+@pytest.mark.parametrize("family,n_dev", [("wgan", 8), ("mtss_wgan_gp", 8),
+                                          ("mtss_wgan_gp", 4),
+                                          ("mtss_wgan_gp", 2)])
+def test_dp_trajectory_matches_single_device(family, n_dev, dataset):
     """dp=8 with controlled global sampling must follow the *whole* loss
     trajectory (and land on the same parameters) as a single-device run at
     the same global batch and key — not just one gradient.
@@ -212,8 +214,10 @@ def test_dp_trajectory_matches_single_device(family, dataset):
     divergence anywhere in the step (optimizer, clip, GP, metrics) would
     surface here.  It caught a real bug: pmean on top of the vma system's
     auto-psum left gradients n_dev× too large, invisible in loss curves
-    because Adam/RMSprop are scale-invariant except through eps."""
-    mesh = make_mesh()
+    because Adam/RMSprop are scale-invariant except through eps.
+    Parametrized over device counts: determinism must hold for ANY mesh
+    size, not just the full 8 (SURVEY §5.2)."""
+    mesh = make_mesh(devices=jax.devices()[:n_dev])
     mcfg = dataclasses.replace(MCFG, family=family)
     tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=4)
     pair = build_gan(mcfg)
