@@ -23,6 +23,13 @@ per timestep.
 Exactness: the pipeline computes the identical recurrence (same order,
 same arithmetic) as the single-device scan — verified to float32
 round-off in tests/test_sequence.py on an 8-device CPU mesh.
+
+Future work: the per-chunk recurrence currently runs the XLA scan cell;
+swapping in the pallas kernel needs carry-injection variants of the
+fwd/bwd/adjoint kernels (today they hard-init h0=c0=0) and is only
+testable on real multi-chip hardware (interpret-mode pallas cannot
+propagate vma under shard_map) — deferred until a pod is available to
+measure it on.
 """
 
 from __future__ import annotations
