@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import pickle
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -177,14 +176,16 @@ def run_cleaning(raw_dir: str, out_dir: Optional[str] = None) -> CleanResult:
     rf_df = rf.to_frame()
     res = CleanResult(hfd=hfd, factor_etf=factor, rf=rf_df)
     if out_dir is not None:
+        from hfrep_tpu.core.data import dic_save
+
         os.makedirs(out_dir, exist_ok=True)
         hfd.to_csv(os.path.join(out_dir, "hfd.csv"))
         factor.to_csv(os.path.join(out_dir, "factor_etf_data.csv"))
         rf_df.to_csv(os.path.join(out_dir, "rf.csv"))
-        with open(os.path.join(out_dir, "hfd_fullname.pkl"), "wb") as f:
-            pickle.dump(HF_FULLNAMES, f)
-        with open(os.path.join(out_dir, "factor_etf_name.pkl"), "wb") as f:
-            pickle.dump(FACTOR_FULLNAMES, f)
+        # dic_save = write + read-back through the restricted unpickler
+        # (helper.py:155-162 semantics + the plain-data invariant)
+        dic_save(HF_FULLNAMES, os.path.join(out_dir, "hfd_fullname.pkl"))
+        dic_save(FACTOR_FULLNAMES, os.path.join(out_dir, "factor_etf_name.pkl"))
     return res
 
 

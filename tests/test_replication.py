@@ -223,6 +223,12 @@ class TestPerfStats:
         ref = (r.mean() - rf.mean()) / r.std() * np.sqrt(12)
         np.testing.assert_allclose(float(perf_stats.annualized_sharpe(r, rf)), ref, rtol=1e-4)
 
+    def test_var_matches_percentile(self, rng):
+        """historicalVaR (cell 23): the 5th percentile per column."""
+        r = rng.normal(0.0, 0.05, (300, 2))
+        np.testing.assert_allclose(perf_stats.historical_var(r),
+                                   np.percentile(r, 5, axis=0), rtol=1e-12)
+
     def test_cvar_matches_formula(self, rng):
         r = rng.normal(0.0, 0.05, (300, 2))
         var = np.percentile(r, 5, axis=0)
