@@ -29,7 +29,8 @@ def test_mesh_uses_all_devices():
     assert mesh.axis_names == ("dp",)
 
 
-@pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp", "mtss_wgan_gp"])
+@pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp", "mtss_gan",
+                                    "mtss_wgan", "mtss_wgan_gp"])
 def test_dp_step_runs_and_replicates(family, dataset):
     mesh = make_mesh()
     tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=2)
@@ -200,7 +201,8 @@ def test_psum_if_handles_both_vma_cases(dataset):
                   check_vma=False)(w, batch)
 
 
-@pytest.mark.parametrize("family,n_dev", [("wgan", 8), ("mtss_wgan_gp", 8),
+@pytest.mark.parametrize("family,n_dev", [("gan", 8), ("wgan", 8),
+                                          ("mtss_wgan_gp", 8),
                                           ("mtss_wgan_gp", 4),
                                           ("mtss_wgan_gp", 2)])
 def test_dp_trajectory_matches_single_device(family, n_dev, dataset):
