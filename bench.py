@@ -113,7 +113,7 @@ def measure_dp(n_calls: int) -> float:
         state, metrics = multi(state, jax.random.fold_in(key, i))
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
-    assert jnp.isfinite(metrics["d_loss"]).all()
+    assert jnp.isfinite(metrics["d_loss"]).all() and jnp.isfinite(metrics["g_loss"]).all()
     return n_calls * tcfg.steps_per_call / dt
 
 

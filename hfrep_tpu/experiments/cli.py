@@ -193,12 +193,13 @@ def cmd_train_gan(args) -> int:
     leader = not args.coordinator or jax.process_index() == 0
     if args.checkpoint_dir:
         path = trainer.save_checkpoint()     # leader-gated internally
-        print(f"checkpoint: {path}")
+        if leader:
+            print(f"checkpoint: {path}")
     if args.samples_out:
         cube = trainer.generate(jax.random.PRNGKey(9), args.n_samples)
         if leader:
             np.save(args.samples_out, np.asarray(cube))
-        print(f"samples: {args.samples_out} {tuple(cube.shape)}")
+            print(f"samples: {args.samples_out} {tuple(cube.shape)}")
     if args.eval:
         _eval_trainer_samples(trainer, ds, out=None)
     if args.export_h5:

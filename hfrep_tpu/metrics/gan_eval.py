@@ -229,10 +229,37 @@ def _exact_ks2_pvalue(n: int, m: int, d: float) -> float:
     return float(np.clip(-math.expm1(log_inside - log_total), 0.0, 1.0))
 
 
-def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto") -> np.ndarray:
+def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto",
+                columns: tuple | None = None) -> np.ndarray:
     if method not in ("auto", "exact", "asymp"):
         raise ValueError(f"method must be auto|exact|asymp, got {method!r}")
     if method == "exact" or (method == "auto" and max(n, m) <= 10000):
+        # The in-repo DP is O(n·m) host Python per column; past ~1e6 cells
+        # scipy's C implementation of the same exact distribution is orders
+        # of magnitude faster, so delegate when the raw samples are at hand.
+        # scipy's exact path can overflow internally and *silently* switch
+        # to the asymptotic answer (the reason the DP exists — see
+        # :func:`_exact_ks2_pvalue`); it announces that with a warning, on
+        # which we rescue the column through the overflow-proof DP.  The
+        # DP also remains the no-scipy fallback and the oracle for tests.
+        if columns is not None and n * m > 1_000_000:
+            try:
+                from scipy.stats import ks_2samp
+            except ImportError:  # pragma: no cover - scipy present in image
+                pass
+            else:
+                import warnings
+                r, f = (np.asarray(c) for c in columns)   # host copy here only
+                out = []
+                for j in range(r.shape[1]):
+                    with warnings.catch_warnings(record=True) as caught:
+                        warnings.simplefilter("always")
+                        res = ks_2samp(r[:, j], f[:, j], method="exact")
+                    if any("exact" in str(c.message).lower() for c in caught):
+                        out.append(_exact_ks2_pvalue(n, m, float(res.statistic)))
+                    else:
+                        out.append(float(res.pvalue))
+                return np.array(out)
         return np.array([_exact_ks2_pvalue(n, m, float(d)) for d in stats])
     try:
         from scipy.stats import distributions as _dist
@@ -252,9 +279,11 @@ def ks_test(real: Array, fake: Array, group: bool = True, p_val_only: bool = Tru
     ``kstwo.sf(d, round(nm/(n+m)))`` otherwise; without scipy the
     Kolmogorov series is the fallback."""
     stats = np.asarray(_ks_statistics(real, fake))
-    n = _flatten_rows(real).shape[0]
-    m = _flatten_rows(fake).shape[0]
-    pvals = _ks_pvalues(stats, n, m, method)
+    # Device arrays pass through untouched; _ks_pvalues materializes them
+    # on the host only if the large-exact scipy delegation actually fires.
+    r_cols, f_cols = _flatten_rows(real), _flatten_rows(fake)
+    n, m = r_cols.shape[0], f_cols.shape[0]
+    pvals = _ks_pvalues(stats, n, m, method, columns=(r_cols, f_cols))
     if group:
         if p_val_only:
             return float(np.mean(pvals))

@@ -7,8 +7,11 @@ the XLA `lax.scan` path is bound by per-step loop latency, not FLOPs.
 These kernels run the whole recurrence as ONE ``pallas_call``: weights
 stay resident in VMEM, the per-step state (h, c) lives in VMEM scratch,
 and the grid walks the time axis with the time-sliced operands streamed
-per step — measured ~10× faster than the scan on a v5e chip, bit-exact
-vs the scan in forward.
+per step — measured +81% on the end-to-end flagship train epoch vs the
+scan path on a v5e chip (419 vs 232 steps/s, RESULTS.md "bf16: measured
+decision"; isolated-traversal micro-timings are closer to parity — the
+win lives in the whole-epoch fusion context), bit-exact vs the scan in
+forward.
 
 Layout: gates are padded per-block from H=100 to Hp=128 lanes (the MXU
 lane width), so every in-kernel slice is 128-aligned.  Zero-padded
@@ -110,15 +113,24 @@ def pad_keras_params(params: dict, h: int, hp: int) -> tuple:
 
 # --------------------------------------------------------------- forward
 
-def _fwd_kernel(act_name, with_cs, xz_ref, rec_ref, hs_ref, *rest):
-    cs_ref = rest[0] if with_cs else None
+def _fwd_kernel(act_name, with_cs, with_carry, xz_ref, rec_ref, *rest):
+    # operand tail: [h0, c0]? ; outputs: hs, [cs]?, [c_fin]? ; scratch last 2
+    k = 2 if with_carry else 0
+    h0_ref, c0_ref = (rest[0], rest[1]) if with_carry else (None, None)
+    hs_ref = rest[k]
+    cs_ref = rest[k + 1] if with_cs else None
+    cfin_ref = rest[k + 1] if (with_carry and not with_cs) else None
     h_scr, c_scr = rest[-2], rest[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _():
-        h_scr[:] = jnp.zeros_like(h_scr)
-        c_scr[:] = jnp.zeros_like(c_scr)
+        if with_carry:
+            h_scr[:] = h0_ref[:]
+            c_scr[:] = c0_ref[:]
+        else:
+            h_scr[:] = jnp.zeros_like(h_scr)
+            c_scr[:] = jnp.zeros_like(c_scr)
 
     act = _ACT[act_name]
     # Mixed precision: xz/rec may arrive bf16 (halved HBM stream for the
@@ -140,6 +152,10 @@ def _fwd_kernel(act_name, with_cs, xz_ref, rec_ref, hs_ref, *rest):
     hs_ref[0] = h
     if with_cs:
         cs_ref[0] = c
+    if cfin_ref is not None:
+        # Constant-index output block: overwritten every step, the final
+        # flush leaves c_{W-1} — the cell carry handed to the next chunk.
+        cfin_ref[:] = c
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -158,42 +174,65 @@ def lstm_seq(xz: jnp.ndarray, rec: jnp.ndarray, activation: str = "tanh"):
     return _lstm_seq_fwd_impl(xz, rec, activation, with_cs=False)
 
 
-def _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True):
+def _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True, carry=None):
     w, b, g = xz.shape
     hp = g // 4
     t_spec = pl.BlockSpec((1, b, hp), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
-    t_shape = shape_struct((w, b, hp), jnp.float32, (xz, rec))
+    st_spec = pl.BlockSpec((b, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    operands = [xz, rec] + (list(carry) if carry is not None else [])
+    t_shape = shape_struct((w, b, hp), jnp.float32, operands)
+    st_shape = shape_struct((b, hp), jnp.float32, operands)
+    out_specs, out_shape = [t_spec], [t_shape]
+    if with_cs:
+        out_specs, out_shape = out_specs + [t_spec], out_shape + [t_shape]
+    elif carry is not None:                      # emit the final cell carry
+        out_specs, out_shape = out_specs + [st_spec], out_shape + [st_shape]
     out = pl.pallas_call(
-        functools.partial(_fwd_kernel, activation, with_cs),
+        functools.partial(_fwd_kernel, activation, with_cs, carry is not None),
         grid=(w,),
         in_specs=[pl.BlockSpec((1, b, g), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
-                  pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)],
-        out_specs=[t_spec, t_spec] if with_cs else [t_spec],
-        out_shape=[t_shape, t_shape] if with_cs else [t_shape],
+                  pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)]
+                 + [st_spec] * (2 if carry is not None else 0),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
                         pltpu.VMEM((b, hp), jnp.float32)],
         interpret=_interpret(),
-    )(xz, rec)
-    return (out[0], out[1]) if with_cs else out[0]
+    )(*operands)
+    if with_cs:
+        return out[0], out[1]
+    if carry is not None:
+        return out[0], out[1]
+    return out[0]
 
 
 # -------------------------------------------------------------- backward
 
-def _bwd_kernel(act_name, with_dcs, with_carries, xz_ref, rec_ref, rec_t_ref,
-                h_prev_ref, c_prev_ref, cs_ref, dhs_ref, *rest):
-    # rest = [dcs?] + [dxz, drec] + [dhT, dcT]? + [dh_scr, dc_scr]
-    k = 1 if with_dcs else 0
+def _bwd_kernel(act_name, with_dcs, with_carries, with_carry0, xz_ref, rec_ref,
+                rec_t_ref, h_prev_ref, c_prev_ref, cs_ref, dhs_ref, *rest):
+    # rest = [dcs?] + [dcfin?] + [dxz, drec] + [dhT, dcT]? + [dh0, dc0]?
+    #        + [dh_scr, dc_scr]
+    k = int(with_dcs) + int(with_carry0)
     dcs_ref = rest[0] if with_dcs else None
+    dcfin_ref = rest[int(with_dcs)] if with_carry0 else None
     dxz_ref, drec_ref = rest[k], rest[k + 1]
     if with_carries:   # second-order residuals: per-step dhT/dcT
         dhT_ref, dcT_ref = rest[k + 2], rest[k + 3]
+    if with_carry0:    # cotangents of the injected initial (h0, c0)
+        dh0_ref, dc0_ref = rest[-4], rest[-3]
     dh_scr, dc_scr = rest[-2], rest[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _():
         dh_scr[:] = jnp.zeros_like(dh_scr)
-        dc_scr[:] = jnp.zeros_like(dc_scr)
+        if with_carry0:
+            # cotangent arriving on the emitted final cell carry seeds the
+            # reverse sweep (the final hidden carry is hs[-1], so its
+            # cotangent reaches us through dhs[-1] instead)
+            dc_scr[:] = dcfin_ref[:]
+        else:
+            dc_scr[:] = jnp.zeros_like(dc_scr)
         drec_ref[:] = jnp.zeros_like(drec_ref)
 
     act = _ACT[act_name]
@@ -229,32 +268,55 @@ def _bwd_kernel(act_name, with_dcs, with_carries, xz_ref, rec_ref, rec_t_ref,
         dcT_ref[0] = dc
     dh_scr[:] = jnp.dot(dz, rec_t_ref[:], preferred_element_type=jnp.float32)
     dc_scr[:] = dc * f
+    if with_carry0:
+        # Constant-index outputs: the reverse grid's LAST iteration is
+        # timestep 0, whose outgoing carries ARE (dh0, dc0); earlier
+        # writes are overwritten before the final flush.
+        dh0_ref[:] = dh_scr[:]
+        dc0_ref[:] = dc_scr[:]
     # (Hp, B) @ (B, 4Hp) accumulated across the reverse sweep.
     drec_ref[:] += lax.dot_general(h_prev, dz, (((0,), (0,)), ((), ())),
                                    preferred_element_type=jnp.float32)
 
 
-def _shifted(hs, cs):
-    zero = jnp.zeros_like(hs[:1])
-    return (jnp.concatenate([zero, hs[:-1]], axis=0),
-            jnp.concatenate([zero, cs[:-1]], axis=0))
+def _shifted(hs, cs, carry=None):
+    """Per-step previous-state sequences; step 0 sees the injected carry
+    (zeros in the carry-free recurrence)."""
+    if carry is None:
+        h_first = c_first = jnp.zeros_like(hs[:1])
+    else:
+        h_first, c_first = carry[0][None], carry[1][None]
+    return (jnp.concatenate([h_first, hs[:-1]], axis=0),
+            jnp.concatenate([c_first, cs[:-1]], axis=0))
 
 
-def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation, with_carries=False):
+def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation, with_carries=False,
+              carry=None, dc_fin=None):
     """Reverse-time pallas sweep: (dxz, drec) from output cotangents.
 
     ``dcs`` (optional) is a direct cotangent on the cell-state sequence —
     nonzero only when ``cs`` escapes as a residual (second-order paths).
     ``with_carries`` additionally returns the per-step (dhT, dcT) carries,
     the residuals the adjoint kernel (:func:`_adj_call`) needs.
+    ``carry`` = injected initial (h0, c0): timestep 0 recomputes its gates
+    from them, and two extra outputs (dh0, dc0) — their cotangents — are
+    appended.  ``dc_fin`` (carry mode only) is the cotangent on the
+    emitted final cell state, seeding the reverse sweep's dc carry.
     """
     w, b, g = xz.shape
     hp = g // 4
-    h_prev, c_prev = _shifted(hs, cs)
+    h_prev, c_prev = _shifted(hs, cs, carry)
     rev = lambda t: (w - 1 - t, 0, 0)
     t_in = pl.BlockSpec((1, b, hp), rev, memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((b, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)
     with_dcs = dcs is not None
-    operands = [xz, rec, rec.T, h_prev, c_prev, cs, dhs] + ([dcs] if with_dcs else [])
+    with_carry0 = carry is not None
+    if with_carry0 and dc_fin is None:
+        dc_fin = jnp.zeros((b, hp), jnp.float32)
+    operands = ([xz, rec, rec.T, h_prev, c_prev, cs, dhs]
+                + ([dcs] if with_dcs else [])
+                + ([dc_fin] if with_carry0 else []))
+    st_shape = shape_struct((b, hp), jnp.float32, operands)
     out_specs = [pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                  pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM)]
     out_shape = [shape_struct((w, b, g), jnp.float32, operands),
@@ -262,13 +324,18 @@ def _bwd_call(xz, rec, hs, cs, dhs, dcs, activation, with_carries=False):
     if with_carries:
         out_specs += [t_in, t_in]
         out_shape += [shape_struct((w, b, hp), jnp.float32, operands)] * 2
+    if with_carry0:
+        out_specs += [st_spec, st_spec]
+        out_shape += [st_shape, st_shape]
     out = pl.pallas_call(
-        functools.partial(_bwd_kernel, activation, with_dcs, with_carries),
+        functools.partial(_bwd_kernel, activation, with_dcs, with_carries,
+                          with_carry0),
         grid=(w,),
         in_specs=[pl.BlockSpec((1, b, g), rev, memory_space=pltpu.VMEM),
                   pl.BlockSpec((hp, g), lambda t: (0, 0), memory_space=pltpu.VMEM),
                   pl.BlockSpec((g, hp), lambda t: (0, 0), memory_space=pltpu.VMEM)]
-                 + [t_in] * (4 + int(with_dcs)),
+                 + [t_in] * (4 + int(with_dcs))
+                 + ([st_spec] if with_carry0 else []),
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
@@ -287,22 +354,35 @@ def _act_prime_prime_from_value(name, v):
     return jnp.zeros_like(v)
 
 
-def _adj_kernel(act_name, xz_ref, rec_ref, rec_t_ref, v_ref, v_t_ref,
-                h_prev_ref, c_prev_ref, cs_ref, u_ref,
-                dhT_ref, dcT_ref,
-                uxz_ref, uhp_ref, ucp_ref, uc_ref, udhs_ref, urec_ref,
-                muh_scr, muc_scr):
+def _adj_kernel(act_name, with_carry0, xz_ref, rec_ref, rec_t_ref, v_ref,
+                v_t_ref, h_prev_ref, c_prev_ref, cs_ref, u_ref,
+                dhT_ref, dcT_ref, *rest):
     """Adjoint of one backward step (hand-derived, oracle-validated
     against ``jax.vjp`` over :func:`_lstm_bwd_scan`).  Runs forward-time
     t = 0..W-1 — the reverse of the primal backward's execution order —
     with the adjoint carries (μh, μc) = cotangents of the primal step's
-    (dh′, dc′) carry outputs in VMEM scratch."""
+    (dh′, dc′) carry outputs in VMEM scratch.
+
+    Carry mode (``with_carry0``): the primal backward's final carry
+    outputs ARE (dh0, dc0), so their cotangents (μh0, μc0) seed the
+    adjoint carries at t=0; symmetrically the backward's *initial* dc
+    carry was seeded with dc_fin, so its cotangent — the final μc — is
+    emitted as one extra constant-index output."""
+    k = 2 if with_carry0 else 0
+    muh0_ref, muc0_ref = (rest[0], rest[1]) if with_carry0 else (None, None)
+    uxz_ref, uhp_ref, ucp_ref, uc_ref, udhs_ref, urec_ref = rest[k:k + 6]
+    udcfin_ref = rest[k + 6] if with_carry0 else None
+    muh_scr, muc_scr = rest[-2], rest[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _():
-        muh_scr[:] = jnp.zeros_like(muh_scr)
-        muc_scr[:] = jnp.zeros_like(muc_scr)
+        if with_carry0:
+            muh_scr[:] = muh0_ref[:]
+            muc_scr[:] = muc0_ref[:]
+        else:
+            muh_scr[:] = jnp.zeros_like(muh_scr)
+            muc_scr[:] = jnp.zeros_like(muc_scr)
         urec_ref[:] = jnp.zeros_like(urec_ref)
 
     act = _ACT[act_name]
@@ -376,54 +456,76 @@ def _adj_kernel(act_name, xz_ref, rec_ref, rec_t_ref, v_ref, v_t_ref,
                                           preferred_element_type=jnp.float32)
     muh_scr[:] = dhTbar                  # cot of carry-in dh → next step
     muc_scr[:] = dcTbar                  # cot of carry-in dc → next step
+    if with_carry0:
+        # After the last step this is cot of the backward's initial dc
+        # carry — i.e. cot(dc_fin); earlier writes are overwritten.
+        udcfin_ref[:] = dcTbar
 
 
-def _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation):
+def _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation,
+              carry=None, mu0=None):
     """Cotangents of (xz, rec, hs, cs, dhs) for the backward sweep, given
     ``u`` = cot(dxz) and ``v_mat`` = cot(drec).  ``dhs`` itself is not an
     operand: the kernel recovers each step's dh total from the saved
-    ``dhT_seq`` carries (and ``cot(dhs) = cot(dh)`` falls out directly)."""
+    ``dhT_seq`` carries (and ``cot(dhs) = cot(dh)`` falls out directly).
+
+    Carry mode: ``carry`` = the injected (h0, c0) and ``mu0`` = the
+    cotangents of the backward's (dh0, dc0) outputs; three extra
+    cotangents are appended — cot(dc_fin), cot(h0), cot(c0)."""
     w, b, g = xz.shape
     hp = g // 4
-    h_prev, c_prev = _shifted(hs, cs)
+    with_carry0 = carry is not None
+    h_prev, c_prev = _shifted(hs, cs, carry)
     nat = lambda t: (t, 0, 0)
     const = lambda t: (0, 0)
     t_h = pl.BlockSpec((1, b, hp), nat, memory_space=pltpu.VMEM)
     t_g = pl.BlockSpec((1, b, g), nat, memory_space=pltpu.VMEM)
     mat_hg = pl.BlockSpec((hp, g), const, memory_space=pltpu.VMEM)
     mat_gh = pl.BlockSpec((g, hp), const, memory_space=pltpu.VMEM)
-    _ops = (xz, rec, v_mat, h_prev, c_prev, cs, u, dhT_seq, dcT_seq)
+    st = pl.BlockSpec((b, hp), const, memory_space=pltpu.VMEM)
+    _ops = ((xz, rec, v_mat, h_prev, c_prev, cs, u, dhT_seq, dcT_seq)
+            + (tuple(mu0) if with_carry0 else ()))
     sh_h = shape_struct((w, b, hp), jnp.float32, _ops)
     sh_g = shape_struct((w, b, g), jnp.float32, _ops)
-    uxz, uhp, ucp, uc, udhs, urec = pl.pallas_call(
-        functools.partial(_adj_kernel, activation),
+    sh_st = shape_struct((b, hp), jnp.float32, _ops)
+    out = pl.pallas_call(
+        functools.partial(_adj_kernel, activation, with_carry0),
         grid=(w,),
         in_specs=[t_g, mat_hg, mat_gh, mat_hg, mat_gh,
-                  t_h, t_h, t_h, t_g, t_h, t_h],
-        out_specs=[t_g, t_h, t_h, t_h, t_h, mat_hg],
+                  t_h, t_h, t_h, t_g, t_h, t_h]
+                 + [st, st] * int(with_carry0),
+        out_specs=[t_g, t_h, t_h, t_h, t_h, mat_hg]
+                  + [st] * int(with_carry0),
         out_shape=[sh_g, sh_h, sh_h, sh_h, sh_h,
-                   shape_struct((hp, g), jnp.float32, _ops)],
+                   shape_struct((hp, g), jnp.float32, _ops)]
+                  + [sh_st] * int(with_carry0),
         scratch_shapes=[pltpu.VMEM((b, hp), jnp.float32),
                         pltpu.VMEM((b, hp), jnp.float32)],
         interpret=_interpret(),
     )(xz, rec, rec.T, v_mat, v_mat.T, h_prev, c_prev, cs, u,
-      dhT_seq, dcT_seq)
+      dhT_seq, dcT_seq, *(tuple(mu0) if with_carry0 else ()))
+    uxz, uhp, ucp, uc, udhs, urec = out[:6]
     # uhp_s is the cotangent of hs_{s-1}; ucp_s of cs_{s-1}; uc_s of cs_s.
     zero = jnp.zeros_like(uhp[:1])
     uhs = jnp.concatenate([uhp[1:], zero], axis=0)
     ucs = uc + jnp.concatenate([ucp[1:], zero], axis=0)
-    return uxz, urec, uhs, ucs, udhs
+    if not with_carry0:
+        return uxz, urec, uhs, ucs, udhs
+    # step 0's "previous state" is the injected carry itself
+    return uxz, urec, uhs, ucs, udhs, out[6], uhp[0], ucp[0]
 
 
-def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation):
+def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation, carry=None,
+                   dc_fin=None):
     """Pure-JAX twin of :func:`_bwd_call` (same arithmetic, `lax.scan`).
 
     This is the second-order fallback: :func:`lstm_bwd_seq`'s own VJP is
     derived by JAX AD over this implementation, so hand-written kernels
-    never need their derivatives hand-derived.
+    never need their derivatives hand-derived.  ``carry``/``dc_fin``
+    mirror the carry-injection kernel mode (two extra outputs: dh0, dc0).
     """
     act = _ACT[activation]
-    h_prev, c_prev = _shifted(hs, cs)
+    h_prev, c_prev = _shifted(hs, cs, carry)
     b, hp = hs.shape[1], hs.shape[2]
     g = xz.shape[2]
     if dcs is None:
@@ -450,12 +552,15 @@ def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation):
         drec = drec + lax.dot_general(hp_s, dz, (((0,), (0,)), ((), ())))
         return (dz @ rec.T, dc * f, drec), dz
 
-    init = (jnp.zeros((b, hp), xz.dtype), jnp.zeros((b, hp), xz.dtype),
+    init = (jnp.zeros((b, hp), xz.dtype),
+            jnp.zeros((b, hp), xz.dtype) if dc_fin is None else dc_fin,
             jnp.zeros((hp, g), xz.dtype))
-    (_, _, drec), dz_rev = lax.scan(
+    (dh0, dc0, drec), dz_rev = lax.scan(
         step, init,
         (xz[::-1], h_prev[::-1], c_prev[::-1], cs[::-1], dhs[::-1], dcs[::-1]))
-    return dz_rev[::-1], drec
+    if carry is None:
+        return dz_rev[::-1], drec
+    return dz_rev[::-1], drec, dh0, dc0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -519,6 +624,94 @@ def _lstm_seq_bwd(activation, residuals, dhs):
 
 
 lstm_seq.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
+
+
+# ------------------------------------------- carry-injection entry points
+#
+# The sequence-parallel pipeline (hfrep_tpu.parallel.sequence) shards the
+# window axis: device k receives the (h, c) carry computed by device k-1
+# and must run its local chunk from that state, then hand its own final
+# carry onward.  These variants extend the kernels above with nonzero
+# initial state in and final state out, with the same nested-custom_vjp
+# structure so the WGAN-GP second-order path stays kernel-resident.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_seq_carry(xz: jnp.ndarray, rec: jnp.ndarray, h0: jnp.ndarray,
+                   c0: jnp.ndarray, activation: str = "tanh"):
+    """Carry-injected LSTM recurrence: (W, B, 4Hp) chunk from initial
+    state (h0, c0) — returns ``(hs, c_fin)``; the final hidden carry is
+    ``hs[-1]``.  Twice-differentiable like :func:`lstm_seq` (nested
+    custom_vjps; the second-order residue runs the carry adjoint
+    kernel)."""
+    return _lstm_seq_fwd_impl(xz, rec, activation, with_cs=False,
+                              carry=(h0, c0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_fwd_res_carry(xz, rec, h0, c0, activation):
+    """Residual-producing forward for the carry recurrence: (hs, cs) with
+    a pallas VJP (dcs-extended carry backward)."""
+    return _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True,
+                              carry=(h0, c0))
+
+
+def _lstm_fwd_res_carry_fwd(xz, rec, h0, c0, activation):
+    hs, cs = _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True,
+                                carry=(h0, c0))
+    return (hs, cs), (xz, rec, h0, c0, hs, cs)
+
+
+def _lstm_fwd_res_carry_bwd(activation, residuals, cotangents):
+    xz, rec, h0, c0, hs, cs = residuals
+    dhs, dcs = cotangents
+    dxz, drec, dh0, dc0 = _bwd_call(xz, rec, hs, cs, dhs, dcs, activation,
+                                    carry=(h0, c0))
+    return dxz, drec, dh0, dc0
+
+
+lstm_fwd_res_carry.defvjp(_lstm_fwd_res_carry_fwd, _lstm_fwd_res_carry_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8,))
+def lstm_bwd_seq_carry(xz, rec, hs, cs, dhs, dc_fin, h0, c0, activation):
+    """First-order carry backward as a differentiable-once primitive:
+    returns (dxz, drec, dh0, dc0).  Its own VJP is the carry-mode adjoint
+    kernel — the second-order path of sequence-parallel WGAN-GP."""
+    return _bwd_call(xz, rec, hs, cs, dhs, None, activation,
+                     carry=(h0, c0), dc_fin=dc_fin)
+
+
+def _lstm_bwd_seq_carry_fwd(xz, rec, hs, cs, dhs, dc_fin, h0, c0, activation):
+    dxz, drec, dhT_seq, dcT_seq, dh0, dc0 = _bwd_call(
+        xz, rec, hs, cs, dhs, None, activation, with_carries=True,
+        carry=(h0, c0), dc_fin=dc_fin)
+    return ((dxz, drec, dh0, dc0),
+            (xz, rec, hs, cs, h0, c0, dhT_seq, dcT_seq))
+
+
+def _lstm_bwd_seq_carry_bwd(activation, residuals, cotangents):
+    xz, rec, hs, cs, h0, c0, dhT_seq, dcT_seq = residuals
+    u, v_mat, muh0, muc0 = cotangents
+    return _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation,
+                     carry=(h0, c0), mu0=(muh0, muc0))
+
+
+lstm_bwd_seq_carry.defvjp(_lstm_bwd_seq_carry_fwd, _lstm_bwd_seq_carry_bwd)
+
+
+def _lstm_seq_carry_fwd(xz, rec, h0, c0, activation):
+    hs, cs = lstm_fwd_res_carry(xz, rec, h0, c0, activation)
+    return (hs, cs[-1]), (xz, rec, h0, c0, hs, cs)
+
+
+def _lstm_seq_carry_bwd(activation, residuals, cotangents):
+    xz, rec, h0, c0, hs, cs = residuals
+    dhs, dc_fin = cotangents
+    return lstm_bwd_seq_carry(xz, rec, hs, cs, dhs, dc_fin, h0, c0,
+                              activation)
+
+
+lstm_seq_carry.defvjp(_lstm_seq_carry_fwd, _lstm_seq_carry_bwd)
 
 
 # ----------------------------------------------------- Keras-layout entry

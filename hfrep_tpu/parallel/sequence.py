@@ -24,12 +24,15 @@ Exactness: the pipeline computes the identical recurrence (same order,
 same arithmetic) as the single-device scan — verified to float32
 round-off in tests/test_sequence.py on an 8-device CPU mesh.
 
-Future work: the per-chunk recurrence currently runs the XLA scan cell;
-swapping in the pallas kernel needs carry-injection variants of the
-fwd/bwd/adjoint kernels (today they hard-init h0=c0=0) and is only
-testable on real multi-chip hardware (interpret-mode pallas cannot
-propagate vma under shard_map) — deferred until a pod is available to
-measure it on.
+Backends: ``backend="xla"`` scans the fused cell; ``backend="pallas"``
+dispatches each device's chunk to the carry-injection pallas kernels
+(:func:`hfrep_tpu.ops.pallas_lstm.lstm_seq_carry` — nonzero (h0, c0) in,
+final carry out, twice-differentiable), keeping the ~10× single-device
+kernel speed in the sharded composition.  The pallas path compiles only
+on real TPU (interpret-mode pallas cannot propagate vma under
+``shard_map(check_vma=True)``), so it is opt-in and TPU-gated; the
+kernels themselves are oracle-tested against the scan twin on a single
+chip (tests/test_pallas_lstm.py carry tests).
 """
 
 from __future__ import annotations
@@ -77,7 +80,8 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
             x: jnp.ndarray, mesh: Mesh, *, axis_name: Optional[str] = None,
             microbatches: Optional[int] = None,
             activation: str = "tanh",
-            recurrent_activation: str = "sigmoid") -> jnp.ndarray:
+            recurrent_activation: str = "sigmoid",
+            backend: str = "xla") -> jnp.ndarray:
     """LSTM over (B, W, F) with W sharded across ``axis_name`` (defaults
     to the mesh's only axis).
 
@@ -87,6 +91,9 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
     defaults mirror :class:`hfrep_tpu.ops.lstm.KerasLSTM` (tanh candidate
     transform, sigmoid gates); the reference's generators override the
     candidate transform with sigmoid (``GAN/MTSS_WGAN_GP.py:224-226``).
+
+    ``backend="pallas"`` runs each chunk through the carry-injection
+    pallas kernels (TPU-only; see module docstring).
     """
     axis_name = _resolve_axis(mesh, axis_name)
     n_dev = mesh.shape[axis_name]
@@ -100,6 +107,26 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
     bm = b // m
     act, rec_act = ACTIVATIONS[activation], ACTIVATIONS[recurrent_activation]
 
+    use_kernel = backend == "pallas"
+    if use_kernel:
+        from hfrep_tpu.ops.pallas_lstm import (LANE, _supported,
+                                               lstm_seq_carry,
+                                               pad_keras_params)
+        _supported(activation, recurrent_activation)
+        if jax.default_backend() != "tpu":
+            raise NotImplementedError(
+                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
+                "pallas cannot propagate vma under shard_map(check_vma)")
+        if x.dtype != jnp.float32:
+            raise NotImplementedError("sp_lstm pallas backend runs f32")
+        hp = ((h + LANE - 1) // LANE) * LANE
+        kernel, recurrent, bias = pad_keras_params(
+            {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias},
+            h, hp)
+        act_name = activation if activation else "linear"
+    else:
+        hp = h
+
     fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
 
     def per_device(kern, rec, bia, x_local):
@@ -107,18 +134,35 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
         wl = x_local.shape[1]
         k_idx = lax.axis_index(axis_name)
         # Hoisted input projection: one MXU matmul for the whole chunk.
-        xz = (x_local.reshape(b * wl, f) @ kern + bia).reshape(b, wl, 4 * h)
-        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4H)
-        xz_mb = xz.reshape(wl, m, bm, 4 * h)            # microbatch split
+        # (Padded-gate layout when the pallas kernels run the chunks.)
+        xz = (x_local.reshape(b * wl, f) @ kern + bia).reshape(b, wl, 4 * hp)
+        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp)
+        xz_mb = xz.reshape(wl, m, bm, 4 * hp)           # microbatch split
 
         # pcast to varying: mark the device-varying loop state as such for
         # the shard_map VMA type system (loop outputs vary over 'sp').
         def _varying(a):
             return lax.pcast(a, axis_name, to="varying")
 
-        out = _varying(jnp.zeros((wl, m, bm, h), xz.dtype))
-        carry_reg = (_varying(jnp.zeros((bm, h), xz.dtype)),
-                     _varying(jnp.zeros((bm, h), xz.dtype)))
+        out = _varying(jnp.zeros((wl, m, bm, hp), xz.dtype))
+        carry_reg = (_varying(jnp.zeros((bm, hp), xz.dtype)),
+                     _varying(jnp.zeros((bm, hp), xz.dtype)))
+
+        # Kernel mode: the pallas custom_vjp emits *varying* cotangents
+        # (hand-computed per-device, never auto-psum'd), so a replicated
+        # rec would give the AD-generated reverse scan a drec accumulator
+        # whose carry-in (invariant zeros) mismatches its carry-out under
+        # check_vma.  Casting rec to varying keeps the whole cotangent
+        # chain varying; the pcast's own transpose then psums it back to
+        # the replicated param exactly once at the boundary.
+        rec_v = _varying(rec) if use_kernel else rec
+
+        def run_chunk(xz_s, h0, c0):
+            """((h_fin, c_fin), h_seq) for one (Wl, Bm, 4Hp) chunk."""
+            if use_kernel:
+                h_seq, c_f = lstm_seq_carry(xz_s, rec_v, h0, c0, act_name)
+                return (h_seq[-1], c_f), h_seq
+            return _local_chunk_scan(xz_s, (h0, c0), rec, act, rec_act)
 
         def superstep(s, state):
             out_buf, (h_in, c_in) = state
@@ -129,22 +173,24 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
             # Device 0 always starts microbatches from the zero carry.
             h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
             c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
-            (h_f, c_f), h_seq = _local_chunk_scan(xz_s, (h0, c0), rec, act, rec_act)
+            (h_f, c_f), h_seq = run_chunk(xz_s, h0, c0)
             out_buf = jnp.where(
                 active,
                 lax.dynamic_update_index_in_dim(out_buf, h_seq, mb_c, axis=1),
                 out_buf)
             h_f = jnp.where(active, h_f, 0.0)
             c_f = jnp.where(active, c_f, 0.0)
-            # Hand the finished carry to the next pipeline stage.
+            # Hand the finished carry to the next pipeline stage (padding
+            # lanes ride along in kernel mode; their outgoing recurrent
+            # weights are zero, so they never touch real lanes).
             h_nxt = lax.ppermute(h_f, axis_name, perm=fwd)
             c_nxt = lax.ppermute(c_f, axis_name, perm=fwd)
             return out_buf, (h_nxt, c_nxt)
 
         out, _ = lax.fori_loop(0, m + n_dev - 1, superstep, (out, carry_reg))
-        # (Wl, M, Bm, H) → (B, Wl, H)
-        out = out.reshape(wl, b, h)
-        return jnp.swapaxes(out, 0, 1)
+        # (Wl, M, Bm, Hp) → (B, Wl, H)
+        out = out.reshape(wl, b, hp)
+        return jnp.swapaxes(out, 0, 1)[..., :h]
 
     mapped = shard_map(
         per_device, mesh=mesh,
@@ -182,9 +228,15 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
             "sequence-parallel step runs f32; configure dtype=float32")
     slope = pair.generator.slope
 
+    # Same resolution/validation as the plain step: 'auto' → pallas on a
+    # real TPU, xla elsewhere; anything else raises.
+    from hfrep_tpu.train.steps import resolve_lstm_backend
+    backend = resolve_lstm_backend(tcfg.lstm_backend)
     g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=axis_name,
-                                       activation="sigmoid", slope=slope)
-    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name)
+                                       activation="sigmoid", slope=slope,
+                                       backend=backend)
+    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name,
+                                     backend=backend)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
     return jax.jit(step, donate_argnums=(0,)) if jit else step
 
@@ -227,7 +279,8 @@ def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.nd
 
 
 def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
-              axis_name: Optional[str] = None) -> jnp.ndarray:
+              axis_name: Optional[str] = None,
+              backend: str = "xla") -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
@@ -245,11 +298,11 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     h1 = sp_lstm(d_params["KerasLSTM_0"]["kernel"],
                  d_params["KerasLSTM_0"]["recurrent_kernel"],
                  d_params["KerasLSTM_0"]["bias"], x, mesh,
-                 axis_name=axis_name)
+                 axis_name=axis_name, backend=backend)
     h2 = sp_lstm(d_params["KerasLSTM_1"]["kernel"],
                  d_params["KerasLSTM_1"]["recurrent_kernel"],
                  d_params["KerasLSTM_1"]["bias"], h1, mesh,
-                 axis_name=axis_name)
+                 axis_name=axis_name, backend=backend)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     b, w, h = h2.shape
@@ -272,7 +325,8 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
 def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 axis_name: Optional[str] = None, slope: float = 0.2,
                 activation: str = "sigmoid",
-                ln_eps: float = 1e-3) -> jnp.ndarray:
+                ln_eps: float = 1e-3,
+                backend: str = "xla") -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
     Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
     window axis sharded over ``axis_name`` — long-window synthesis
@@ -290,7 +344,7 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     sharding = NamedSharding(mesh, P(None, axis_name, None))
     z = jax.device_put(z, sharding)
 
-    kw = dict(axis_name=axis_name, activation=activation)
+    kw = dict(axis_name=axis_name, activation=activation, backend=backend)
     x = sp_lstm(g_params["KerasLSTM_0"]["kernel"],
                 g_params["KerasLSTM_0"]["recurrent_kernel"],
                 g_params["KerasLSTM_0"]["bias"], z, mesh, **kw)

@@ -113,6 +113,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
+@pytest.mark.slow
 def test_two_process_dp_matches_single_device(tmp_path):
     script = tmp_path / "child.py"
     script.write_text(CHILD)
@@ -185,6 +186,7 @@ def test_two_process_dp_matches_single_device(tmp_path):
 @pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
 @pytest.mark.skipif(not os.path.isdir("/root/reference/cleaned_data"),
                     reason="reference data not mounted")
+@pytest.mark.slow
 def test_cli_multihost_drill():
     """The user-facing multi-host entry: two CLI processes joined with
     --coordinator/--process-id train the same schedule on one pod-wide

@@ -80,6 +80,7 @@ def tiny_problem():
 
 
 class TestSweep:
+    @pytest.mark.slow
     def test_run_sweep_shapes_and_summary(self, tiny_problem, tmp_path):
         x_train, y_train, x_test, y_test, rf_test, factor_full = tiny_problem
         cfg = AEConfig(epochs=30, ols_window=12)
@@ -103,6 +104,7 @@ class TestSweep:
         summary = json.loads((tmp_path / "summary.json").read_text())
         assert "best_oos_r2" in summary
 
+    @pytest.mark.slow
     def test_augmented_sweep_runs(self, tiny_problem):
         x_train, y_train, x_test, y_test, rf_test, factor_full = tiny_problem
         cube = jnp.concatenate([
@@ -140,6 +142,7 @@ class TestCli:
         assert rc == 0
         assert (tmp_path / "cleaned" / "hfd.csv").exists()
 
+    @pytest.mark.slow
     def test_train_gan_cli_tiny(self, tmp_path):
         """cmd_train_gan end to end: short training, checkpoint, samples,
         resume completing the schedule, and h5 export when TF is present."""

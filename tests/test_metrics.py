@@ -124,6 +124,25 @@ class TestMetricOracles:
         else:
             assert got < 1e-11
 
+    def test_ks_large_exact_delegates_to_scipy(self):
+        """Above ~1e6 DP cells the exact path hands the raw columns to
+        scipy's C implementation (same exact distribution, orders of
+        magnitude faster than the host-Python DP); the p-values must agree
+        with the DP oracle on the same statistic."""
+        from scipy.stats import ks_2samp
+
+        n = m = 1200  # n·m = 1.44e6 > delegation threshold, max <= 10000
+        g = np.random.default_rng(7)
+        r = g.normal(size=(n, 2))
+        f = g.normal(0.05, 1.0, size=(m, 2))
+        stats = np.array([ks_2samp(r[:, j], f[:, j]).statistic for j in range(2)])
+        got = ge._ks_pvalues(stats, n, m, "exact", columns=(r, f))
+        for j in range(2):
+            ref = ks_2samp(r[:, j], f[:, j], method="exact")
+            np.testing.assert_allclose(got[j], ref.pvalue, atol=1e-12)
+            oracle = ge._exact_ks2_pvalue(n, m, float(ref.statistic))
+            np.testing.assert_allclose(got[j], oracle, atol=1e-9)
+
     def test_wasserstein_matches_scipy(self, cubes):
         from scipy.stats import wasserstein_distance
 

@@ -6,6 +6,8 @@ same kernels compile natively on TPU.  The XLA `lax.scan` path of
 values and first-order gradients.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -116,6 +118,7 @@ def test_gradients_match_scan(activation, h):
                                    atol=1e-5, rtol=1e-4, err_msg=name)
 
 
+@pytest.mark.slow
 def test_wgan_gp_epoch_matches_xla_backend():
     """One full MTSS-WGAN-GP epoch with the pallas backend lands on the
     same numbers as the xla backend — including the gradient penalty's
@@ -149,7 +152,155 @@ def test_wgan_gp_epoch_matches_xla_backend():
                                atol=1e-5, rtol=1e-4)
 
 
+def _fwd_scan_carry(xz, rec, h0, c0, activation):
+    """Pure-JAX twin of the carry-injection forward kernel: the same
+    recurrence arithmetic from an injected (h0, c0)."""
+    from hfrep_tpu.ops.pallas_lstm import _ACT
+
+    act = _ACT[activation]
+
+    def step(carry, xz_t):
+        h, c = carry
+        z = xz_t + h @ rec
+        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+        c2 = jax.nn.sigmoid(zf) * c + jax.nn.sigmoid(zi) * act(zc)
+        h2 = jax.nn.sigmoid(zo) * act(c2)
+        return (h2, c2), h2
+
+    (h_f, c_f), hs = jax.lax.scan(step, (h0, c0), xz)
+    return hs, c_f
+
+
+def _mk_carry(activation, key, w=5, b=4, hp=128):
+    ks = jax.random.split(key, 4)
+    xz = 0.3 * jax.random.normal(ks[0], (w, b, 4 * hp))
+    rec = 0.3 * jax.random.normal(ks[1], (hp, 4 * hp))
+    h0 = 0.5 * jax.random.normal(ks[2], (b, hp))
+    c0 = 0.5 * jax.random.normal(ks[3], (b, hp))
+    return xz, rec, h0, c0
+
+
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", "linear"])
+def test_carry_forward_matches_scan_twin(activation):
+    """Carry-injection forward kernel: nonzero (h0, c0) in, final cell
+    carry out — vs the scan twin (VERDICT r2 item 1's oracle method)."""
+    from hfrep_tpu.ops.pallas_lstm import lstm_seq, lstm_seq_carry
+
+    xz, rec, h0, c0 = _mk_carry(activation, jax.random.PRNGKey(11))
+    hs, c_fin = lstm_seq_carry(xz, rec, h0, c0, activation)
+    ref_hs, ref_cf = _fwd_scan_carry(xz, rec, h0, c0, activation)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(ref_hs), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_fin), np.asarray(ref_cf), atol=1e-5)
+    # zero carry degenerates to the carry-free kernel
+    z = jnp.zeros_like(h0)
+    hs0, _ = lstm_seq_carry(xz, rec, z, z, activation)
+    np.testing.assert_allclose(np.asarray(hs0),
+                               np.asarray(lstm_seq(xz, rec, activation)),
+                               atol=1e-6)
+
+
 @pytest.mark.parametrize("activation", ["sigmoid", "tanh"])
+def test_carry_gradients_match_scan_twin(activation):
+    """First-order grads w.r.t. all four differentiable operands,
+    including cotangents arriving on BOTH outputs (hs and c_fin)."""
+    from hfrep_tpu.ops.pallas_lstm import lstm_seq_carry
+
+    xz, rec, h0, c0 = _mk_carry(activation, jax.random.PRNGKey(12))
+    wts = jax.random.normal(jax.random.PRNGKey(13), xz.shape[:2] + (xz.shape[2] // 4,))
+    u = jax.random.normal(jax.random.PRNGKey(14), h0.shape)
+
+    def loss(fn):
+        def f(xz, rec, h0, c0):
+            hs, c_fin = fn(xz, rec, h0, c0, activation)
+            return jnp.sum(hs * wts) + jnp.sum(c_fin * u)
+        return f
+
+    ref = jax.grad(loss(_fwd_scan_carry), argnums=(0, 1, 2, 3))(xz, rec, h0, c0)
+    got = jax.grad(loss(lstm_seq_carry), argnums=(0, 1, 2, 3))(xz, rec, h0, c0)
+    for name, a, r in zip(("dxz", "drec", "dh0", "dc0"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=1e-5, rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh"])
+def test_carry_second_order_matches_scan_twin(activation):
+    """Grad-of-grad (the GP pattern ∂/∂θ ∇_x c) through the carry
+    kernels: routes through the carry-mode adjoint kernel, and must agree
+    with double AD over the scan twin — this is what sequence-parallel
+    WGAN-GP training runs per chunk."""
+    from hfrep_tpu.ops.pallas_lstm import lstm_seq_carry
+
+    xz, rec, h0, c0 = _mk_carry(activation, jax.random.PRNGKey(15), w=4, b=2)
+
+    def gp_like(fn, xz, rec, h0, c0):
+        def scalar(xzi, h0i, c0i):
+            hs, c_fin = fn(xzi, rec, h0i, c0i, activation)
+            return jnp.sum(hs) + jnp.sum(c_fin)
+        g = jax.grad(scalar, argnums=(0, 1, 2))(xz, h0, c0)
+        norms = jnp.sqrt(sum(jnp.sum(t ** 2) for t in g) + 1e-12)
+        return (1.0 - norms) ** 2
+
+    for wrt in (0, 1, 2, 3):
+        ref = jax.grad(functools.partial(gp_like, _fwd_scan_carry),
+                       argnums=wrt)(xz, rec, h0, c0)
+        got = jax.grad(functools.partial(gp_like, lstm_seq_carry),
+                       argnums=wrt)(xz, rec, h0, c0)
+        # Composite double-AD noise: kernel and twin accumulate the
+        # W-step sums in different orders and the GP norm amplifies it
+        # (observed ≤1e-4 on <0.05% of elements; the underlying backward
+        # paths match the twin at ~1e-6 — see the adjoint/carry-gradient
+        # oracle tests above, which keep their tight tolerances).
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=1e-4, err_msg=f"wrt={wrt}")
+
+
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", "linear"])
+def test_carry_adjoint_matches_scan_twin_vjp(activation):
+    """The carry-mode adjoint kernel (`_adj_call(carry=, mu0=)`) vs JAX
+    AD over the carry-extended scan twin of the backward — cotangents for
+    all eight backward inputs, including dc_fin/h0/c0."""
+    from hfrep_tpu.ops.pallas_lstm import (_adj_call, _bwd_call,
+                                           _lstm_bwd_scan,
+                                           _lstm_seq_fwd_impl)
+
+    key = jax.random.PRNGKey(16)
+    w, b, hp = 5, 4, 128
+    g = 4 * hp
+    xz, rec, h0, c0 = _mk_carry(activation, key, w=w, b=b, hp=hp)
+    ks = jax.random.split(jax.random.fold_in(key, 1), 5)
+    dhs = 0.3 * jax.random.normal(ks[0], (w, b, hp))
+    dc_fin = 0.3 * jax.random.normal(ks[1], (b, hp))
+    hs, cs = _lstm_seq_fwd_impl(xz, rec, activation, with_cs=True,
+                                carry=(h0, c0))
+    u = 0.3 * jax.random.normal(ks[2], (w, b, g))
+    v = 0.3 * jax.random.normal(ks[3], (hp, g))
+    muh0 = 0.3 * jax.random.normal(ks[4], (b, hp))
+    muc0 = 0.3 * jax.random.normal(jax.random.fold_in(ks[4], 1), (b, hp))
+
+    _, vjp = jax.vjp(
+        lambda xz, rec, hs, cs, dhs, dcf, h0, c0: _lstm_bwd_scan(
+            xz, rec, hs, cs, dhs, None, activation, carry=(h0, c0),
+            dc_fin=dcf),
+        xz, rec, hs, cs, dhs, dc_fin, h0, c0)
+    ref = vjp((u, v, muh0, muc0))
+
+    _, _, dhT_seq, dcT_seq, _, _ = _bwd_call(
+        xz, rec, hs, cs, dhs, None, activation, with_carries=True,
+        carry=(h0, c0), dc_fin=dc_fin)
+    got = _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v, activation,
+                    carry=(h0, c0), mu0=(muh0, muc0))
+    names = ("uxz", "urec", "uhs", "ucs", "udhs", "u_dcfin", "uh0", "uc0")
+    for name, a, r in zip(names, got, ref):
+        # urec is a W-step sum whose addends are ~2× larger than in the
+        # zero-carry test (injected |h0| ~ 0.5); allow the extra
+        # accumulation-order noise (observed ≤5e-5 on 3/65536 elements).
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=1e-4 if name == "urec" else 1e-5,
+                                   rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("activation", [
+    pytest.param("sigmoid", marks=pytest.mark.slow), "tanh"])
 def test_second_order_matches_xla(activation):
     """Grad-of-grad (the WGAN-GP gradient-penalty pattern, ∂/∂θ ∇_x c)
     through the pallas backend: the nested custom_vjp structure routes

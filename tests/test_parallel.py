@@ -29,8 +29,11 @@ def test_mesh_uses_all_devices():
     assert mesh.axis_names == ("dp",)
 
 
-@pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp", "mtss_gan",
-                                    "mtss_wgan", "mtss_wgan_gp"])
+@pytest.mark.parametrize("family", [
+    "gan", "wgan", "wgan_gp",
+    pytest.param("mtss_gan", marks=pytest.mark.slow),
+    pytest.param("mtss_wgan", marks=pytest.mark.slow),
+    pytest.param("mtss_wgan_gp", marks=pytest.mark.slow)])
 def test_dp_step_runs_and_replicates(family, dataset):
     mesh = make_mesh()
     tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=2)
@@ -122,6 +125,7 @@ def test_dp_pallas_backend_on_tpu(dataset):
     assert int(new_state.step) == 1
 
 
+@pytest.mark.slow
 def test_dp_nan_guard_path(dataset):
     """The failure-detection path under data parallelism: a clean dp run
     with the guard on trains and stays replicated; poisoned data trips
@@ -201,10 +205,11 @@ def test_psum_if_handles_both_vma_cases(dataset):
                   check_vma=False)(w, batch)
 
 
-@pytest.mark.parametrize("family,n_dev", [("gan", 8), ("wgan", 8),
-                                          ("mtss_wgan_gp", 8),
-                                          ("mtss_wgan_gp", 4),
-                                          ("mtss_wgan_gp", 2)])
+@pytest.mark.parametrize("family,n_dev", [
+    ("gan", 8), ("wgan", 8),
+    pytest.param("mtss_wgan_gp", 8, marks=pytest.mark.slow),
+    pytest.param("mtss_wgan_gp", 4, marks=pytest.mark.slow),
+    ("mtss_wgan_gp", 2)])
 def test_dp_trajectory_matches_single_device(family, n_dev, dataset):
     """dp=8 with controlled global sampling must follow the *whole* loss
     trajectory (and land on the same parameters) as a single-device run at

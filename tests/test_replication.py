@@ -76,6 +76,7 @@ class TestTraining:
 
 
 class TestSweepEvaluate:
+    @pytest.mark.slow
     def test_vmapped_eval_matches_engine_loop(self, panels):
         """The one-program sweep evaluation must reproduce the per-latent
         engine path (use_params → IS/OOS/ante/post/turnover) exactly — the
@@ -127,6 +128,7 @@ class TestMetrics:
         ref = r2_score(np.asarray(eng.x_train), pred)
         np.testing.assert_allclose(eng.model_IS_r2(), ref, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_oos_metrics_match_naive_loop(self, panels):
         from sklearn.metrics import mean_squared_error, r2_score
         from sklearn.preprocessing import MinMaxScaler

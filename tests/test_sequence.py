@@ -69,6 +69,7 @@ def test_sp_full_generator_matches_single_device(b, w):
 
 
 @needs_8
+@pytest.mark.slow
 def test_sp_critic_matches_single_device_with_grads():
     """Window-sharded critic (pipelined LSTMs + psum'd flatten-Dense)
     must match LSTMFlatCritic in value AND in gradients w.r.t. both
@@ -105,6 +106,7 @@ def test_sp_critic_matches_single_device_with_grads():
 
 
 @needs_8
+@pytest.mark.slow
 def test_sp_train_step_matches_plain_step():
     """Sequence-parallel WGAN-GP training (window sharded over 8 devices,
     GP second-order through the pipelined recurrences) must follow the
@@ -169,6 +171,7 @@ def test_sharded_input_wrapper():
 
 
 @needs_8
+@pytest.mark.slow
 def test_gradients_flow():
     """First-order grads through ppermute pipeline match the scan's."""
     key = jax.random.PRNGKey(6)

@@ -50,6 +50,7 @@ def test_wgan_clip_bounds(dataset):
         assert float(jnp.abs(leaf).max()) <= TCFG.clip_value + 1e-7
 
 
+@pytest.mark.slow
 def test_multi_step_equals_sequential(dataset):
     """scan-of-steps must equal the same steps applied one by one."""
     mcfg = dataclasses.replace(MCFG, family="gan")
@@ -107,6 +108,7 @@ def test_resolve_lstm_backend_validates():
         resolve_lstm_backend("cuda")
 
 
+@pytest.mark.slow
 def test_pipelined_history_contiguous_with_checkpoints(tmp_path, dataset):
     """The pipelined logging path (block i's host work deferred behind
     block i+1's dispatch) must keep per-epoch history contiguous and
@@ -126,6 +128,7 @@ def test_pipelined_history_contiguous_with_checkpoints(tmp_path, dataset):
     assert any(not w for _, _, w in tr.timer.samples)
 
 
+@pytest.mark.slow
 def test_trainer_checkpoint_resume(tmp_path, dataset):
     cfg = ExperimentConfig(
         model=dataclasses.replace(MCFG, family="wgan_gp"),
