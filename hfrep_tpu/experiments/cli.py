@@ -48,6 +48,13 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--num-processes", type=int, default=None)
     t.add_argument("--process-id", type=int, default=None)
     t.add_argument("--quiet", action="store_true")
+    t.add_argument("--nan-guard", action="store_true",
+                   help="failure detection: roll back a block whose metrics "
+                        "go non-finite, reseed and retry (the reference's "
+                        "save-once-at-end runs lose everything on divergence, "
+                        "GAN/MTSS_WGAN_GP.py:285-287)")
+    t.add_argument("--max-recoveries", type=int, default=3,
+                   help="consecutive rollbacks before giving up (with --nan-guard)")
     t.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint in --checkpoint-dir "
                         "before training (elastic recovery, SURVEY §5.3)")
@@ -121,7 +128,7 @@ def cmd_clean(args) -> int:
 
 
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
-                  mesh=False, quiet=False):
+                  mesh=False, quiet=False, nan_guard=False, max_recoveries=3):
     import jax
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
@@ -141,7 +148,9 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
     style = {"gan": "gan", "mtss_gan": "gan", "wgan": "wgan", "mtss_wgan": "wgan"}.get(
         cfg.model.family, "wgan_gp")
     logger = MetricLogger(echo=not quiet, echo_style=style)
-    return GanTrainer(cfg, ds, mesh=device_mesh, logger=logger), ds, panel, cfg
+    trainer = GanTrainer(cfg, ds, mesh=device_mesh, logger=logger,
+                         nan_guard=nan_guard, max_recoveries=max_recoveries)
+    return trainer, ds, panel, cfg
 
 
 def cmd_train_gan(args) -> int:
@@ -155,7 +164,9 @@ def cmd_train_gan(args) -> int:
                                args.process_id)
         args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
-        args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh, args.quiet)
+        args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,
+        args.quiet, nan_guard=args.nan_guard,
+        max_recoveries=args.max_recoveries)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest
@@ -304,8 +315,12 @@ def cmd_sweep(args) -> int:
         a_ante = result.ante[i_best]
         actual = np.asarray(y_test)[-p.shape[0]:]
     if args.plots:
+        # Three series per panel — Ex-ante / Ex-post / Real — full parity
+        # with AE.plot (Autoencoder_encapsulate.py:226-243)
         report.multiplot(p, actual, panel.hf_names,
-                         os.path.join(args.out, "cumulative_returns.png"))
+                         os.path.join(args.out, "cumulative_returns.png"),
+                         labels=("replication (ex-post)", "actual"),
+                         ante=a_ante)
         print(f"plot: {os.path.join(args.out, 'cumulative_returns.png')}")
         # AE training diagnostics (Autoencoder_encapsulate.py:97-105 parity)
         path = report.ae_loss_curves(result.train_loss, result.val_loss,

@@ -44,11 +44,21 @@ def _panel_grid(n_panels: int, ncols: int, panel_size: tuple,
 
 def multiplot(replication: np.ndarray, actual: np.ndarray,
               names: Sequence[str], path: str, ncols: int = 3,
-              labels: tuple = ("replication", "actual")) -> str:
+              labels: tuple = ("replication", "actual"),
+              ante: Optional[np.ndarray] = None,
+              ante_label: str = "replication (ex-ante)") -> str:
     """Cumulative-return grid, one panel per strategy (cell 38's
     ``multiplot``): replicated vs actual index, compounded from monthly
-    returns."""
+    returns.
+
+    ``ante`` adds the third series of the reference's per-strategy chart
+    (``Autoencoder_encapsulate.py:226-243`` overlays *Ex-ante, Ex_post,
+    Real*; the reference cumsums raw returns where this grid compounds
+    them — same ranking, honest compounding)."""
     def draw(ax, j):
+        if ante is not None:
+            ax.plot(np.cumprod(1.0 + ante[:, j]) - 1.0, label=ante_label,
+                    linestyle="--")
         ax.plot(np.cumprod(1.0 + replication[:, j]) - 1.0, label=labels[0])
         ax.plot(np.cumprod(1.0 + actual[:, j]) - 1.0, label=labels[1])
         ax.set_title(names[j], fontsize=9)

@@ -1,0 +1,132 @@
+"""Multi-seed vmapped GAN training: K independent models in ONE program.
+
+Why this exists (RESULTS.md "Absolute performance"): at the reference's
+batch 32 (``GAN/MTSS_WGAN_GP.py:97-101``) the recurrent matmul occupies
+32 of the MXU's 128 systolic rows, and the measured per-sample
+throughput at batch 128 is 1.82× batch 32.  The reference's semantics
+pin batch 32 per model — but nothing pins *one model per program*.
+``jax.vmap`` over the complete train step stacks K independent
+members' (32, ·) matmuls into (K·32, ·) MXU work while every member
+consumes exactly the PRNG streams of a standalone run: member k's
+trajectory equals ``GanTrainer`` seeded with ``seeds[k]`` to summation
+round-off — ≤1e-8 after 7 epochs; vmap only reorders the batched
+reductions' accumulation
+(tests/test_train.py::test_multi_seed_bitwise_equivalence).
+
+This converts the documented roofline headroom into delivered
+throughput for the repo's own multi-seed workloads (seed-variance
+studies, family evaluation, GAN-augmentation ensembles) without
+touching reference semantics.  Measured on chip:
+``tools/bench_multi_seed.py`` → RESULTS.md "Multi-seed vmapped
+training".
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from hfrep_tpu.config import ExperimentConfig
+from hfrep_tpu.core.data import GanDataset
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_multi_step, make_train_step
+
+
+def init_multi_seed_states(init_keys: jnp.ndarray, mcfg, tcfg, pair=None):
+    """Stacked ``GanState`` (leading axis = member); member k equals
+    ``init_gan_state(init_keys[k], ...)``."""
+    if pair is None:
+        pair = build_gan(mcfg)
+    return jax.vmap(lambda k: init_gan_state(k, mcfg, tcfg, pair))(init_keys)
+
+
+def make_multi_seed_step(pair, tcfg, dataset: jnp.ndarray, jit: bool = True):
+    """``fn(states, keys) -> (states, metrics)`` running one
+    ``steps_per_call``-epoch block for every member; ``states`` is a
+    stacked pytree and ``keys`` is (K, 2).  The dataset is closed over
+    and shared (read-only) across members — each member samples its own
+    batches from it with its own key, exactly as a standalone run does."""
+    multi = make_multi_step(pair, tcfg, dataset, jit=False)
+    fn = jax.vmap(multi)
+    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+
+
+class MultiSeedTrainer:
+    """K member-exact :class:`~hfrep_tpu.train.trainer.GanTrainer` runs
+    in one jitted program.
+
+    Mirrors the trainer's key discipline — member k starts from
+    ``PRNGKey(seeds[k])``, splits once for (run, init), then splits the
+    run key per block — so each member's parameter trajectory equals
+    ``GanTrainer`` with ``train.seed = seeds[k]`` (same sample/noise/α
+    streams; only reduction-order round-off differs).
+    Deliberately minimal (no checkpoint/logging pipeline): the intended
+    use is throughput-bound multi-seed studies; full training
+    infrastructure remains the single-model trainer's job.
+    """
+
+    def __init__(self, cfg: ExperimentConfig, dataset: GanDataset | jnp.ndarray,
+                 seeds: Sequence[int]):
+        self.cfg = cfg
+        self.seeds = tuple(seeds)
+        self.windows = (dataset.windows if isinstance(dataset, GanDataset)
+                        else jnp.asarray(dataset))
+        self.scaler = dataset.scaler if isinstance(dataset, GanDataset) else None
+        self.pair = build_gan(cfg.model)
+        base = jnp.stack([jax.random.PRNGKey(s) for s in self.seeds])
+        split = jax.vmap(jax.random.split)(base)          # (K, 2, 2)
+        self.keys = split[:, 0]                           # per-member run keys
+        self.states = init_multi_seed_states(split[:, 1], cfg.model, cfg.train,
+                                             self.pair)
+        self._multi = make_multi_seed_step(self.pair, cfg.train, self.windows)
+        self._one = None
+        self._gen = None
+        self.epoch = 0
+
+    @property
+    def n_seeds(self) -> int:
+        return len(self.seeds)
+
+    def _split_keys(self):
+        ks = jax.vmap(jax.random.split)(self.keys)
+        self.keys = ks[:, 0]
+        return ks[:, 1]
+
+    def train(self, epochs: Optional[int] = None):
+        spc = self.cfg.train.steps_per_call
+        epochs = epochs if epochs is not None else self.cfg.train.epochs
+        n_full, remainder = divmod(epochs, spc)
+        for _ in range(n_full):
+            self.states, _ = self._multi(self.states, self._split_keys())
+            self.epoch += spc
+        if remainder:
+            if self._one is None:
+                step = make_train_step(self.pair, self.cfg.train, self.windows)
+                self._one = jax.jit(jax.vmap(step), donate_argnums=(0,))
+            for _ in range(remainder):
+                self.states, _ = self._one(self.states, self._split_keys())
+                self.epoch += 1
+        return self.states
+
+    def generate(self, key: jax.Array, n_samples: int,
+                 unscale: bool = True) -> jnp.ndarray:
+        """(K, n, W, F) samples — every member gets the SAME noise (the
+        standalone eval protocol fixes the sampling key independently of
+        the training seed), so members are comparable pointwise."""
+        w, f = self.windows.shape[1], self.windows.shape[2]
+        noise = jax.random.normal(key, (n_samples, w, f))
+        if self._gen is None:
+            from hfrep_tpu.train.steps import resolve_lstm_backend
+            be = resolve_lstm_backend(self.cfg.train.lstm_backend)
+            self._gen = jax.jit(jax.vmap(
+                lambda p, z: self.pair.generator.apply({"params": p}, z,
+                                                       backend=be),
+                in_axes=(0, None)))
+        out = self._gen(self.states.g_params, noise)
+        if unscale and self.scaler is not None:
+            from hfrep_tpu.core import scaler as mm
+            out = jax.vmap(lambda o: mm.inverse_transform(self.scaler, o))(out)
+        return out

@@ -28,6 +28,46 @@ def time_fn(fn, *args, iters=50):
     return (time.perf_counter() - t0) / iters, out
 
 
+def _probe_hidden_sizes(hiddens=(100, 256, 512), n_calls=6):
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_multi_step
+
+    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35), jnp.float32)
+    for h in hiddens:
+        rates = {}
+        for label, dtype, backend in [("f32/pallas", "float32", "pallas"),
+                                      ("bf16/scan", "bfloat16", "xla"),
+                                      ("f32/scan", "float32", "xla")]:
+            mcfg = ModelConfig(family="mtss_wgan_gp", hidden=h, dtype=dtype)
+            tcfg = TrainConfig(steps_per_call=50, lstm_backend=backend)
+            pair = build_gan(mcfg)
+            state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+            step = make_multi_step(pair, tcfg, data)
+            try:
+                state, m = step(state, jax.random.PRNGKey(1))
+                jax.block_until_ready(m)
+            except Exception as e:                    # e.g. VMEM OOM at large H
+                rates[label] = None
+                print(f"  hidden={h} {label}: FAILED "
+                      f"({type(e).__name__}: {str(e)[:120]}...)")
+                continue
+            t0 = time.perf_counter()
+            for i in range(n_calls):
+                state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(2), i))
+            jax.block_until_ready(m)
+            rates[label] = n_calls * 50 / (time.perf_counter() - t0)
+            assert jnp.isfinite(m["d_loss"]).all()
+        ok = {k: v for k, v in rates.items() if v}
+        best16 = ok.get("bf16/scan")
+        best32 = max((v for k, v in ok.items() if k.startswith("f32")), default=None)
+        ratio = (f"  -> bf16 vs best-f32: {best16/best32:.2f}x"
+                 if best16 and best32 else "")
+        print(f"hidden={h}: " + "  ".join(
+            f"{k} {v:.1f}/s" if v else f"{k} n/a" for k, v in rates.items()) + ratio)
+
+
 def main():
     print("backend:", jax.default_backend())
     fwd = jax.jit(lambda xz, rec: _lstm_seq_fwd_impl(xz, rec, "sigmoid",
@@ -43,6 +83,20 @@ def main():
         print(f"fwd traversal (B={b}, W={w}, Hp={hp}): "
               f"f32 {t32*1e6:.1f}us  bf16-operands {t16*1e6:.1f}us "
               f"({t32/t16:.2f}x)  max|Δh|={err:.2e}")
+
+    # Larger-model probe (VERDICT r2 item 7): the forward kernel accepts
+    # bf16 operand streams "for larger-model reuse" — measure where (if
+    # anywhere) that actually pays.  Isolated traversal timings through
+    # the tunnel proved unmeasurable in BOTH directions (identical-
+    # execution dedup, non-fencing readiness, 0.1-0.9 s latency jitter —
+    # even a reps=300 vs reps=3000 slope method returns negative slopes),
+    # so the instrument is the same state-threaded end-to-end loop
+    # bench.py uses: each dispatch consumes the previous dispatch's
+    # state, which nothing can dedup or reorder, and 50 epochs/dispatch
+    # dwarf the jitter.  Scaling `hidden` scales the recurrent matmul
+    # (the op whose operand width bf16 halves) quadratically.
+    print("--- larger-model probe: end-to-end train epochs at hidden=H ---")
+    _probe_hidden_sizes()
 
     # End-to-end: one flagship train epoch, f32+pallas vs bf16+scan.
     from hfrep_tpu.config import ModelConfig, TrainConfig

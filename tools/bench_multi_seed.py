@@ -1,0 +1,67 @@
+"""Per-model throughput of K-seed vmapped training vs K=1 (the roofline
+conversion RESULTS.md's batch table predicts: ~1.8× per-sample at 128
+MXU rows).  Flagship MTSS-WGAN-GP at the reference's (48, 35) shape and
+batch 32 per member — member semantics untouched, only the number of
+models per program varies.
+
+Run on the real chip: `python tools/bench_multi_seed.py [K ...]`
+(default 1 2 4).  Uses bench.py's measurement discipline: one jitted
+50-epoch block per dispatch, distinct keys per call (the tunneled
+backend dedupes identical executions).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+
+
+def measure(n_seeds: int, n_calls: int = 10) -> float:
+    from bench import load_dataset
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.multi_seed import (init_multi_seed_states,
+                                            make_multi_seed_step)
+
+    mcfg = ModelConfig(family="mtss_wgan_gp")
+    tcfg = TrainConfig(steps_per_call=50)
+    dataset = load_dataset(mcfg, include_rf=False)
+    pair = build_gan(mcfg)
+    keys = jnp.stack([jax.random.PRNGKey(100 + s) for s in range(n_seeds)])
+    states = init_multi_seed_states(keys, mcfg, tcfg, pair)
+    fn = make_multi_seed_step(pair, tcfg, dataset)
+
+    run_keys = jnp.stack([jax.random.PRNGKey(s) for s in range(n_seeds)])
+    fold = jax.jit(jax.vmap(jax.random.fold_in, in_axes=(0, None)))
+    states, metrics = fn(states, fold(run_keys, 0))      # compile + warm
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for i in range(1, n_calls + 1):
+        states, metrics = fn(states, fold(run_keys, i))
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(metrics["d_loss"]).all()
+    assert jnp.isfinite(metrics["g_loss"]).all()
+    # model-epochs per second (each member advances 50 epochs per call)
+    return n_calls * tcfg.steps_per_call * n_seeds / dt
+
+
+def main(argv):
+    ks = [int(a) for a in argv] or [1, 2, 4]
+    base = None
+    for k in ks:
+        rate = measure(k)
+        if base is None:
+            base = rate / k               # per-model rate at the first K
+        print(f"K={k}: {rate:8.1f} model-epochs/s  "
+              f"({rate / k:7.1f} per model, {rate / k / base:4.2f}x vs K={ks[0]})",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

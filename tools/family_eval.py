@@ -14,7 +14,13 @@ import time
 import jax
 
 
-def main(out="results/family_eval.json"):
+def main(out="results/family_eval.json", seeds: int = 1):
+    """``seeds > 1`` trains that many member-exact models per family in
+    ONE vmapped program (`hfrep_tpu/train/multi_seed.py`) and reports
+    per-seed metrics plus mean/std — the seed-variance protocol with K×
+    fewer dispatches (throughput itself is not improved by vmapping;
+    RESULTS.md "Multi-seed vmapped training: measured negative result")."""
+    seeds = int(seeds)
 
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
@@ -27,21 +33,46 @@ def main(out="results/family_eval.json"):
                    "mtss_wgan_gp"):
         cfg = get_preset(preset)
         ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
-        tr = GanTrainer(cfg, ds)
-        t0 = time.perf_counter()
-        tr.train()
-        wall = time.perf_counter() - t0
         n = min(500, ds.windows.shape[0])
-        fake = tr.generate(jax.random.PRNGKey(11), n, unscale=False)
-        suite = GanEval(ds.windows[:n], fake, ds.windows,
-                        model_name=[cfg.model.family])
-        res = suite.run_all()
+        t0 = time.perf_counter()
+        if seeds == 1:
+            tr = GanTrainer(cfg, ds)
+            tr.train()
+            wall = time.perf_counter() - t0
+            fakes = [tr.generate(jax.random.PRNGKey(11), n, unscale=False)]
+            epochs = tr.epoch
+        else:
+            from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+            mst = MultiSeedTrainer(cfg, ds,
+                                   [cfg.train.seed + k for k in range(seeds)])
+            mst.train()
+            wall = time.perf_counter() - t0
+            cube = mst.generate(jax.random.PRNGKey(11), n, unscale=False)
+            fakes = [cube[k] for k in range(seeds)]
+            epochs = mst.epoch
+        per_seed = []
+        for fake in fakes:
+            suite = GanEval(ds.windows[:n], fake, ds.windows,
+                            model_name=[cfg.model.family])
+            per_seed.append(suite.run_all())
+        if seeds == 1:
+            res = dict(per_seed[0])
+        else:
+            import numpy as np
+            scalars = [k for k, v in per_seed[0].items()
+                       if isinstance(v, (int, float))]
+            res = {k: float(np.mean([p[k] for p in per_seed]))
+                   for k in scalars}
+            res["per_seed"] = per_seed
+            res["std"] = {k: float(np.std([p[k] for p in per_seed]))
+                          for k in scalars}
         res["train_wall_s"] = round(wall, 2)
-        res["epochs"] = tr.epoch
+        res["epochs"] = epochs
+        res["n_seeds"] = seeds
         results[cfg.model.family] = res
-        print(f"{cfg.model.family}: {tr.epoch} epochs in {wall:.1f}s  "
-              f"FID={res.get('FID'):.4g}  JS={res.get('js_div'):.4g}",
-              flush=True)
+        print(f"{cfg.model.family}: {epochs} epochs ×{seeds} seed(s) in "
+              f"{wall:.1f}s  FID={res.get('FID'):.4g}  "
+              f"JS={res.get('js_div'):.4g}", flush=True)
 
     if os.path.dirname(out):
         os.makedirs(os.path.dirname(out), exist_ok=True)
@@ -51,4 +82,11 @@ def main(out="results/family_eval.json"):
 
 
 if __name__ == "__main__":
-    main(*sys.argv[1:])
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out", nargs="?", default="results/family_eval.json")
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="models per family, trained member-exact in one "
+                         "vmapped program (hfrep_tpu/train/multi_seed.py)")
+    a = ap.parse_args()
+    main(a.out, a.seeds)
