@@ -6,6 +6,7 @@ from hfrep_tpu.parallel.mesh import (  # noqa: F401
 )
 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step  # noqa: F401
 from hfrep_tpu.parallel.sequence import (  # noqa: F401
+    make_sp_multi_step,
     make_sp_train_step,
     sp_critic,
     sp_generate,

@@ -27,12 +27,15 @@ round-off in tests/test_sequence.py on an 8-device CPU mesh.
 Backends: ``backend="xla"`` scans the fused cell; ``backend="pallas"``
 dispatches each device's chunk to the carry-injection pallas kernels
 (:func:`hfrep_tpu.ops.pallas_lstm.lstm_seq_carry` — nonzero (h0, c0) in,
-final carry out, twice-differentiable), keeping the ~10× single-device
-kernel speed in the sharded composition.  The pallas path compiles only
+final carry out, twice-differentiable).  The pallas path compiles only
 on real TPU (interpret-mode pallas cannot propagate vma under
-``shard_map(check_vma=True)``), so it is opt-in and TPU-gated; the
-kernels themselves are oracle-tested against the scan twin on a single
-chip (tests/test_pallas_lstm.py carry tests).
+``shard_map(check_vma=True)``) and is opt-in: dispatch-amortized
+measurement on one chip shows the scan backend slightly ahead in the sp
+composition (184 vs 243 ms/epoch at prod shape — the kernels' win lives
+in whole-epoch fusion, which chunk boundaries break; RESULTS.md
+"Sequence-parallel pallas chunks").  The kernels themselves are
+oracle-tested against the scan twin on a single chip
+(tests/test_pallas_lstm.py carry tests, tools/chip_check_carry.py).
 """
 
 from __future__ import annotations
@@ -239,6 +242,24 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                                      backend=backend)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
     return jax.jit(step, donate_argnums=(0,)) if jit else step
+
+
+def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: Optional[str] = None, jit: bool = True):
+    """``fn(state, key) -> (state, stacked_metrics)``:
+    ``tcfg.steps_per_call`` sequence-parallel epochs scanned into ONE
+    compiled program — the sp twin of
+    :func:`hfrep_tpu.train.steps.make_multi_step` and the launch shape
+    real sp training should use.  Measured on chip (RESULTS.md): a
+    single-epoch dispatch pays ~1 s of fixed per-dispatch overhead
+    through the tunneled runtime, so one-epoch-at-a-time timing
+    overstates the sp program's cost by ~6×; 50-epoch blocks amortize it
+    exactly as the plain trainer's ``steps_per_call`` does."""
+    from hfrep_tpu.train.steps import make_multi_step
+
+    step = make_sp_train_step(pair, tcfg, dataset, mesh,
+                              axis_name=axis_name, jit=False)
+    return make_multi_step(pair, tcfg, dataset, jit=jit, step=step)
 
 
 def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,

@@ -358,13 +358,17 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
 
 def make_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                     axis_name: Optional[str] = None, jit: bool = True,
-                    sample_batch: Optional[int] = None):
+                    sample_batch: Optional[int] = None,
+                    step: Optional[Callable] = None):
     """Scan ``steps_per_call`` epochs into one compiled program.
 
     Returns ``fn(state, key) -> (state, stacked_metrics)``; metrics carry
     one entry per inner epoch so per-epoch logging survives the batching.
+    ``step`` overrides the epoch step (e.g. a prebuilt sequence-parallel
+    step) while keeping the scan/key-folding harness in one place.
     """
-    step = make_train_step(pair, tcfg, dataset, axis_name, sample_batch)
+    if step is None:
+        step = make_train_step(pair, tcfg, dataset, axis_name, sample_batch)
     n = tcfg.steps_per_call
 
     def multi(state: GanState, key: jax.Array):

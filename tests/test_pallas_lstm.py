@@ -222,6 +222,7 @@ def test_carry_gradients_match_scan_twin(activation):
                                    atol=1e-5, rtol=1e-4, err_msg=name)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("activation", ["sigmoid", "tanh"])
 def test_carry_second_order_matches_scan_twin(activation):
     """Grad-of-grad (the GP pattern ∂/∂θ ∇_x c) through the carry

@@ -69,6 +69,7 @@ def test_dp_trainer_end_to_end(dataset):
     assert tr.steps_per_sec > 0
 
 
+@pytest.mark.slow
 def test_dp_gradient_is_global_batch_mean(dataset):
     """Axis-normalized per-shard gradients must equal the global-batch
     gradient.

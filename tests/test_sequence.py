@@ -50,7 +50,8 @@ def test_sigmoid_variant():
 
 
 @needs_8
-@pytest.mark.parametrize("b,w", [(8, 16), (8, 128)])
+@pytest.mark.parametrize("b,w", [
+    (8, 16), pytest.param(8, 128, marks=pytest.mark.slow)])
 def test_sp_full_generator_matches_single_device(b, w):
     """The complete MTSS generator (both LSTMs + LN/LeakyReLU/Dense head)
     window-sharded over the sp mesh must equal the single-device apply —
@@ -204,3 +205,34 @@ def test_validation_errors():
     with pytest.raises(ValueError):
         sp_lstm(p["kernel"], p["recurrent_kernel"], p["bias"],
                 jnp.zeros((8, 12, 4)), mesh)          # window not divisible
+
+
+@needs_8
+@pytest.mark.slow
+def test_sp_multi_step_equals_sequential_sp_steps():
+    """The scanned multi-epoch sp block must equal the same sp steps
+    applied one by one (the make_multi_step equivalence, sp flavor)."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import (make_sp_multi_step,
+                                             make_sp_train_step)
+    from hfrep_tpu.train.states import init_gan_state
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=8, window=16, features=5)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    mesh = _mesh(8)
+    data = jax.random.uniform(jax.random.PRNGKey(0), (64, 16, 5))
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(1)
+
+    multi = make_sp_multi_step(pair, tcfg, data, mesh, jit=False)
+    st_a, metrics = multi(init_gan_state(key, mcfg, tcfg, pair), jax.random.PRNGKey(2))
+    assert metrics["d_loss"].shape == (3,)
+
+    step = make_sp_train_step(pair, tcfg, data, mesh, jit=False)
+    st_b = init_gan_state(key, mcfg, tcfg, pair)
+    for i in range(3):
+        st_b, _ = step(st_b, jax.random.fold_in(jax.random.PRNGKey(2), i))
+    for la, lb in zip(jax.tree_util.tree_leaves(st_a.g_params),
+                      jax.tree_util.tree_leaves(st_b.g_params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-6)

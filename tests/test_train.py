@@ -222,6 +222,7 @@ class TestNanGuard:
 class TestMultiSeed:
     """K-member vmapped training (hfrep_tpu/train/multi_seed.py)."""
 
+    @pytest.mark.slow
     def test_multi_seed_bitwise_equivalence(self, dataset):
         """Each vmapped member's trajectory AND generated samples must
         equal a standalone GanTrainer with that seed (VERDICT r2 item 2's
