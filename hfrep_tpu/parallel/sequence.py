@@ -29,13 +29,13 @@ dispatches each device's chunk to the carry-injection pallas kernels
 (:func:`hfrep_tpu.ops.pallas_lstm.lstm_seq_carry` — nonzero (h0, c0) in,
 final carry out, twice-differentiable).  The pallas path compiles only
 on real TPU (interpret-mode pallas cannot propagate vma under
-``shard_map(check_vma=True)``) and is opt-in: dispatch-amortized
-measurement on one chip shows the scan backend slightly ahead in the sp
-composition (184 vs 243 ms/epoch at prod shape — the kernels' win lives
-in whole-epoch fusion, which chunk boundaries break; RESULTS.md
-"Sequence-parallel pallas chunks").  The kernels themselves are
-oracle-tested against the scan twin on a single chip
-(tests/test_pallas_lstm.py carry tests, tools/chip_check_carry.py).
+``shard_map(check_vma=True)``); on TPU the default ``lstm_backend='auto'``
+resolves to it, and dispatch-amortized measurement has it ahead of the
+scan backend in the full sp training composition (80.5 vs 100.6 ms/epoch
+at prod shape on one chip; RESULTS.md "Sequence-parallel pallas
+chunks").  The kernels are oracle-tested against the scan twin on a
+single chip (tests/test_pallas_lstm.py carry tests,
+tools/chip_check_carry.py).
 """
 
 from __future__ import annotations
@@ -147,7 +147,6 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
         def _varying(a):
             return lax.pcast(a, axis_name, to="varying")
 
-        out = _varying(jnp.zeros((wl, m, bm, hp), xz.dtype))
         carry_reg = (_varying(jnp.zeros((bm, hp), xz.dtype)),
                      _varying(jnp.zeros((bm, hp), xz.dtype)))
 
@@ -167,8 +166,19 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
                 return (h_seq[-1], c_f), h_seq
             return _local_chunk_scan(xz_s, (h0, c0), rec, act, rec_act)
 
-        def superstep(s, state):
-            out_buf, (h_in, c_in) = state
+        # Scan-then-gather: every superstep emits its chunk's hidden
+        # sequence; afterwards this device keeps exactly its m active
+        # supersteps (s = k_idx + mb).  No masking is needed — device k
+        # is active precisely for s ∈ [k, k+m-1], so (a) every gathered
+        # output comes from an active compute, and (b) a carry consumed
+        # by an active step was always produced by an active step at
+        # s-1 (k active at s ⟺ k-1 active at s-1); inactive chunks
+        # produce bounded garbage that nothing selects.  This replaces
+        # the earlier fori_loop that scatter-updated a (Wl, M, Bm, H)
+        # buffer under a `where` every superstep — two full-buffer
+        # copies per superstep that AD then re-materialized.
+        def superstep(carry, s):
+            h_in, c_in = carry
             mb = s - k_idx                              # microbatch this device runs now
             active = jnp.logical_and(mb >= 0, mb < m)
             mb_c = jnp.clip(mb, 0, m - 1)
@@ -177,10 +187,13 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
             h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
             c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
             (h_f, c_f), h_seq = run_chunk(xz_s, h0, c0)
-            out_buf = jnp.where(
-                active,
-                lax.dynamic_update_index_in_dim(out_buf, h_seq, mb_c, axis=1),
-                out_buf)
+            # Inactive fill/drain chunks never feed a *selected* output,
+            # but their carries must still be zeroed at the handoff: with
+            # a non-saturating activation ("linear"/None) an unselected
+            # garbage chain could otherwise compound across supersteps to
+            # inf, and 0-cotangent × inf residuals would NaN the real
+            # gradients.  Two (Bm, Hp) wheres — the big buffer scatter
+            # this scan/gather design removed is what cost time.
             h_f = jnp.where(active, h_f, 0.0)
             c_f = jnp.where(active, c_f, 0.0)
             # Hand the finished carry to the next pipeline stage (padding
@@ -188,11 +201,13 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
             # weights are zero, so they never touch real lanes).
             h_nxt = lax.ppermute(h_f, axis_name, perm=fwd)
             c_nxt = lax.ppermute(c_f, axis_name, perm=fwd)
-            return out_buf, (h_nxt, c_nxt)
+            return (h_nxt, c_nxt), h_seq
 
-        out, _ = lax.fori_loop(0, m + n_dev - 1, superstep, (out, carry_reg))
-        # (Wl, M, Bm, Hp) → (B, Wl, H)
-        out = out.reshape(wl, b, hp)
+        _, ys = lax.scan(superstep, carry_reg,
+                         jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp)
+        out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp)
+        # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
+        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, hp)
         return jnp.swapaxes(out, 0, 1)[..., :h]
 
     mapped = shard_map(

@@ -236,3 +236,59 @@ def test_sp_multi_step_equals_sequential_sp_steps():
     for la, lb in zip(jax.tree_util.tree_leaves(st_a.g_params),
                       jax.tree_util.tree_leaves(st_b.g_params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-6)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled pallas path needs a real TPU")
+def test_sp_pallas_backend_on_tpu():
+    """sp_lstm(backend='pallas') — carry-injection kernels under
+    shard_map(check_vma=True) — must match the scan backend in forward
+    and parameter gradients.  Interpret-mode pallas can't propagate vma,
+    so this runs only where the kernels compile natively; the CPU suite
+    skips it (driven on chip by tools/chip_check_carry.py)."""
+    from hfrep_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    h, f, b, w = 100, 35, 8, 48
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 3)
+    kern = 0.3 * jax.random.normal(ks[0], (f, 4 * h))
+    recu = 0.3 * jax.random.normal(ks[1], (h, 4 * h))
+    bias = 0.1 * jax.random.normal(ks[2], (4 * h,))
+    x = jax.random.normal(jax.random.fold_in(key, 1), (b, w, f))
+
+    ref = sp_lstm(kern, recu, bias, x, mesh, activation="sigmoid")
+    got = sp_lstm(kern, recu, bias, x, mesh, activation="sigmoid",
+                  backend="pallas")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+    def loss(be, kern, recu, bias):
+        out = sp_lstm(kern, recu, bias, x, mesh, activation="sigmoid",
+                      backend=be)
+        return jnp.sum(out ** 2)
+
+    import functools
+    rg = jax.grad(functools.partial(loss, "xla"), argnums=(0, 1, 2))(
+        kern, recu, bias)
+    gg = jax.grad(functools.partial(loss, "pallas"), argnums=(0, 1, 2))(
+        kern, recu, bias)
+    for a, r in zip(gg, rg):
+        scale = float(np.max(np.abs(np.asarray(r)))) or 1.0
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(r) / scale, atol=1e-5)
+
+
+def test_sp_pallas_requires_tpu():
+    """Off-TPU the pallas sp backend must refuse loudly, not interpret
+    silently (interpret-mode pallas can't propagate vma under
+    shard_map(check_vma))."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("error path is for non-TPU hosts")
+    from hfrep_tpu.ops.lstm import KerasLSTM
+
+    mod = KerasLSTM(8, activation="sigmoid")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 5))
+    params = mod.init(jax.random.PRNGKey(1), x)["params"]
+    with pytest.raises(NotImplementedError, match="real TPU"):
+        sp_lstm(params["kernel"], params["recurrent_kernel"], params["bias"],
+                x, _mesh(8), activation="sigmoid", backend="pallas")

@@ -32,7 +32,7 @@ from hfrep_tpu.parallel.sequence import sp_critic
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--reps", type=int, default=20)
-    ap.add_argument("--backend", default="xla")
+    ap.add_argument("--backend", default="xla", choices=["xla", "pallas"])
     args = ap.parse_args()
     reps = args.reps
 
