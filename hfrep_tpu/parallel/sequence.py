@@ -30,10 +30,11 @@ dispatches each device's chunk to the carry-injection pallas kernels
 final carry out, twice-differentiable).  The pallas path compiles only
 on real TPU (interpret-mode pallas cannot propagate vma under
 ``shard_map(check_vma=True)``); on TPU the default ``lstm_backend='auto'``
-resolves to it, and dispatch-amortized measurement has it ahead of the
-scan backend in the full sp training composition (80.5 vs 100.6 ms/epoch
-at prod shape on one chip; RESULTS.md "Sequence-parallel pallas
-chunks").  The kernels are oracle-tested against the scan twin on a
+resolves to it; in the full sp training composition the kernels are
+3.8× the scan backend and bring the window-sharded step to ~80% of the
+plain single-device step's speed (7.5 vs 6.0 ms/epoch at prod shape on
+one chip; RESULTS.md "Sequence-parallel pallas chunks" — note the two
+measurement traps documented there).  The kernels are oracle-tested against the scan twin on a
 single chip (tests/test_pallas_lstm.py carry tests,
 tools/chip_check_carry.py).
 """
@@ -79,6 +80,174 @@ def _resolve_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
         f"pass axis_name explicitly for multi-axis mesh {mesh.axis_names}")
 
 
+def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
+                 axis_name: Optional[str] = None,
+                 microbatches: Optional[int] = None,
+                 activation: str = "tanh",
+                 recurrent_activation: str = "sigmoid",
+                 backend: str = "xla",
+                 inters=None) -> jnp.ndarray:
+    """N stacked LSTMs through ONE window-sharded pipeline pass.
+
+    ``layers`` is a list of KerasLSTM param dicts ({kernel,
+    recurrent_kernel, bias}); ``inters[i]`` is an optional *per-timestep*
+    transform applied between layer i and i+1 (e.g. the generator's
+    LayerNorm), given as a ``(fn, params)`` pair — ``fn(params, y)`` with
+    ``params`` threaded through `shard_map` as a real operand (closure
+    capture of arrays inside the manual-mesh body trips jax's
+    mesh-consistency check when the pipeline is scanned over epochs).
+    Per-timestep means position-independent, so applying it chunk-wise
+    inside the pipeline computes exactly what applying it to the full
+    sequence would.  Each superstep runs this device's chunk
+    through every layer back-to-back (layer i+1's chunk input is layer
+    i's chunk output, same device, same superstep) and hands ALL layers'
+    (h, c) carries forward together — one pipeline fill/drain and one
+    shard_map region for the whole stack, where per-layer passes pay
+    both per layer.
+    """
+    axis_name = _resolve_axis(mesh, axis_name)
+    n_dev = mesh.shape[axis_name]
+    b, w, f = x.shape
+    h_dims = [l["recurrent_kernel"].shape[0] for l in layers]
+    m = microbatches or n_dev
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    if w % n_dev:
+        raise ValueError(f"window {w} not divisible by sp devices {n_dev}")
+    bm = b // m
+    n_lay = len(layers)
+    inters = list(inters) if inters is not None else [None] * n_lay
+    inter_fns = [i[0] if i is not None else None for i in inters]
+    inter_params = [i[1] if i is not None else () for i in inters]
+    act, rec_act = ACTIVATIONS[activation], ACTIVATIONS[recurrent_activation]
+
+    use_kernel = backend == "pallas"
+    if use_kernel:
+        from hfrep_tpu.ops.pallas_lstm import (LANE, _supported,
+                                               lstm_seq_carry,
+                                               pad_keras_params)
+        _supported(activation, recurrent_activation)
+        if jax.default_backend() != "tpu":
+            raise NotImplementedError(
+                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
+                "pallas cannot propagate vma under shard_map(check_vma)")
+        if x.dtype != jnp.float32:
+            raise NotImplementedError("sp_lstm pallas backend runs f32")
+        hp = [((h + LANE - 1) // LANE) * LANE for h in h_dims]
+        lay = []
+        for l, h, hpi in zip(layers, h_dims, hp):
+            k_p, r_p, b_p = pad_keras_params(l, h, hpi)
+            lay.append({"kernel": k_p, "recurrent_kernel": r_p, "bias": b_p})
+        act_name = activation if activation else "linear"
+    else:
+        hp = h_dims
+        lay = list(layers)
+
+    fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
+
+    def per_device(lay, inter_params, x_local):
+        # x_local: (B, Wl, F) — this device's time chunk for every row.
+        wl = x_local.shape[1]
+        k_idx = lax.axis_index(axis_name)
+        # Hoisted layer-0 input projection: one MXU matmul for the whole
+        # chunk (padded-gate layout when the pallas kernels run it).
+        # Deeper layers' projections run per superstep — their inputs
+        # only exist once the previous layer's chunk has run.
+        g0 = 4 * hp[0]
+        xz = (x_local.reshape(b * wl, f) @ lay[0]["kernel"]
+              + lay[0]["bias"]).reshape(b, wl, g0)
+        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp0)
+        xz_mb = xz.reshape(wl, m, bm, g0)               # microbatch split
+
+        # pcast to varying: mark the device-varying loop state as such for
+        # the shard_map VMA type system (loop outputs vary over 'sp').
+        def _varying(a):
+            return lax.pcast(a, axis_name, to="varying")
+
+        carry_reg = tuple(
+            (_varying(jnp.zeros((bm, hpi), xz.dtype)),
+             _varying(jnp.zeros((bm, hpi), xz.dtype))) for hpi in hp)
+
+        # Kernel mode: the pallas custom_vjp emits *varying* cotangents
+        # (hand-computed per-device, never auto-psum'd), so a replicated
+        # rec would give the AD-generated reverse scan a drec accumulator
+        # whose carry-in (invariant zeros) mismatches its carry-out under
+        # check_vma.  Casting rec to varying keeps the whole cotangent
+        # chain varying; the pcast's own transpose then psums it back to
+        # the replicated param exactly once at the boundary.
+        recs = [(_varying(l["recurrent_kernel"]) if use_kernel
+                 else l["recurrent_kernel"]) for l in lay]
+
+        def run_chunk(i, xz_s, h0, c0):
+            """((h_fin, c_fin), h_seq) for one (Wl, Bm, 4Hp_i) chunk."""
+            if use_kernel:
+                h_seq, c_f = lstm_seq_carry(xz_s, recs[i], h0, c0, act_name)
+                return (h_seq[-1], c_f), h_seq
+            return _local_chunk_scan(xz_s, (h0, c0), recs[i], act, rec_act)
+
+        # Scan-then-gather: every superstep emits its chunk's last-layer
+        # hidden sequence; afterwards this device keeps exactly its m
+        # active supersteps (s = k_idx + mb).  No output masking is
+        # needed — device k is active precisely for s ∈ [k, k+m-1], so
+        # (a) every gathered output comes from an active compute, and
+        # (b) a carry consumed by an active step was always produced by
+        # an active step at s-1 (k active at s ⟺ k-1 active at s-1);
+        # inactive chunks produce bounded garbage that nothing selects.
+        # This replaces the earlier fori_loop that scatter-updated a
+        # (Wl, M, Bm, H) buffer under a `where` every superstep — two
+        # full-buffer copies per superstep that AD then re-materialized.
+        def superstep(carry, s):
+            mb = s - k_idx                              # microbatch this device runs now
+            active = jnp.logical_and(mb >= 0, mb < m)
+            mb_c = jnp.clip(mb, 0, m - 1)
+            seq = lax.dynamic_index_in_dim(xz_mb, mb_c, axis=1, keepdims=False)
+            new_carry = []
+            for i in range(n_lay):
+                if i > 0:
+                    # previous layer's real lanes → inter-layer transform
+                    # → this layer's input projection (one (Wl·Bm)-row
+                    # MXU matmul per chunk)
+                    y = seq[..., :h_dims[i - 1]]
+                    if inter_fns[i - 1] is not None:
+                        y = inter_fns[i - 1](inter_params[i - 1], y)
+                    gi = 4 * hp[i]
+                    seq = (y.reshape(wl * bm, h_dims[i - 1]) @ lay[i]["kernel"]
+                           + lay[i]["bias"]).reshape(wl, bm, gi)
+                h_in, c_in = carry[i]
+                # Device 0 always starts microbatches from the zero carry.
+                h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
+                c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
+                (h_f, c_f), seq = run_chunk(i, seq, h0, c0)
+                # Inactive fill/drain chunks never feed a *selected*
+                # output, but their carries must still be zeroed at the
+                # handoff: with a non-saturating activation an unselected
+                # garbage chain could otherwise compound across
+                # supersteps to inf, and 0-cotangent × inf residuals
+                # would NaN the real gradients.
+                h_f = jnp.where(active, h_f, 0.0)
+                c_f = jnp.where(active, c_f, 0.0)
+                # Hand the finished carry to the next pipeline stage
+                # (padding lanes ride along in kernel mode; their
+                # outgoing recurrent weights are zero, so they never
+                # touch real lanes).
+                new_carry.append((lax.ppermute(h_f, axis_name, perm=fwd),
+                                  lax.ppermute(c_f, axis_name, perm=fwd)))
+            return tuple(new_carry), seq
+
+        _, ys = lax.scan(superstep, carry_reg,
+                         jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp[-1])
+        out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp[-1])
+        # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
+        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, hp[-1])
+        return jnp.swapaxes(out, 0, 1)[..., :h_dims[-1]]
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(None, axis_name, None)),
+        out_specs=P(None, axis_name, None))
+    return mapped(lay, inter_params, x)
+
+
 def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
             x: jnp.ndarray, mesh: Mesh, *, axis_name: Optional[str] = None,
             microbatches: Optional[int] = None,
@@ -98,123 +267,29 @@ def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
     ``backend="pallas"`` runs each chunk through the carry-injection
     pallas kernels (TPU-only; see module docstring).
     """
-    axis_name = _resolve_axis(mesh, axis_name)
-    n_dev = mesh.shape[axis_name]
-    b, w, f = x.shape
-    h = recurrent.shape[0]
-    m = microbatches or n_dev
-    if b % m:
-        raise ValueError(f"batch {b} not divisible by microbatches {m}")
-    if w % n_dev:
-        raise ValueError(f"window {w} not divisible by sp devices {n_dev}")
-    bm = b // m
-    act, rec_act = ACTIVATIONS[activation], ACTIVATIONS[recurrent_activation]
+    return _sp_pipeline(
+        [{"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}],
+        x, mesh, axis_name=axis_name, microbatches=microbatches,
+        activation=activation, recurrent_activation=recurrent_activation,
+        backend=backend)
 
-    use_kernel = backend == "pallas"
-    if use_kernel:
-        from hfrep_tpu.ops.pallas_lstm import (LANE, _supported,
-                                               lstm_seq_carry,
-                                               pad_keras_params)
-        _supported(activation, recurrent_activation)
-        if jax.default_backend() != "tpu":
-            raise NotImplementedError(
-                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
-                "pallas cannot propagate vma under shard_map(check_vma)")
-        if x.dtype != jnp.float32:
-            raise NotImplementedError("sp_lstm pallas backend runs f32")
-        hp = ((h + LANE - 1) // LANE) * LANE
-        kernel, recurrent, bias = pad_keras_params(
-            {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias},
-            h, hp)
-        act_name = activation if activation else "linear"
-    else:
-        hp = h
 
-    fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
-
-    def per_device(kern, rec, bia, x_local):
-        # x_local: (B, Wl, F) — this device's time chunk for every row.
-        wl = x_local.shape[1]
-        k_idx = lax.axis_index(axis_name)
-        # Hoisted input projection: one MXU matmul for the whole chunk.
-        # (Padded-gate layout when the pallas kernels run the chunks.)
-        xz = (x_local.reshape(b * wl, f) @ kern + bia).reshape(b, wl, 4 * hp)
-        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp)
-        xz_mb = xz.reshape(wl, m, bm, 4 * hp)           # microbatch split
-
-        # pcast to varying: mark the device-varying loop state as such for
-        # the shard_map VMA type system (loop outputs vary over 'sp').
-        def _varying(a):
-            return lax.pcast(a, axis_name, to="varying")
-
-        carry_reg = (_varying(jnp.zeros((bm, hp), xz.dtype)),
-                     _varying(jnp.zeros((bm, hp), xz.dtype)))
-
-        # Kernel mode: the pallas custom_vjp emits *varying* cotangents
-        # (hand-computed per-device, never auto-psum'd), so a replicated
-        # rec would give the AD-generated reverse scan a drec accumulator
-        # whose carry-in (invariant zeros) mismatches its carry-out under
-        # check_vma.  Casting rec to varying keeps the whole cotangent
-        # chain varying; the pcast's own transpose then psums it back to
-        # the replicated param exactly once at the boundary.
-        rec_v = _varying(rec) if use_kernel else rec
-
-        def run_chunk(xz_s, h0, c0):
-            """((h_fin, c_fin), h_seq) for one (Wl, Bm, 4Hp) chunk."""
-            if use_kernel:
-                h_seq, c_f = lstm_seq_carry(xz_s, rec_v, h0, c0, act_name)
-                return (h_seq[-1], c_f), h_seq
-            return _local_chunk_scan(xz_s, (h0, c0), rec, act, rec_act)
-
-        # Scan-then-gather: every superstep emits its chunk's hidden
-        # sequence; afterwards this device keeps exactly its m active
-        # supersteps (s = k_idx + mb).  No masking is needed — device k
-        # is active precisely for s ∈ [k, k+m-1], so (a) every gathered
-        # output comes from an active compute, and (b) a carry consumed
-        # by an active step was always produced by an active step at
-        # s-1 (k active at s ⟺ k-1 active at s-1); inactive chunks
-        # produce bounded garbage that nothing selects.  This replaces
-        # the earlier fori_loop that scatter-updated a (Wl, M, Bm, H)
-        # buffer under a `where` every superstep — two full-buffer
-        # copies per superstep that AD then re-materialized.
-        def superstep(carry, s):
-            h_in, c_in = carry
-            mb = s - k_idx                              # microbatch this device runs now
-            active = jnp.logical_and(mb >= 0, mb < m)
-            mb_c = jnp.clip(mb, 0, m - 1)
-            xz_s = lax.dynamic_index_in_dim(xz_mb, mb_c, axis=1, keepdims=False)
-            # Device 0 always starts microbatches from the zero carry.
-            h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
-            c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
-            (h_f, c_f), h_seq = run_chunk(xz_s, h0, c0)
-            # Inactive fill/drain chunks never feed a *selected* output,
-            # but their carries must still be zeroed at the handoff: with
-            # a non-saturating activation ("linear"/None) an unselected
-            # garbage chain could otherwise compound across supersteps to
-            # inf, and 0-cotangent × inf residuals would NaN the real
-            # gradients.  Two (Bm, Hp) wheres — the big buffer scatter
-            # this scan/gather design removed is what cost time.
-            h_f = jnp.where(active, h_f, 0.0)
-            c_f = jnp.where(active, c_f, 0.0)
-            # Hand the finished carry to the next pipeline stage (padding
-            # lanes ride along in kernel mode; their outgoing recurrent
-            # weights are zero, so they never touch real lanes).
-            h_nxt = lax.ppermute(h_f, axis_name, perm=fwd)
-            c_nxt = lax.ppermute(c_f, axis_name, perm=fwd)
-            return (h_nxt, c_nxt), h_seq
-
-        _, ys = lax.scan(superstep, carry_reg,
-                         jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp)
-        out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp)
-        # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
-        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, hp)
-        return jnp.swapaxes(out, 0, 1)[..., :h]
-
-    mapped = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, axis_name, None)),
-        out_specs=P(None, axis_name, None))
-    return mapped(kernel, recurrent, bias, x)
+def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
+             inter=None, axis_name: Optional[str] = None,
+             microbatches: Optional[int] = None,
+             activation: str = "tanh",
+             recurrent_activation: str = "sigmoid",
+             backend: str = "xla") -> jnp.ndarray:
+    """Two stacked LSTMs fused into ONE pipeline pass (optionally with a
+    per-timestep ``inter = (fn, params)`` transform between them, applied
+    as ``fn(params, y)``) — the sp analogue of the single-device fused
+    stack kernels (`ops/pallas_lstm_stack.py`): one fill/drain and one
+    shard_map region instead of two of each."""
+    return _sp_pipeline([p0, p1], x, mesh, inters=[inter, None],
+                        axis_name=axis_name, microbatches=microbatches,
+                        activation=activation,
+                        recurrent_activation=recurrent_activation,
+                        backend=backend)
 
 
 def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -289,13 +364,15 @@ def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
                    x, mesh, **kw)
 
 
-@functools.partial(jax.jit, static_argnames=("eps",))
 def _sp_ln(p: dict, v: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """Window-sharded LayerNorm between the pipelined recurrences — the
-    same :class:`~hfrep_tpu.ops.layers.KerasLayerNorm` module the
-    single-device generator runs, so the two paths cannot drift; jitted
-    once at module level (per-timestep math partitions with zero
-    communication under GSPMD)."""
+    """LayerNorm between the pipelined recurrences — the same
+    :class:`~hfrep_tpu.ops.layers.KerasLayerNorm` module the
+    single-device generator runs, so the two paths cannot drift.
+    Deliberately NOT jitted: it executes inside the fused pipeline's
+    `shard_map` body (a Manual-mesh context), where an inner jit's
+    sharding plumbing raises a mesh-consistency error under `lax.scan`
+    tracing; as plain traced ops it inlines and partitions per-timestep
+    with zero communication."""
     from hfrep_tpu.ops.layers import KerasLayerNorm
 
     return KerasLayerNorm(epsilon=eps).apply({"params": p}, v)
@@ -321,24 +398,21 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
 
-    The two recurrences pipeline via :func:`sp_lstm`; the flattened
-    (W·H → 1) head is a window-sharded contraction: each device dots its
-    local (B, Wl, H) chunk with its Wl·H slice of the Dense kernel and a
-    single `psum` over ``axis_name`` completes the reduction — the only
-    collective beyond the carry handoffs.  Differentiable end to end
+    Both recurrences run in ONE fused pipeline pass (:func:`sp_lstm2` —
+    layer 1's chunk consumes layer 0's chunk in the same superstep, both
+    carry pairs ppermute together); the flattened (W·H → 1) head is a
+    window-sharded contraction: each device dots its local (B, Wl, H)
+    chunk with its Wl·H slice of the Dense kernel and a single `psum`
+    over ``axis_name`` completes the reduction — the only collective
+    beyond the carry handoffs.  Differentiable end to end
     (ppermute/psum transposes), which is what sequence-parallel WGAN-GP
     *training* needs; exactness and gradient tests in
     tests/test_sequence.py.
     """
     axis_name = _resolve_axis(mesh, axis_name)
-    h1 = sp_lstm(d_params["KerasLSTM_0"]["kernel"],
-                 d_params["KerasLSTM_0"]["recurrent_kernel"],
-                 d_params["KerasLSTM_0"]["bias"], x, mesh,
-                 axis_name=axis_name, backend=backend)
-    h2 = sp_lstm(d_params["KerasLSTM_1"]["kernel"],
-                 d_params["KerasLSTM_1"]["recurrent_kernel"],
-                 d_params["KerasLSTM_1"]["bias"], h1, mesh,
-                 axis_name=axis_name, backend=backend)
+    # both recurrences in ONE fused pipeline pass (see sp_lstm2)
+    h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
+                  axis_name=axis_name, backend=backend)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     b, w, h = h2.shape
@@ -368,10 +442,12 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     window axis sharded over ``axis_name`` — long-window synthesis
     (W ≫ 168) on a mesh.
 
-    The two recurrences run the pipelined carry-handoff scan
-    (:func:`sp_lstm`); every other layer is per-timestep, so under GSPMD
-    with window-sharded operands it partitions with zero communication —
-    only the two LSTMs' (h, c) ppermutes touch ICI.  ``g_params`` is the
+    Both recurrences AND the inter-layer LayerNorm run in ONE fused
+    pipeline pass (:func:`sp_lstm2`): the LN executes chunk-wise inside
+    the shard_map body, with its params threaded through as a real
+    operand (see `_sp_ln`'s no-inner-jit note); only the head layers
+    after the second LSTM run outside under GSPMD.  The (h, c) ppermutes
+    of the two LSTMs are the only ICI traffic.  ``g_params`` is the
     LSTMGenerator tree (``KerasLSTM_0/1``, ``KerasLayerNorm_0/1``,
     ``KerasDense_0``); output matches the single-device
     ``generator.apply`` to f32 round-off (tests/test_sequence.py).
@@ -380,12 +456,11 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     sharding = NamedSharding(mesh, P(None, axis_name, None))
     z = jax.device_put(z, sharding)
 
-    kw = dict(axis_name=axis_name, activation=activation, backend=backend)
-    x = sp_lstm(g_params["KerasLSTM_0"]["kernel"],
-                g_params["KerasLSTM_0"]["recurrent_kernel"],
-                g_params["KerasLSTM_0"]["bias"], z, mesh, **kw)
-    x = _sp_ln(g_params["KerasLayerNorm_0"], x, ln_eps)
-    x = sp_lstm(g_params["KerasLSTM_1"]["kernel"],
-                g_params["KerasLSTM_1"]["recurrent_kernel"],
-                g_params["KerasLSTM_1"]["bias"], x, mesh, **kw)
+    # both recurrences + the inter-layer LayerNorm in ONE fused pipeline
+    # pass: LN is per-timestep, so applying it chunk-wise inside the
+    # pipeline computes exactly the full-sequence result (see sp_lstm2)
+    x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
+                 inter=(lambda p, v: _sp_ln(p, v, ln_eps),
+                        g_params["KerasLayerNorm_0"]),
+                 axis_name=axis_name, activation=activation, backend=backend)
     return _sp_head(g_params, x, slope, ln_eps)

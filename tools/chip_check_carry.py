@@ -135,6 +135,31 @@ def section_sp(mesh, sp_lstm):
     for n, a, r in zip(("kernel", "recurrent", "bias"), gg, rg):
         check(f"sp grad {n}", a, r, 1e-2)
 
+    # fused 2-layer pipeline (sp_lstm2 via sp_critic) with pallas chunks
+    from hfrep_tpu.config import ModelConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import sp_critic
+
+    pair = build_gan(ModelConfig(family="mtss_wgan_gp", hidden=h,
+                                 window=ww, features=f))
+    d_params = pair.discriminator.init(KEY, x)["params"]
+    check("sp2 critic fwd", sp_critic(d_params, x, mesh, backend="pallas"),
+          sp_critic(d_params, x, mesh), 1e-4)
+
+    def critic_loss(be, p):
+        return jnp.sum(sp_critic(p, x, mesh, backend=be) ** 2)
+
+    cg_ref = jax.grad(functools.partial(critic_loss, "xla"))(d_params)
+    cg_got = jax.grad(functools.partial(critic_loss, "pallas"))(d_params)
+    err = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        / (float(np.max(np.abs(np.asarray(b)))) or 1.0)
+        for a, b in zip(jax.tree_util.tree_leaves(cg_got),
+                        jax.tree_util.tree_leaves(cg_ref)))
+    status = "ok" if err <= 1e-2 else "FAIL"
+    print(f"  {'sp2 critic grads':24s} rel_err {err:.3e}  [{status}]")
+    assert err <= 1e-2
+
 
 def section_train(mesh):
     """Full sp TRAINING step (n_critic GP critic updates + generator
