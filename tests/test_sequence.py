@@ -108,21 +108,26 @@ def test_sp_critic_matches_single_device_with_grads():
 
 @needs_8
 @pytest.mark.slow
-def test_sp_train_step_matches_plain_step():
+@pytest.mark.parametrize("window", [16, 672])
+def test_sp_train_step_matches_plain_step(window):
     """Sequence-parallel WGAN-GP training (window sharded over 8 devices,
     GP second-order through the pipelined recurrences) must follow the
     plain single-device step's trajectory at the same key — long-window
-    *training*, exact."""
+    *training*, exact.  W=672 is the actual long-context case (4× the
+    production window, 84 timesteps per device — a shape the reference's
+    single-device serial LSTM never reaches): W ≫ 168 adds devices, not
+    error."""
     from hfrep_tpu.config import ModelConfig, TrainConfig
     from hfrep_tpu.models.registry import build_gan
     from hfrep_tpu.parallel.sequence import make_sp_train_step
     from hfrep_tpu.train.states import init_gan_state
     from hfrep_tpu.train.steps import make_train_step
 
-    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=window,
+                       hidden=8)
     tcfg = TrainConfig(batch_size=8, n_critic=2)
     dataset = jnp.asarray(np.random.default_rng(3).uniform(
-        0, 1, (32, 16, 5)).astype(np.float32))
+        0, 1, (32, window, 5)).astype(np.float32))
     pair = build_gan(mcfg)
     mesh = _mesh(8)
 
