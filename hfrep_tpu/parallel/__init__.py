@@ -5,6 +5,10 @@ from hfrep_tpu.parallel.mesh import (  # noqa: F401
     spans_processes,
 )
 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step  # noqa: F401
+from hfrep_tpu.parallel.dp_sp import (  # noqa: F401
+    make_dp_sp_multi_step,
+    make_dp_sp_train_step,
+)
 from hfrep_tpu.parallel.sequence import (  # noqa: F401
     make_sp_multi_step,
     make_sp_train_step,

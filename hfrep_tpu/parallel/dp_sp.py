@@ -1,0 +1,143 @@
+"""Composed data × sequence parallelism on one 2-D ``('dp', 'sp')`` mesh.
+
+Round-3 state of the framework had two disjoint scaling stories: batch
+sharding over a 1-D dp mesh (:mod:`hfrep_tpu.parallel.data_parallel`) and
+window sharding over a 1-D sp mesh (:mod:`hfrep_tpu.parallel.sequence`).
+A pod training a long-window MTSS-WGAN-GP wants BOTH — the window axis
+pipelined over ``sp`` to fit/parallelize the recurrence, and the batch
+sharded over ``dp`` so the remaining chips contribute throughput.  This
+module composes them in ONE ``shard_map`` region over the 2-D mesh:
+
+* **dp axis** — each dp row samples its own batch shard (i.i.d. folded
+  keys, or controlled global sampling for trajectory tests); gradients
+  are globally batch-mean normalized by the existing
+  :func:`hfrep_tpu.train.steps._psum_if` vma machinery (AD's automatic
+  psum over dp for standard paths, explicit pmean for varying
+  custom-vjp leaves).
+* **sp axis** — every generator/critic forward inside the step (and the
+  gradient penalty's second-order path) runs the pipelined
+  window-sharded recurrence in *manual* mode
+  (:func:`hfrep_tpu.parallel.sequence._sp_pipeline` with
+  ``manual=True``): each device slices its own window chunk, carries
+  hop via ``ppermute``, the critic head psums over ``sp``, and the
+  generator reassembles full windows by masked psum (typed
+  sp-*invariant* — an all_gather's sp-varying output would poison every
+  downstream loss type; see :func:`~hfrep_tpu.parallel.sequence.sp_generate`).
+* **params/optimizer state** — replicated over both axes;
+  ``check_vma=True`` proves replication is preserved at trace time.
+
+The reference anchor is the training loop being scaled,
+``GAN/MTSS_WGAN_GP.py:254-292`` — single-device, window ≤168.  Here
+dp×sp at the same global batch follows the plain step's trajectory to
+f32 round-off (``tests/test_dp_sp.py``, controlled sampling on a 2×4
+virtual mesh), so scaling out is a layout change, not a semantics
+change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hfrep_tpu.config import TrainConfig
+from hfrep_tpu.models.registry import GanPair
+from hfrep_tpu.train.states import GanState
+from hfrep_tpu.parallel.sequence import (sp_critic, sp_generate,
+                                         validate_sp_pair)
+
+
+def _split_axes(mesh: Mesh) -> Tuple[str, str]:
+    if tuple(mesh.axis_names) != ("dp", "sp"):
+        raise ValueError(
+            f"dp×sp composition wants a ('dp', 'sp') mesh, got {mesh.axis_names}")
+    return "dp", "sp"
+
+
+def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
+                mesh: Mesh, controlled_sampling: bool):
+    """The per-device epoch step: plain-step semantics with manual-mode
+    window-sharded apply fns, dp-axis gradient normalization."""
+    from hfrep_tpu.train.steps import make_train_step, resolve_lstm_backend
+
+    dp_axis, sp_axis = _split_axes(mesh)
+    validate_sp_pair(pair)
+    n_dp = mesh.shape[dp_axis]
+    n_sp = mesh.shape[sp_axis]
+    if tcfg.batch_size % n_dp:
+        raise ValueError(
+            f"global batch {tcfg.batch_size} not divisible by dp={n_dp}")
+    local_batch = tcfg.batch_size // n_dp
+    if local_batch % n_sp:
+        raise ValueError(
+            f"per-dp-row batch {local_batch} not divisible by sp={n_sp} "
+            "(the pipeline's default microbatch count)")
+    if dataset.shape[1] % n_sp:
+        raise ValueError(
+            f"window {dataset.shape[1]} not divisible by sp={n_sp}")
+    backend = resolve_lstm_backend(tcfg.lstm_backend)
+    slope = pair.generator.slope
+    g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=sp_axis,
+                                       activation="sigmoid", slope=slope,
+                                       backend=backend, manual=True)
+    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=sp_axis,
+                                     backend=backend, manual=True)
+    local_tcfg = dataclasses.replace(tcfg, batch_size=local_batch)
+    return make_train_step(
+        pair, local_tcfg, dataset, axis_name=dp_axis,
+        sample_batch=tcfg.batch_size if controlled_sampling else None,
+        apply_fns=(g_apply, d_apply))
+
+
+def _wrap(inner, mesh: Mesh, controlled_sampling: bool, jit: bool):
+    """shard_map the per-device step over the 2-D mesh: i.i.d. mode folds
+    the key by dp row, metrics are pmean'd over dp, and check_vma proves
+    state replication over both axes at trace time."""
+    dp_axis, _ = _split_axes(mesh)
+
+    def per_device(state: GanState, key: jax.Array):
+        if not controlled_sampling:
+            key = jax.random.fold_in(key, lax.axis_index(dp_axis))
+        state, metrics = inner(state, key)
+        return state, lax.pmean(metrics, dp_axis)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(), P()), out_specs=(P(), P()),
+                   check_vma=True)
+    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+
+
+def make_dp_sp_train_step(pair: GanPair, tcfg: TrainConfig,
+                          dataset: jnp.ndarray, mesh: Mesh, *,
+                          controlled_sampling: bool = False,
+                          jit: bool = True):
+    """One dp×sp epoch: ``fn(state, key) -> (state, metrics)`` with state
+    replicated over the 2-D mesh and metrics pmean'd over ``dp``.
+
+    ``controlled_sampling=True`` draws the global batch identically on
+    every device and shards by dp position — the run then consumes the
+    exact sample stream of a single-device run at the same global batch
+    (the dp trajectory-test pattern, composed with window sharding).
+    """
+    inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
+    return _wrap(inner, mesh, controlled_sampling, jit)
+
+
+def make_dp_sp_multi_step(pair: GanPair, tcfg: TrainConfig,
+                          dataset: jnp.ndarray, mesh: Mesh, *,
+                          controlled_sampling: bool = False,
+                          jit: bool = True):
+    """``tcfg.steps_per_call`` dp×sp epochs scanned into ONE compiled
+    program — the launch shape for real pod training (same per-dispatch
+    amortization argument as :func:`make_sp_multi_step`; the trainer
+    dispatches this from its ordinary block loop)."""
+    from hfrep_tpu.train.steps import make_multi_step
+
+    step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
+    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
+    return _wrap(inner, mesh, controlled_sampling, jit)

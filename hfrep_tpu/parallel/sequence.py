@@ -41,7 +41,6 @@ tools/chip_check_carry.py).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -53,6 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hfrep_tpu.ops.layers import ACTIVATIONS
 from hfrep_tpu.ops.lstm import lstm_cell_step
+from hfrep_tpu.utils.vma import match_vma
 
 
 def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarray],
@@ -86,7 +86,8 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                  activation: str = "tanh",
                  recurrent_activation: str = "sigmoid",
                  backend: str = "xla",
-                 inters=None) -> jnp.ndarray:
+                 inters=None,
+                 manual: bool = False) -> jnp.ndarray:
     """N stacked LSTMs through ONE window-sharded pipeline pass.
 
     ``layers`` is a list of KerasLSTM param dicts ({kernel,
@@ -104,6 +105,17 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
     (h, c) carries forward together — one pipeline fill/drain and one
     shard_map region for the whole stack, where per-layer passes pay
     both per layer.
+
+    ``manual=True`` runs the pipeline *inside an enclosing*
+    ``shard_map`` region (the dp×sp composed step,
+    :mod:`hfrep_tpu.parallel.dp_sp`): ``x`` is then this device's
+    full-window batch shard (replicated over the sp axis), the body
+    slices its own window chunk by ``lax.axis_index(axis_name)``, and
+    the return value is the LOCAL (B, W/D, H) chunk — the caller owns
+    reassembly (masked psum for the generator, sliced-head psum for the
+    critic; never all_gather — see :func:`sp_generate`).  The vma casts adapt automatically: loop carries are
+    matched against the pre-projected chunk's actual variance
+    (``match_vma``), which is {sp} standalone and {dp, sp} composed.
     """
     axis_name = _resolve_axis(mesh, axis_name)
     n_dev = mesh.shape[axis_name]
@@ -159,14 +171,13 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp0)
         xz_mb = xz.reshape(wl, m, bm, g0)               # microbatch split
 
-        # pcast to varying: mark the device-varying loop state as such for
-        # the shard_map VMA type system (loop outputs vary over 'sp').
-        def _varying(a):
-            return lax.pcast(a, axis_name, to="varying")
-
+        # Cast the loop state to the variance the loop body will produce:
+        # the pre-projected chunk carries the true vma ({sp} standalone,
+        # {dp, sp} under the composed dp×sp step), so matching against it
+        # keeps the scan's carry-in/carry-out types equal in both modes.
         carry_reg = tuple(
-            (_varying(jnp.zeros((bm, hpi), xz.dtype)),
-             _varying(jnp.zeros((bm, hpi), xz.dtype))) for hpi in hp)
+            (match_vma(jnp.zeros((bm, hpi), xz.dtype), xz),
+             match_vma(jnp.zeros((bm, hpi), xz.dtype), xz)) for hpi in hp)
 
         # Kernel mode: the pallas custom_vjp emits *varying* cotangents
         # (hand-computed per-device, never auto-psum'd), so a replicated
@@ -175,7 +186,7 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         # check_vma.  Casting rec to varying keeps the whole cotangent
         # chain varying; the pcast's own transpose then psums it back to
         # the replicated param exactly once at the boundary.
-        recs = [(_varying(l["recurrent_kernel"]) if use_kernel
+        recs = [(match_vma(l["recurrent_kernel"], xz) if use_kernel
                  else l["recurrent_kernel"]) for l in lay]
 
         def run_chunk(i, xz_s, h0, c0):
@@ -241,6 +252,13 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         out = jnp.swapaxes(out, 0, 1).reshape(wl, b, hp[-1])
         return jnp.swapaxes(out, 0, 1)[..., :h_dims[-1]]
 
+    if manual:
+        # Already inside a shard_map region: slice this device's window
+        # chunk and run the body directly; the caller reassembles.
+        wl = w // n_dev
+        k_sp = lax.axis_index(axis_name)
+        x_loc = lax.dynamic_slice_in_dim(x, k_sp * wl, wl, axis=1)
+        return per_device(lay, inter_params, x_loc)
     mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), P(), P(None, axis_name, None)),
@@ -279,17 +297,32 @@ def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
              microbatches: Optional[int] = None,
              activation: str = "tanh",
              recurrent_activation: str = "sigmoid",
-             backend: str = "xla") -> jnp.ndarray:
+             backend: str = "xla",
+             manual: bool = False) -> jnp.ndarray:
     """Two stacked LSTMs fused into ONE pipeline pass (optionally with a
     per-timestep ``inter = (fn, params)`` transform between them, applied
     as ``fn(params, y)``) — the sp analogue of the single-device fused
     stack kernels (`ops/pallas_lstm_stack.py`): one fill/drain and one
-    shard_map region instead of two of each."""
+    shard_map region instead of two of each.  ``manual=True`` runs
+    inside an enclosing shard_map and returns the local window chunk
+    (see :func:`_sp_pipeline`)."""
     return _sp_pipeline([p0, p1], x, mesh, inters=[inter, None],
                         axis_name=axis_name, microbatches=microbatches,
                         activation=activation,
                         recurrent_activation=recurrent_activation,
-                        backend=backend)
+                        backend=backend, manual=manual)
+
+
+def validate_sp_pair(pair) -> None:
+    """The sp modules mirror the flagship LSTMGenerator/LSTMFlatCritic
+    param trees and run f32 — shared precondition of the standalone sp
+    step and the composed dp×sp step (:mod:`hfrep_tpu.parallel.dp_sp`)."""
+    if pair.family != "mtss_wgan_gp":
+        raise ValueError(f"sequence-parallel step supports the "
+                         f"mtss_wgan_gp family, got {pair.family!r}")
+    if (pair.generator.dtype or jnp.float32) != jnp.float32:
+        raise NotImplementedError(
+            "sequence-parallel step runs f32; configure dtype=float32")
 
 
 def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -313,12 +346,7 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     from hfrep_tpu.train.steps import make_train_step
 
     axis_name = _resolve_axis(mesh, axis_name)
-    if pair.family != "mtss_wgan_gp":
-        raise ValueError(f"sequence-parallel step supports the "
-                         f"mtss_wgan_gp family, got {pair.family!r}")
-    if (pair.generator.dtype or jnp.float32) != jnp.float32:
-        raise NotImplementedError(
-            "sequence-parallel step runs f32; configure dtype=float32")
+    validate_sp_pair(pair)
     slope = pair.generator.slope
 
     # Same resolution/validation as the plain step: 'auto' → pallas on a
@@ -378,10 +406,12 @@ def _sp_ln(p: dict, v: jnp.ndarray, eps: float) -> jnp.ndarray:
     return KerasLayerNorm(epsilon=eps).apply({"params": p}, v)
 
 
-@functools.partial(jax.jit, static_argnames=("slope", "eps"))
-def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.ndarray:
-    """LeakyReLU → LN → Dense tail of the generator, on sharded operands,
-    built from the same primitives as the single-device model."""
+def _sp_head_impl(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.ndarray:
+    """LeakyReLU → LN → Dense tail of the generator — every op is
+    per-timestep, so it applies identically to a full sequence (GSPMD
+    path) or to one device's window chunk (manual dp×sp path, where an
+    inner jit would trip the manual-mesh consistency check — see
+    `_sp_ln`)."""
     from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
 
     v = leaky_relu(v, slope)
@@ -391,9 +421,13 @@ def _sp_head(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.nd
     return KerasDense(features).apply({"params": g_params["KerasDense_0"]}, v)
 
 
+_sp_head = jax.jit(_sp_head_impl, static_argnames=("slope", "eps"))
+
+
 def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
               axis_name: Optional[str] = None,
-              backend: str = "xla") -> jnp.ndarray:
+              backend: str = "xla",
+              manual: bool = False) -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
@@ -408,14 +442,20 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     (ppermute/psum transposes), which is what sequence-parallel WGAN-GP
     *training* needs; exactness and gradient tests in
     tests/test_sequence.py.
+
+    ``manual=True`` (the dp×sp composed step): ``x`` is the device's
+    full-window batch shard inside an enclosing shard_map; the pipeline
+    returns the local chunk and the head dots it with this device's
+    W/D-slice of the flatten-Dense kernel before the same psum.
     """
     axis_name = _resolve_axis(mesh, axis_name)
     # both recurrences in ONE fused pipeline pass (see sp_lstm2)
     h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
-                  axis_name=axis_name, backend=backend)
+                  axis_name=axis_name, backend=backend, manual=manual)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
-    b, w, h = h2.shape
+    w = x.shape[1]
+    h = h2.shape[-1]
     kernel_w = dense["kernel"].reshape(w, h, -1)     # (W, H, 1): shardable by W
 
     def local_head(h_local, k_local):
@@ -423,10 +463,16 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
         part = h_local.reshape(bb, wl * hh) @ k_local.reshape(wl * hh, -1)
         return lax.psum(part, axis_name)
 
-    scores = shard_map(
-        local_head, mesh=mesh,
-        in_specs=(P(None, axis_name, None), P(axis_name, None, None)),
-        out_specs=P())(h2, kernel_w)
+    if manual:
+        wl = w // mesh.shape[axis_name]
+        k_local = lax.dynamic_slice_in_dim(
+            kernel_w, lax.axis_index(axis_name) * wl, wl, axis=0)
+        scores = local_head(h2, k_local)
+    else:
+        scores = shard_map(
+            local_head, mesh=mesh,
+            in_specs=(P(None, axis_name, None), P(axis_name, None, None)),
+            out_specs=P())(h2, kernel_w)
     if "bias" in dense:
         scores = scores + dense["bias"]
     return scores
@@ -436,7 +482,8 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 axis_name: Optional[str] = None, slope: float = 0.2,
                 activation: str = "sigmoid",
                 ln_eps: float = 1e-3,
-                backend: str = "xla") -> jnp.ndarray:
+                backend: str = "xla",
+                manual: bool = False) -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
     Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
     window axis sharded over ``axis_name`` — long-window synthesis
@@ -451,8 +498,36 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     LSTMGenerator tree (``KerasLSTM_0/1``, ``KerasLayerNorm_0/1``,
     ``KerasDense_0``); output matches the single-device
     ``generator.apply`` to f32 round-off (tests/test_sequence.py).
+
+    ``manual=True`` (the dp×sp composed step, inside an enclosing
+    shard_map): the head runs un-jitted on the local chunk (its ops are
+    all per-timestep), then the full (B, W, F) windows are reassembled
+    by a masked ``psum`` — each device scatters its chunk into a zeros
+    buffer at its offset and the sum concatenates the disjoint chunks.
+    Deliberately NOT ``all_gather``: the vma type system types a
+    gather's output *varying* over ``axis_name`` even though the values
+    agree, which would (a) leak spurious sp-variance into every
+    downstream loss/carry type and (b) hide from AD that the critic's
+    later chunk-slice needs its transpose-psum — the masked psum's
+    output is typed *invariant*, making both exact automatically (the
+    gradient-penalty note in :func:`hfrep_tpu.train.steps.gradient_penalty`).
+    Costs ~2× a gather's ICI bytes on a (B, W, F) buffer — noise next to
+    the pipeline's compute.
     """
     axis_name = _resolve_axis(mesh, axis_name)
+    if manual:
+        x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
+                     inter=(lambda p, v: _sp_ln(p, v, ln_eps),
+                            g_params["KerasLayerNorm_0"]),
+                     axis_name=axis_name, activation=activation,
+                     backend=backend, manual=True)
+        y = _sp_head_impl(g_params, x, slope, ln_eps)   # chunk-wise head
+        wl = y.shape[1]
+        buf = jnp.zeros((y.shape[0], wl * mesh.shape[axis_name], y.shape[2]),
+                        y.dtype)
+        buf = lax.dynamic_update_slice_in_dim(
+            match_vma(buf, y), y, lax.axis_index(axis_name) * wl, axis=1)
+        return lax.psum(buf, axis_name)
     sharding = NamedSharding(mesh, P(None, axis_name, None))
     z = jax.device_put(z, sharding)
 

@@ -123,6 +123,15 @@ def gradient_penalty(d_apply: Callable, d_params, interp: jnp.ndarray) -> jnp.nd
     Exact-gradient port of ``gradient_penalty_loss``
     (``GAN/MTSS_WGAN_GP.py:201-216``): per-sample L2 norm over all
     non-batch axes of the critic's input gradient at x̂.
+
+    Works unchanged inside the manual dp×sp region
+    (:mod:`hfrep_tpu.parallel.dp_sp`): there ``d_apply`` slices its own
+    window chunk from the sp-invariant interpolates, and the transpose
+    of that implicit invariant→varying cast is a psum over ``sp`` — so
+    this `jax.grad` already returns the FULL-window input gradient on
+    every device, provided the inputs are honestly typed sp-invariant
+    (why the manual generator reassembles windows via masked psum, not
+    all_gather: see :func:`hfrep_tpu.parallel.sequence.sp_generate`).
     """
     grads = jax.grad(lambda x: jnp.sum(d_apply(d_params, x)))(interp)
     norms = jnp.sqrt(jnp.sum(grads**2, axis=tuple(range(1, grads.ndim))) + 1e-12)
