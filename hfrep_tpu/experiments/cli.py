@@ -40,6 +40,16 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--n-samples", type=int, default=10)
     t.add_argument("--eval", action="store_true", help="run the 12-metric suite after training")
     t.add_argument("--mesh", action="store_true", help="data-parallel over all devices")
+    t.add_argument("--sp-mesh", action="store_true",
+                   help="sequence-parallel: the window axis sharded over "
+                        "all devices (pipelined carry handoff, "
+                        "parallel/sequence.py) — the long-window training "
+                        "path, with the trainer's full checkpoint/resume/"
+                        "nan-guard/logging (flagship mtss_wgan_gp only)")
+    t.add_argument("--dp-sp", default=None, metavar="DPxSP",
+                   help="composed 2-D mesh, e.g. 2x4: batch sharded over "
+                        "dp AND window sharded over sp in one step "
+                        "(parallel/dp_sp.py)")
     t.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port — every "
                         "process runs this same command with its own "
@@ -128,12 +138,33 @@ def cmd_clean(args) -> int:
 
 
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
-                  mesh=False, quiet=False, nan_guard=False, max_recoveries=3):
+                  mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
+                  sp_mesh=False, dp_sp=None):
+    if sum(map(bool, (mesh, sp_mesh, dp_sp))) > 1:
+        raise SystemExit("--mesh, --sp-mesh and --dp-sp are mutually exclusive")
     import jax
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
     from hfrep_tpu.train.trainer import GanTrainer
     from hfrep_tpu.utils.logging import MetricLogger
+
+    # Mesh construction first: a typo'd --dp-sp or too-few-devices error
+    # must not pay the full panel load + window build before surfacing.
+    device_mesh = None
+    if mesh:
+        from hfrep_tpu.parallel import make_mesh
+        device_mesh = make_mesh()
+    elif sp_mesh:
+        from hfrep_tpu.config import MeshConfig
+        from hfrep_tpu.parallel import make_mesh
+        device_mesh = make_mesh(MeshConfig(axis_name="sp"))
+    elif dp_sp:
+        from hfrep_tpu.parallel.mesh import make_mesh_2d
+        try:
+            n_dp, n_sp = (int(v) for v in dp_sp.lower().split("x"))
+        except ValueError:
+            raise SystemExit(f"--dp-sp wants DPxSP (e.g. 2x4), got {dp_sp!r}")
+        device_mesh = make_mesh_2d(n_dp, n_sp)
 
     cfg = get_preset(preset)
     if checkpoint_dir:
@@ -141,10 +172,6 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
             cfg, train=dataclasses.replace(cfg.train, checkpoint_dir=checkpoint_dir))
     panel = load_panel(cleaned_dir)
     ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
-    device_mesh = None
-    if mesh:
-        from hfrep_tpu.parallel import make_mesh
-        device_mesh = make_mesh()
     style = {"gan": "gan", "mtss_gan": "gan", "wgan": "wgan", "mtss_wgan": "wgan"}.get(
         cfg.model.family, "wgan_gp")
     logger = MetricLogger(echo=not quiet, echo_style=style)
@@ -162,11 +189,13 @@ def cmd_train_gan(args) -> int:
         from hfrep_tpu.parallel.mesh import initialize_distributed
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
-        args.mesh = True
+        if not (args.sp_mesh or args.dp_sp):
+            args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,
         args.quiet, nan_guard=args.nan_guard,
-        max_recoveries=args.max_recoveries)
+        max_recoveries=args.max_recoveries,
+        sp_mesh=args.sp_mesh, dp_sp=args.dp_sp)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest

@@ -40,6 +40,32 @@ from hfrep_tpu.train.states import GanState
 from hfrep_tpu.train.steps import make_multi_step
 
 
+def wrap_batch_parallel(inner, mesh: Mesh, batch_axis: str,
+                        controlled_sampling: bool, jit: bool = True):
+    """shard_map a replicated-state step over ``mesh``, batch-parallel
+    along ``batch_axis``: i.i.d. mode folds the key by axis position so
+    each row samples independently (controlled mode leaves the shared
+    key — the inner step shards by axis index instead), metrics are
+    pmean'd over the axis, and ``check_vma=True`` proves parameters and
+    optimizer state stay replicated.  The single home of the dp sampling
+    contract — used by both the 1-D dp trainer here and the composed
+    dp×sp step (:mod:`hfrep_tpu.parallel.dp_sp`)."""
+
+    def per_device(state: GanState, key: jax.Array) -> Tuple[GanState, dict]:
+        if not controlled_sampling:
+            key = jax.random.fold_in(key, lax.axis_index(batch_axis))
+        state, metrics = inner(state, key)
+        return state, lax.pmean(metrics, batch_axis)
+
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=True,
+    )
+    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+
+
 def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                        mesh: Mesh, controlled_sampling: bool = False):
     """Build the jitted data-parallel multi-epoch step.
@@ -70,17 +96,4 @@ def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     inner = make_multi_step(
         pair, local_tcfg, dataset, axis_name=axis_name, jit=False,
         sample_batch=tcfg.batch_size if controlled_sampling else None)
-
-    def per_device(state: GanState, key: jax.Array) -> Tuple[GanState, dict]:
-        if not controlled_sampling:
-            key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        state, metrics = inner(state, key)
-        return state, lax.pmean(metrics, axis_name)
-
-    fn = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P()),
-        check_vma=True,
-    )
-    return jax.jit(fn, donate_argnums=(0,))
+    return wrap_batch_parallel(inner, mesh, axis_name, controlled_sampling)

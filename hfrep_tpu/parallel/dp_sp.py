@@ -39,15 +39,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.train.states import GanState
 from hfrep_tpu.parallel.sequence import (sp_critic, sp_generate,
                                          validate_sp_pair)
 
@@ -95,21 +91,13 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
 
 
 def _wrap(inner, mesh: Mesh, controlled_sampling: bool, jit: bool):
-    """shard_map the per-device step over the 2-D mesh: i.i.d. mode folds
-    the key by dp row, metrics are pmean'd over dp, and check_vma proves
-    state replication over both axes at trace time."""
+    """The shared batch-parallel shard_map wrapper along the dp axis —
+    on the 2-D mesh, check_vma additionally proves state replication
+    over sp."""
+    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
+
     dp_axis, _ = _split_axes(mesh)
-
-    def per_device(state: GanState, key: jax.Array):
-        if not controlled_sampling:
-            key = jax.random.fold_in(key, lax.axis_index(dp_axis))
-        state, metrics = inner(state, key)
-        return state, lax.pmean(metrics, dp_axis)
-
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(P(), P()), out_specs=(P(), P()),
-                   check_vma=True)
-    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+    return wrap_batch_parallel(inner, mesh, dp_axis, controlled_sampling, jit)
 
 
 def make_dp_sp_train_step(pair: GanPair, tcfg: TrainConfig,

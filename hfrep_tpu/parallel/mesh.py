@@ -31,6 +31,22 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
 
 
+def make_mesh_2d(dp: int, sp: int,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The composed ``('dp', 'sp')`` mesh for dp×sp training
+    (:mod:`hfrep_tpu.parallel.dp_sp`): ``dp·sp`` devices as a dp×sp grid.
+    On a real pod, lay dp outermost so the sp carry ppermutes ride
+    neighbouring ICI links (the default device order already does for
+    tori)."""
+    if dp < 1 or sp < 1:
+        raise ValueError(f"dp×sp mesh dims must be >= 1, got {dp}×{sp}")
+    devices = list(devices) if devices is not None else jax.devices()
+    if dp * sp > len(devices):
+        raise ValueError(
+            f"requested dp×sp={dp}×{sp} but only {len(devices)} devices present")
+    return Mesh(np.asarray(devices[:dp * sp]).reshape(dp, sp), ("dp", "sp"))
+
+
 def initialize_distributed(coordinator: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:

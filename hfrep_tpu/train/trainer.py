@@ -39,10 +39,28 @@ class GanTrainer:
         self.key, init_key = jax.random.split(key)
         self.state = init_gan_state(init_key, cfg.model, cfg.train, self.pair)
         if mesh is not None:
-            # local import: parallel depends on train.states, avoid a cycle
-            from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+            # The mesh's axis names declare the partitioning (local
+            # imports: parallel depends on train.states, avoid a cycle):
+            #   ('dp',)       batch sharding       (data_parallel.py)
+            #   ('sp',)       window sharding      (sequence.py) — the
+            #                 long-window path, now with the trainer's
+            #                 full checkpoint/resume/nan-guard/logging
+            #   ('dp', 'sp')  both, one 2-D mesh   (dp_sp.py)
             from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
-            self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            names = tuple(mesh.axis_names)
+            if names == ("dp",):
+                from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+                self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            elif names == ("sp",):
+                from hfrep_tpu.parallel.sequence import make_sp_multi_step
+                self._multi = make_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            elif names == ("dp", "sp"):
+                from hfrep_tpu.parallel.dp_sp import make_dp_sp_multi_step
+                self._multi = make_dp_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            else:
+                raise ValueError(
+                    f"mesh axis names {names} not recognized; use ('dp',), "
+                    "('sp',), or ('dp', 'sp')")
             if spans_processes(mesh):
                 # multi-host: promote the (identically-seeded) state and
                 # key to replicated global arrays for the pod-wide jit
@@ -203,9 +221,25 @@ class GanTrainer:
         return metrics
 
     def _one(self, state, key):
+        """Cached 1-epoch step for schedule remainders, matching the mesh
+        partitioning (a window-sharded run must not fall back to a
+        full-window single-device step — on a real pod that shape may not
+        even fit one device).  The 1-D dp remainder keeps the plain step:
+        state is replicated and the computation is identical at global
+        batch."""
         if self._single_step is None:
-            from hfrep_tpu.train.steps import make_train_step
-            self._single_step = jax.jit(make_train_step(self.pair, self.cfg.train, self.windows))
+            names = tuple(self.mesh.axis_names) if self.mesh is not None else ()
+            if names == ("sp",):
+                from hfrep_tpu.parallel.sequence import make_sp_train_step
+                self._single_step = make_sp_train_step(
+                    self.pair, self.cfg.train, self.windows, self.mesh)
+            elif names == ("dp", "sp"):
+                from hfrep_tpu.parallel.dp_sp import make_dp_sp_train_step
+                self._single_step = make_dp_sp_train_step(
+                    self.pair, self.cfg.train, self.windows, self.mesh)
+            else:
+                from hfrep_tpu.train.steps import make_train_step
+                self._single_step = jax.jit(make_train_step(self.pair, self.cfg.train, self.windows))
         return self._single_step(state, key)
 
     def _log_block(self, metrics: dict, n: int, base_epoch: int) -> None:
