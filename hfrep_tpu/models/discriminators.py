@@ -91,7 +91,12 @@ def _plain_stack(parent_dtype, hidden, x, backend):
 
     l1 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_0")
     l2 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_1")
-    if kernel_eligible(backend, parent_dtype or x.dtype):
+    # layers=2: the FUSED stack's adjoint holds both layers' matrices
+    # resident, so its VMEM ceiling is lower than two single-layer
+    # kernels' — an ineligible width falls through to the chained
+    # KerasLSTMs below, which re-check eligibility per layer.
+    if kernel_eligible(backend, parent_dtype or x.dtype, hidden=hidden,
+                       layers=2):
         from hfrep_tpu.ops.pallas_lstm_stack import pallas_keras_lstm_stack
         # The fused kernel takes one activation for both layers; feed the
         # layers' own setting so the fused and layer-by-layer branches can
@@ -99,7 +104,8 @@ def _plain_stack(parent_dtype, hidden, x, backend):
         assert l1.activation == l2.activation, (l1.activation, l2.activation)
         return pallas_keras_lstm_stack(l1(materialize=x.shape[-1]),
                                        l2(materialize=hidden),
-                                       x, activation=l1.activation)
+                                       x, activation=l1.activation,
+                                       dtype=parent_dtype or x.dtype)
     return l2(l1(x, backend=backend), backend=backend)
 
 

@@ -102,10 +102,12 @@ class KerasLSTM(nn.Module):
         b, w, _ = x.shape
 
         from hfrep_tpu.ops.pallas_lstm import kernel_eligible, pallas_keras_lstm
-        if kernel_eligible(backend or self.backend, self.dtype or x.dtype):
+        if kernel_eligible(backend or self.backend, self.dtype or x.dtype,
+                           hidden=h):
             return pallas_keras_lstm(kernel, recurrent, bias, x,
                                      self.activation or "linear",
-                                     self.recurrent_activation)
+                                     self.recurrent_activation,
+                                     dtype=self.dtype or x.dtype)
 
         act = ACTIVATIONS[self.activation]
         rec_act = ACTIVATIONS[self.recurrent_activation]

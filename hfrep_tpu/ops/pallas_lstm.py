@@ -88,15 +88,50 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
-def kernel_eligible(backend, eff_dtype) -> bool:
-    """Single source of truth for pallas-kernel dispatch: explicit pallas
-    backend and f32 compute.  The *forward* kernel also accepts bf16
-    operand streams (f32 scratch/accumulation), but training dispatch
-    stays f32 by measured choice: at H=100/B=32 the recurrence is
-    latency-bound, not matmul-throughput-bound, and end-to-end bf16 gains
-    nothing (RESULTS.md "bf16: measured decision").  Other dtypes take
-    the scan path so configured precision is honored."""
-    return backend == "pallas" and eff_dtype == jnp.float32
+VMEM_BUDGET_BYTES = 16 * 2**20
+"""Mosaic's scoped-vmem bound as measured on the v5e (RESULTS.md: the
+H=512 f32 adjoint OOMs wanting ~20 MB against a 16 MB limit)."""
+
+
+def adjoint_vmem_bytes(hidden: int, eff_dtype, layers: int = 1) -> int:
+    """VMEM residency of the heaviest kernel on the dispatch path — the
+    adjoint — counting its resident (Hp, 4Hp) gate matrices.  Per
+    single-layer module: rec, recᵀ, v, vᵀ, urec (5); the fused stack
+    holds both layers' primal matrices + their v-streams + 3 gradient
+    accumulators (15).  Primal matrices ride at the operand dtype (bf16
+    halves them); cotangent/accumulator matrices are always f32.
+    Per-timestep blocks (≤ ~100 KB at B=32) ride inside the budget
+    margin.  Calibrated against measurement: f32 Hp=512 single → 20 MB
+    (the observed OOM), f32 Hp=256 stack → 15.7 MB (observed to fit)."""
+    hp = ((hidden + LANE - 1) // LANE) * LANE
+    mat = 4 * hp * hp                        # elements per (Hp, 4Hp) matrix
+    item = jnp.dtype(eff_dtype).itemsize
+    if layers == 1:
+        return mat * (2 * item + 3 * 4)      # rec, recT @ operand; v, vT, urec @ f32
+    return mat * (6 * item + 9 * 4)          # 6 primal mats; 6 v-streams + 3 accums
+
+
+def kernel_eligible(backend, eff_dtype, hidden: int = None,
+                    layers: int = 1) -> bool:
+    """Single source of truth for pallas-kernel dispatch.
+
+    Three gates, each measured rather than assumed (RESULTS.md):
+
+    * explicit ``pallas`` backend;
+    * operand dtype f32 or bf16 — the kernels stream either (f32
+      scratch/gate math/accumulation in both cases); other dtypes take
+      the scan path so configured precision is honored;
+    * the adjoint's VMEM residency fits the measured scoped-vmem bound
+      (round-3 finding: the default ``auto`` dispatch OOM'd at H=512
+      f32 instead of falling back — shape-blind eligibility was the
+      bug).  ``hidden=None`` (legacy callers) keeps the flagship-size
+      behavior: eligible, since H≤256 fits in every configuration.
+    """
+    if backend != "pallas" or eff_dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if hidden is None:
+        return True
+    return adjoint_vmem_bytes(hidden, eff_dtype, layers) <= VMEM_BUDGET_BYTES
 
 
 def pad_keras_params(params: dict, h: int, hp: int) -> tuple:
@@ -240,8 +275,13 @@ def _bwd_kernel(act_name, with_dcs, with_carries, with_carry0, xz_ref, rec_ref,
     c_prev = c_prev_ref[0]
 
     # Recompute this step's gates from the residuals (cheaper than
-    # saving (W, B, 4Hp) pre-activations from the forward).
-    z = xz_ref[0] + jnp.dot(h_prev, rec_ref[:], preferred_element_type=jnp.float32)
+    # saving (W, B, 4Hp) pre-activations from the forward).  xz/rec may
+    # arrive bf16 (halved VMEM residency and HBM streams); the f32
+    # residual is cast to the operand dtype at each dot so the MXU runs
+    # at operand rate, with f32 accumulation — gate math stays f32.
+    z = (xz_ref[0].astype(jnp.float32)
+         + jnp.dot(h_prev.astype(rec_ref.dtype), rec_ref[:],
+                   preferred_element_type=jnp.float32))
     hp = z.shape[-1] // 4
     zi, zf, zc, zo = (z[:, :hp], z[:, hp:2 * hp], z[:, 2 * hp:3 * hp], z[:, 3 * hp:])
     i = jax.nn.sigmoid(zi)
@@ -266,7 +306,8 @@ def _bwd_kernel(act_name, with_dcs, with_carries, with_carry0, xz_ref, rec_ref,
     if with_carries:
         dhT_ref[0] = dh
         dcT_ref[0] = dc
-    dh_scr[:] = jnp.dot(dz, rec_t_ref[:], preferred_element_type=jnp.float32)
+    dh_scr[:] = jnp.dot(dz.astype(rec_t_ref.dtype), rec_t_ref[:],
+                        preferred_element_type=jnp.float32)
     dc_scr[:] = dc * f
     if with_carry0:
         # Constant-index outputs: the reverse grid's LAST iteration is
@@ -399,7 +440,11 @@ def _adj_kernel(act_name, with_carry0, xz_ref, rec_ref, rec_t_ref, v_ref,
     v_mat = v_ref[:]
 
     # ---- recompute the primal backward step-s intermediates
-    z = xz_ref[0] + jnp.dot(hp_s, rec, preferred_element_type=jnp.float32)
+    # (bf16 operand support mirrors _bwd_kernel: f32 values cast to the
+    # matrix dtype at each dot, f32 accumulation)
+    z = (xz_ref[0].astype(jnp.float32)
+         + jnp.dot(hp_s.astype(rec.dtype), rec,
+                   preferred_element_type=jnp.float32))
     hp_dim = z.shape[-1] // 4
     zi, zf, zc, zo = (z[:, :hp_dim], z[:, hp_dim:2 * hp_dim],
                       z[:, 2 * hp_dim:3 * hp_dim], z[:, 3 * hp_dim:])
@@ -417,12 +462,15 @@ def _adj_kernel(act_name, with_carry0, xz_ref, rec_ref, rec_t_ref, v_ref,
     dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
 
     # ---- adjoint
-    dzbar = (u_ref[0]
-             + jnp.dot(muh, rec, preferred_element_type=jnp.float32)
-             + jnp.dot(hp_s, v_mat, preferred_element_type=jnp.float32))
+    dzbar = (u_ref[0].astype(jnp.float32)
+             + jnp.dot(muh.astype(rec.dtype), rec,
+                       preferred_element_type=jnp.float32)
+             + jnp.dot(hp_s.astype(v_mat.dtype), v_mat,
+                       preferred_element_type=jnp.float32))
     dcTbar = muc * f
     fbar = muc * dcT
-    hpbar = jnp.dot(dz, v_t_ref[:], preferred_element_type=jnp.float32)
+    hpbar = jnp.dot(dz.astype(v_t_ref.dtype), v_t_ref[:],
+                    preferred_element_type=jnp.float32)
     urec = lax.dot_general(muh, dz, (((0,), (0,)), ((), ())),
                            preferred_element_type=jnp.float32)
     dzbi, dzbf, dzbc, dzbo = (dzbar[:, :hp_dim], dzbar[:, hp_dim:2 * hp_dim],
@@ -448,7 +496,7 @@ def _adj_kernel(act_name, with_carry0, xz_ref, rec_ref, rec_t_ref, v_ref,
 
     uxz_ref[0] = zbar
     udhs_ref[0] = dhTbar
-    uhp_ref[0] = hpbar + jnp.dot(zbar, rec_t_ref[:],
+    uhp_ref[0] = hpbar + jnp.dot(zbar.astype(rec_t_ref.dtype), rec_t_ref[:],
                                  preferred_element_type=jnp.float32)
     ucp_ref[0] = cpbar
     uc_ref[0] = aCbar * p(a_c)
@@ -552,9 +600,12 @@ def _lstm_bwd_scan(xz, rec, hs, cs, dhs, dcs, activation, carry=None,
         drec = drec + lax.dot_general(hp_s, dz, (((0,), (0,)), ((), ())))
         return (dz @ rec.T, dc * f, drec), dz
 
-    init = (jnp.zeros((b, hp), xz.dtype),
-            jnp.zeros((b, hp), xz.dtype) if dc_fin is None else dc_fin,
-            jnp.zeros((hp, g), xz.dtype))
+    # f32 carries regardless of operand dtype — mirrors the kernel's f32
+    # scratch/accumulation so the twin stays a valid oracle for bf16
+    # operand streams too
+    init = (jnp.zeros((b, hp), jnp.float32),
+            jnp.zeros((b, hp), jnp.float32) if dc_fin is None else dc_fin,
+            jnp.zeros((hp, g), jnp.float32))
     (dh0, dc0, drec), dz_rev = lax.scan(
         step, init,
         (xz[::-1], h_prev[::-1], c_prev[::-1], cs[::-1], dhs[::-1], dcs[::-1]))
@@ -579,10 +630,20 @@ def _lstm_bwd_seq_fwd(xz, rec, hs, cs, dhs, activation):
     return (dxz, drec), (xz, rec, hs, cs, dhs, dhT_seq, dcT_seq)
 
 
+def _cast_like(cots, primals):
+    """Kernel cotangents (always f32) → the primal operands' dtypes, as
+    `custom_vjp` requires.  The cast is the entire bf16 boundary: kernels
+    compute and emit f32; bf16 exists only in the operand streams."""
+    return tuple(c.astype(p.dtype) if c.dtype != p.dtype else c
+                 for c, p in zip(cots, primals))
+
+
 def _lstm_bwd_seq_bwd(activation, residuals, cotangents):
     xz, rec, hs, cs, dhs, dhT_seq, dcT_seq = residuals
     u, v_mat = cotangents
-    return _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation)
+    uxz, urec, uhs, ucs, udhs = _adj_call(
+        xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation)
+    return _cast_like((uxz, urec), (xz, rec)) + (uhs, ucs, udhs)
 
 
 lstm_bwd_seq.defvjp(_lstm_bwd_seq_fwd, _lstm_bwd_seq_bwd)
@@ -605,7 +666,8 @@ def _lstm_fwd_res_fwd(xz, rec, activation):
 def _lstm_fwd_res_bwd(activation, residuals, cotangents):
     xz, rec, hs, cs = residuals
     dhs, dcs = cotangents
-    return _bwd_call(xz, rec, hs, cs, dhs, dcs, activation)
+    return _cast_like(_bwd_call(xz, rec, hs, cs, dhs, dcs, activation),
+                      (xz, rec))
 
 
 lstm_fwd_res.defvjp(_lstm_fwd_res_fwd, _lstm_fwd_res_bwd)
@@ -620,7 +682,8 @@ def _lstm_seq_fwd(xz, rec, activation):
 
 def _lstm_seq_bwd(activation, residuals, dhs):
     xz, rec, hs, cs = residuals
-    return lstm_bwd_seq(xz, rec, hs, cs, dhs, activation)
+    return _cast_like(lstm_bwd_seq(xz, rec, hs, cs, dhs, activation),
+                      (xz, rec))
 
 
 lstm_seq.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
@@ -666,7 +729,7 @@ def _lstm_fwd_res_carry_bwd(activation, residuals, cotangents):
     dhs, dcs = cotangents
     dxz, drec, dh0, dc0 = _bwd_call(xz, rec, hs, cs, dhs, dcs, activation,
                                     carry=(h0, c0))
-    return dxz, drec, dh0, dc0
+    return _cast_like((dxz, drec), (xz, rec)) + (dh0, dc0)
 
 
 lstm_fwd_res_carry.defvjp(_lstm_fwd_res_carry_fwd, _lstm_fwd_res_carry_bwd)
@@ -692,8 +755,9 @@ def _lstm_bwd_seq_carry_fwd(xz, rec, hs, cs, dhs, dc_fin, h0, c0, activation):
 def _lstm_bwd_seq_carry_bwd(activation, residuals, cotangents):
     xz, rec, hs, cs, h0, c0, dhT_seq, dcT_seq = residuals
     u, v_mat, muh0, muc0 = cotangents
-    return _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation,
-                     carry=(h0, c0), mu0=(muh0, muc0))
+    out = _adj_call(xz, rec, hs, cs, dhT_seq, dcT_seq, u, v_mat, activation,
+                    carry=(h0, c0), mu0=(muh0, muc0))
+    return _cast_like(out[:2], (xz, rec)) + out[2:]
 
 
 lstm_bwd_seq_carry.defvjp(_lstm_bwd_seq_carry_fwd, _lstm_bwd_seq_carry_bwd)
@@ -707,8 +771,9 @@ def _lstm_seq_carry_fwd(xz, rec, h0, c0, activation):
 def _lstm_seq_carry_bwd(activation, residuals, cotangents):
     xz, rec, h0, c0, hs, cs = residuals
     dhs, dc_fin = cotangents
-    return lstm_bwd_seq_carry(xz, rec, hs, cs, dhs, dc_fin, h0, c0,
-                              activation)
+    dxz, drec, dh0, dc0 = lstm_bwd_seq_carry(xz, rec, hs, cs, dhs, dc_fin,
+                                             h0, c0, activation)
+    return _cast_like((dxz, drec), (xz, rec)) + (dh0, dc0)
 
 
 lstm_seq_carry.defvjp(_lstm_seq_carry_fwd, _lstm_seq_carry_bwd)
@@ -719,22 +784,34 @@ lstm_seq_carry.defvjp(_lstm_seq_carry_fwd, _lstm_seq_carry_bwd)
 def pallas_keras_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray,
                       bias: jnp.ndarray, x: jnp.ndarray,
                       activation: str = "tanh",
-                      recurrent_activation: str = "sigmoid") -> jnp.ndarray:
+                      recurrent_activation: str = "sigmoid",
+                      dtype=None) -> jnp.ndarray:
     """Drop-in recurrence for Keras-layout params: (B, W, F) → (B, W, H).
 
     Numerically matches :class:`hfrep_tpu.ops.lstm.KerasLSTM`'s scan path
     (same hoisted projection, same cell arithmetic); first-order
     differentiable via the Pallas backward kernel.
+
+    ``dtype`` is the effective compute dtype (defaults to ``x.dtype``,
+    mirroring the scan path): bf16 streams the projection/recurrent
+    operands at half width through the kernels (f32 scratch/gate math)
+    and returns bf16 hidden states, exactly the scan path's output
+    dtype contract.
     """
     _supported(activation, recurrent_activation)
     b, w, f = x.shape
     h = recurrent.shape[0]
     hp = ((h + LANE - 1) // LANE) * LANE
+    dt = jnp.dtype(dtype or x.dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise NotImplementedError(f"pallas LSTM streams f32/bf16, got {dt}")
 
     kernel_p, rec_p, bias_p = pad_keras_params(
         {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}, h, hp)
 
-    xz = (x.reshape(b * w, f) @ kernel_p + bias_p).reshape(b, w, 4 * hp)
-    xz = jnp.swapaxes(xz, 0, 1).astype(jnp.float32)               # (W, B, 4Hp)
-    hs = lstm_seq(xz, rec_p.astype(jnp.float32), activation if activation else "linear")
-    return jnp.swapaxes(hs, 0, 1)[..., :h]
+    x = x.astype(dt)
+    xz = (x.reshape(b * w, f) @ kernel_p.astype(dt) + bias_p.astype(dt)
+          ).reshape(b, w, 4 * hp)
+    xz = jnp.swapaxes(xz, 0, 1).astype(dt)                        # (W, B, 4Hp)
+    hs = lstm_seq(xz, rec_p.astype(dt), activation if activation else "linear")
+    return jnp.swapaxes(hs, 0, 1)[..., :h].astype(dt)

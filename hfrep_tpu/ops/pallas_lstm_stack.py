@@ -36,6 +36,7 @@ from hfrep_tpu.ops.pallas_lstm import (
     _ACT,
     _act_prime_from_value as P,
     _act_prime_prime_from_value as PP,
+    _cast_like,
     _interpret,
     _shifted,
     _supported,
@@ -80,13 +81,19 @@ def _stack_fwd_kernel(act_name, with_res, xz1_ref, rec1_ref, k2_ref, b2_ref,
             s[:] = jnp.zeros_like(s)
 
     act = _ACT[act_name]
-    z1 = xz1_ref[0] + jnp.dot(h1s[:], rec1_ref[:], preferred_element_type=jnp.float32)
+    # Operands may be bf16 (f32 state/gate math, f32 accumulation —
+    # same mixed-precision contract as the single-layer kernels).
+    z1 = (xz1_ref[0].astype(jnp.float32)
+          + jnp.dot(h1s[:].astype(rec1_ref.dtype), rec1_ref[:],
+                    preferred_element_type=jnp.float32))
     i1, f1, g1, o1 = _gates(z1, act_name)
     c1 = f1 * c1s[:] + i1 * g1
     h1 = o1 * act(c1)
-    z2 = (b2_ref[0]
-          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
-          + jnp.dot(h2s[:], rec2_ref[:], preferred_element_type=jnp.float32))
+    z2 = (b2_ref[0].astype(jnp.float32)
+          + jnp.dot(h1.astype(k2_ref.dtype), k2_ref[:],
+                    preferred_element_type=jnp.float32)
+          + jnp.dot(h2s[:].astype(rec2_ref.dtype), rec2_ref[:],
+                    preferred_element_type=jnp.float32))
     i2, f2, g2, o2 = _gates(z2, act_name)
     c2 = f2 * c2s[:] + i2 * g2
     h2 = o2 * act(c2)
@@ -150,12 +157,17 @@ def _stack_bwd_kernel(act_name, with_direct, with_carries,
     h1p, c1p, c1, h1 = h1p_ref[0], c1p_ref[0], cs1_ref[0], hs1_ref[0]
     h2p, c2p, c2 = h2p_ref[0], c2p_ref[0], cs2_ref[0]
 
-    # recompute gates for both layers
-    z1 = xz1_ref[0] + jnp.dot(h1p, rec1_ref[:], preferred_element_type=jnp.float32)
+    # recompute gates for both layers (bf16 operands: cast f32 residuals
+    # to the matrix dtype at each dot, f32 accumulation)
+    z1 = (xz1_ref[0].astype(jnp.float32)
+          + jnp.dot(h1p.astype(rec1_ref.dtype), rec1_ref[:],
+                    preferred_element_type=jnp.float32))
     i1, f1, g1, o1 = _gates(z1, act_name)
-    z2 = (b2_ref[0]
-          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
-          + jnp.dot(h2p, rec2_ref[:], preferred_element_type=jnp.float32))
+    z2 = (b2_ref[0].astype(jnp.float32)
+          + jnp.dot(h1.astype(k2_ref.dtype), k2_ref[:],
+                    preferred_element_type=jnp.float32)
+          + jnp.dot(h2p.astype(rec2_ref.dtype), rec2_ref[:],
+                    preferred_element_type=jnp.float32))
     i2, f2, g2, o2 = _gates(z2, act_name)
 
     dc2_in = dc2s[:] + (dcs2_ref[0] if with_direct else 0.0)
@@ -166,7 +178,8 @@ def _stack_bwd_kernel(act_name, with_direct, with_carries,
     db2_ref[:] += jnp.sum(dz2, axis=0, keepdims=True)
     drec2_ref[:] += lax.dot_general(h2p, dz2, (((0,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-    dh1_in = jnp.dot(dz2, k2_t_ref[:], preferred_element_type=jnp.float32)
+    dh1_in = jnp.dot(dz2.astype(k2_t_ref.dtype), k2_t_ref[:],
+                     preferred_element_type=jnp.float32)
     if with_direct:
         dh1_in = dh1_in + dhs1_ref[0]
     dc1_in = dc1s[:] + (dcs1_ref[0] if with_direct else 0.0)
@@ -178,9 +191,11 @@ def _stack_bwd_kernel(act_name, with_direct, with_carries,
     if with_carries:
         dhT1_ref[0], dcT1_ref[0] = dhT1, dcT1
         dhT2_ref[0], dcT2_ref[0] = dhT2, dcT2
-    dh1s[:] = jnp.dot(dz1, rec1_t_ref[:], preferred_element_type=jnp.float32)
+    dh1s[:] = jnp.dot(dz1.astype(rec1_t_ref.dtype), rec1_t_ref[:],
+                      preferred_element_type=jnp.float32)
     dc1s[:] = dcT1 * f1
-    dh2s[:] = jnp.dot(dz2, rec2_t_ref[:], preferred_element_type=jnp.float32)
+    dh2s[:] = jnp.dot(dz2.astype(rec2_t_ref.dtype), rec2_t_ref[:],
+                      preferred_element_type=jnp.float32)
     dc2s[:] = dcT2 * f2
 
 
@@ -272,11 +287,15 @@ def _stack_adj_kernel(act_name, xz1_ref, rec1_ref, rec1_t_ref, k2_ref,
         dzc = dcT * i * P(act_name, g)
         dzo = do * qo
         dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
-        dzbar = (U_t + jnp.dot(muh, rec, preferred_element_type=jnp.float32)
-                 + jnp.dot(hp_t, v, preferred_element_type=jnp.float32))
+        dzbar = (U_t.astype(jnp.float32)
+                 + jnp.dot(muh.astype(rec.dtype), rec,
+                           preferred_element_type=jnp.float32)
+                 + jnp.dot(hp_t.astype(v.dtype), v,
+                           preferred_element_type=jnp.float32))
         dcTbar = muc * f
         fbar = muc * dcT
-        hpbar = jnp.dot(dz, v_t, preferred_element_type=jnp.float32)
+        hpbar = jnp.dot(dz.astype(v_t.dtype), v_t,
+                        preferred_element_type=jnp.float32)
         urec = lax.dot_general(muh, dz, (((0,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
         dzbi, dzbf, dzbc, dzbo = (dzbar[:, :hp_dim], dzbar[:, hp_dim:2 * hp_dim],
@@ -299,16 +318,21 @@ def _stack_adj_kernel(act_name, xz1_ref, rec1_ref, rec1_t_ref, k2_ref,
         aCbar += dobar * dhT
         zbar = jnp.concatenate([ibar * qi, fbar * qf, gbar * P(act_name, g),
                                 obar * qo], axis=-1)
-        hpbar = hpbar + jnp.dot(zbar, rec_t, preferred_element_type=jnp.float32)
+        hpbar = hpbar + jnp.dot(zbar.astype(rec_t.dtype), rec_t,
+                                preferred_element_type=jnp.float32)
         urec = urec + lax.dot_general(hp_t, zbar, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         cbar = aCbar * P(act_name, a_c)
         return zbar, hpbar, cpbar, cbar, dhTbar, dcTbar, urec, dz
 
-    z1 = xz1_ref[0] + jnp.dot(h1p, rec1_ref[:], preferred_element_type=jnp.float32)
-    z2 = (b2_ref[0]
-          + jnp.dot(h1, k2_ref[:], preferred_element_type=jnp.float32)
-          + jnp.dot(h2p, rec2_ref[:], preferred_element_type=jnp.float32))
+    z1 = (xz1_ref[0].astype(jnp.float32)
+          + jnp.dot(h1p.astype(rec1_ref.dtype), rec1_ref[:],
+                    preferred_element_type=jnp.float32))
+    z2 = (b2_ref[0].astype(jnp.float32)
+          + jnp.dot(h1.astype(k2_ref.dtype), k2_ref[:],
+                    preferred_element_type=jnp.float32)
+          + jnp.dot(h2p.astype(rec2_ref.dtype), rec2_ref[:],
+                    preferred_element_type=jnp.float32))
 
     # layer1 adjoint first (it ran last in the backward step)
     (zbar1, hp1bar, cp1bar, c1bar, dhTbar1, dcTbar1, ur1_s, dz1) = adj_layer(
@@ -316,16 +340,20 @@ def _stack_adj_kernel(act_name, xz1_ref, rec1_ref, rec1_t_ref, k2_ref,
         vr1_ref[:], vr1_t_ref[:], rec1_ref[:], rec1_t_ref[:])
     ur1_ref[:] += ur1_s
     # layer2's dz2 cotangent: via dh1_in = dz2@K2ᵀ, dk2 = h1ᵀdz2, db2 = Σdz2
-    u2 = (jnp.dot(dhTbar1, k2_ref[:], preferred_element_type=jnp.float32)
-          + jnp.dot(h1, vk2_ref[:], preferred_element_type=jnp.float32)
-          + vb2_ref[0])
+    u2 = (jnp.dot(dhTbar1.astype(k2_ref.dtype), k2_ref[:],
+                  preferred_element_type=jnp.float32)
+          + jnp.dot(h1.astype(vk2_ref.dtype), vk2_ref[:],
+                    preferred_element_type=jnp.float32)
+          + vb2_ref[0].astype(jnp.float32))
     (zbar2, hp2bar, cp2bar, c2bar, dhTbar2, dcTbar2, ur2_s, dz2) = adj_layer(
         z2, cs2_ref[0], c2p, h2p, dhT2, dcT2, muh2_s[:], muc2_s[:], u2,
         vr2_ref[:], vr2_t_ref[:], rec2_ref[:], rec2_t_ref[:])
     ur2_ref[:] += ur2_s
     # zbar2 is the cotangent of z2's additive inputs: h1@K2 (+b2)
-    uh1 = (jnp.dot(zbar2, k2_t_ref[:], preferred_element_type=jnp.float32)
-           + jnp.dot(dz2, vk2_t_ref[:], preferred_element_type=jnp.float32))
+    uh1 = (jnp.dot(zbar2.astype(k2_t_ref.dtype), k2_t_ref[:],
+                   preferred_element_type=jnp.float32)
+           + jnp.dot(dz2.astype(vk2_t_ref.dtype), vk2_t_ref[:],
+                     preferred_element_type=jnp.float32))
     uk2_ref[:] += (lax.dot_general(h1, zbar2, (((0,), (0,)), ((), ())),
                                    preferred_element_type=jnp.float32)
                    + lax.dot_general(dhTbar1, dz2, (((0,), (0,)), ((), ())),
@@ -412,9 +440,10 @@ def _stack_bwd_seq_bwd(activation, res, cots):
     (xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
      dhT1s, dcT1s, dhT2s, dcT2s) = res
     u1, vr1, vk2, vb2, vr2 = cots
-    return _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
-                           dhT1s, dcT1s, dhT2s, dcT2s, u1, vr1, vk2, vb2,
-                           vr2, activation)
+    out = _stack_adj_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
+                          dhT1s, dcT1s, dhT2s, dcT2s, u1, vr1, vk2, vb2,
+                          vr2, activation)
+    return _cast_like(out[:5], (xz1, rec1, k2, b2, rec2)) + out[5:]
 
 
 stack_bwd_seq.defvjp(_stack_bwd_seq_fwd, _stack_bwd_seq_bwd)
@@ -438,7 +467,7 @@ def _stack_fwd_res_bwd(activation, res, cots):
     dhs1, dcs1, dhs2, dcs2 = cots
     out = _stack_bwd_call(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2,
                           dhs2, (dhs1, dcs1, dcs2), activation)
-    return out[:5]
+    return _cast_like(out[:5], (xz1, rec1, k2, b2, rec2))
 
 
 stack_fwd_res.defvjp(_stack_fwd_res_fwd, _stack_fwd_res_bwd)
@@ -457,8 +486,10 @@ def _stack_seq_fwd(xz1, rec1, k2, b2, rec2, activation):
 
 def _stack_seq_bwd(activation, res, dhs2):
     xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2 = res
-    return stack_bwd_seq(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
-                         activation)
+    return _cast_like(
+        stack_bwd_seq(xz1, rec1, k2, b2, rec2, hs1, cs1, hs2, cs2, dhs2,
+                      activation),
+        (xz1, rec1, k2, b2, rec2))
 
 
 stack_seq.defvjp(_stack_seq_fwd, _stack_seq_bwd)
@@ -468,12 +499,16 @@ stack_seq.defvjp(_stack_seq_fwd, _stack_seq_bwd)
 
 def pallas_keras_lstm_stack(params1: dict, params2: dict, x: jnp.ndarray,
                             activation: Optional[str] = "tanh",
-                            recurrent_activation: str = "sigmoid") -> jnp.ndarray:
+                            recurrent_activation: str = "sigmoid",
+                            dtype=None) -> jnp.ndarray:
     """Fused plain stack from two Keras-layout param dicts
     ({kernel, recurrent_kernel, bias}); (B, W, F) → (B, W, H2).
 
     Numerically matches two chained :class:`~hfrep_tpu.ops.lstm.KerasLSTM`
     applications; twice-differentiable like the single-layer path.
+    ``dtype`` is the effective compute dtype (default ``x.dtype``); bf16
+    streams the weight matrices/projection at half width (f32 gate math)
+    and returns bf16, matching the scan path's dtype contract.
     """
     _supported(activation, recurrent_activation)
     act = activation or "linear"
@@ -483,6 +518,9 @@ def pallas_keras_lstm_stack(params1: dict, params2: dict, x: jnp.ndarray,
     if h1 != h2:
         raise NotImplementedError("fused stack requires equal layer widths")
     hp = ((h1 + LANE - 1) // LANE) * LANE
+    dt = jnp.dtype(dtype or x.dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise NotImplementedError(f"pallas LSTM stack streams f32/bf16, got {dt}")
 
     k1p, r1p, b1p = pad_keras_params(params1, h1, hp)
     _, r2p, b2p = pad_keras_params(params2, h2, hp)
@@ -491,8 +529,10 @@ def pallas_keras_lstm_stack(params1: dict, params2: dict, x: jnp.ndarray,
     k2p = pad_keras_params({**params2, "recurrent_kernel": params2["kernel"]},
                            h2, hp)[1]
 
-    xz1 = (x.reshape(b * w, f) @ k1p + b1p).reshape(b, w, 4 * hp)
-    xz1 = jnp.swapaxes(xz1, 0, 1).astype(jnp.float32)
-    hs2 = stack_seq(xz1, r1p.astype(jnp.float32), k2p.astype(jnp.float32),
-                    b2p.astype(jnp.float32), r2p.astype(jnp.float32), act)
-    return jnp.swapaxes(hs2, 0, 1)[..., :h2]
+    x = x.astype(dt)
+    xz1 = (x.reshape(b * w, f) @ k1p.astype(dt) + b1p.astype(dt)
+           ).reshape(b, w, 4 * hp)
+    xz1 = jnp.swapaxes(xz1, 0, 1).astype(dt)
+    hs2 = stack_seq(xz1, r1p.astype(dt), k2p.astype(dt),
+                    b2p.astype(dt), r2p.astype(dt), act)
+    return jnp.swapaxes(hs2, 0, 1)[..., :h2].astype(dt)

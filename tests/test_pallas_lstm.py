@@ -83,9 +83,12 @@ def test_bf16_operand_forward_kernel_matches_f32():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-3)
 
 
-def test_bf16_falls_back_to_scan():
-    """Training dispatch is f32-only; a bf16 module must honor its dtype
-    via the scan path instead of silently computing in f32."""
+def test_bf16_dispatches_to_kernel():
+    """bf16 modules now take the kernel path (round-4: bf16 operand
+    streams through fwd/bwd/adjoint, f32 scratch/gate math) — output
+    dtype stays bf16 and values agree with the bf16 scan path to bf16
+    rounding (the kernel's f32 internal math is slightly *more* precise
+    than the scan's all-bf16 arithmetic)."""
     mod = KerasLSTM(16, activation="sigmoid", dtype=jnp.bfloat16)
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3))
     params = mod.init(jax.random.PRNGKey(1), x)["params"]
@@ -93,11 +96,99 @@ def test_bf16_falls_back_to_scan():
     got = mod.apply({"params": params}, x, backend="pallas")
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(got, np.float32),
-                               np.asarray(ref, np.float32), atol=1e-6)
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+@pytest.mark.slow
+def test_bf16_kernel_gradients_and_second_order_match_f32():
+    """First- and second-order grads through the bf16-operand kernels
+    must track the f32 kernel path to bf16-rounding tolerance, and the
+    cotangent dtypes must match the operands (custom_vjp contract)."""
+    from hfrep_tpu.ops.pallas_lstm import lstm_seq
+
+    key = jax.random.PRNGKey(5)
+    w, b, hp = 5, 4, 128
+    xz = 0.3 * jax.random.normal(key, (w, b, 4 * hp), jnp.float32)
+    rec = 0.1 * jax.random.normal(jax.random.fold_in(key, 1), (hp, 4 * hp))
+    tgt = jax.random.normal(jax.random.fold_in(key, 2), (w, b, hp))
+
+    def loss(xz_, rec_):
+        return jnp.sum((lstm_seq(xz_, rec_, "sigmoid") - tgt) ** 2)
+
+    g32 = jax.grad(loss, argnums=(0, 1))(xz, rec)
+    g16 = jax.grad(loss, argnums=(0, 1))(xz.astype(jnp.bfloat16),
+                                         rec.astype(jnp.bfloat16))
+    assert g16[0].dtype == jnp.bfloat16 and g16[1].dtype == jnp.bfloat16
+    for a, r in zip(g16, g32):
+        scale = float(jnp.abs(r).max()) or 1.0
+        np.testing.assert_allclose(np.asarray(a, np.float32) / scale,
+                                   np.asarray(r) / scale, atol=5e-2)
+
+    # GP-shaped second order: grad w.r.t. rec of the input-grad norm
+    def gp(rec_, xz_):
+        gx = jax.grad(lambda v: jnp.sum(lstm_seq(v, rec_, "sigmoid")))(xz_)
+        return jnp.sum(gx.astype(jnp.float32) ** 2)
+
+    h32 = jax.grad(gp)(rec, xz)
+    h16 = jax.grad(gp)(rec.astype(jnp.bfloat16), xz.astype(jnp.bfloat16))
+    assert h16.dtype == jnp.bfloat16
+    scale = float(jnp.abs(h32).max()) or 1.0
+    np.testing.assert_allclose(np.asarray(h16, np.float32) / scale,
+                               np.asarray(h32) / scale, atol=5e-2)
+
+
+class TestVmemCeiling:
+    """Round-3 finding: `auto` dispatch OOM'd at H=512 f32 instead of
+    falling back — eligibility must be shape- and dtype-aware, anchored
+    to the measured 16 MB scoped-vmem bound (RESULTS.md)."""
+
+    def test_measured_anchor_points(self):
+        from hfrep_tpu.ops.pallas_lstm import kernel_eligible
+
+        f32, bf16 = jnp.float32, jnp.bfloat16
+        assert kernel_eligible("pallas", f32, hidden=100, layers=1)
+        assert kernel_eligible("pallas", f32, hidden=100, layers=2)   # flagship critic
+        assert kernel_eligible("pallas", f32, hidden=256, layers=2)   # measured fits
+        assert not kernel_eligible("pallas", f32, hidden=512)         # measured OOM
+        assert not kernel_eligible("pallas", f32, hidden=512, layers=2)
+        assert not kernel_eligible("pallas", f32, hidden=384, layers=2)
+        assert kernel_eligible("pallas", f32, hidden=384, layers=1)
+        # bf16 halves the primal matrices (the f32 cotangent streams
+        # dominate the stack, so its ceiling moves less)
+        assert kernel_eligible("pallas", bf16, hidden=384, layers=1)
+        assert kernel_eligible("pallas", bf16, hidden=256, layers=2)
+        assert not kernel_eligible("pallas", bf16, hidden=384, layers=2)
+        # other dtypes still take the scan path
+        assert not kernel_eligible("pallas", jnp.float16, hidden=100)
+        assert not kernel_eligible("xla", f32, hidden=100)
+
+    def test_h512_f32_falls_back_cleanly(self):
+        """The exact round-3 crash shape: H=512 f32 with backend='pallas'
+        must run the scan path (identical to the xla backend), not OOM."""
+        mod = KerasLSTM(512, activation="sigmoid")
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 6))
+        params = mod.init(jax.random.PRNGKey(1), x)["params"]
+        ref = mod.apply({"params": params}, x, backend="xla")
+        got = mod.apply({"params": params}, x, backend="pallas")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0)
+
+    def test_h384_stack_falls_back_to_per_layer_kernels(self):
+        """At Hp=384 the FUSED stack exceeds the ceiling but single-layer
+        kernels fit: the critic must fall through to chained per-layer
+        dispatch (still correct vs the xla backend)."""
+        from hfrep_tpu.models.discriminators import LSTMFlatCritic
+
+        critic = LSTMFlatCritic(hidden=384)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 6))
+        params = critic.init(jax.random.PRNGKey(3), x)["params"]
+        ref = critic.apply({"params": params}, x, backend="xla")
+        got = critic.apply({"params": params}, x, backend="pallas")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
 
 
 @pytest.mark.parametrize("activation", ["sigmoid", "tanh", None])
-@pytest.mark.parametrize("h", [100, 200])
+@pytest.mark.parametrize("h", [100, pytest.param(200, marks=pytest.mark.slow)])
 def test_gradients_match_scan(activation, h):
     mod, params, x = _mk(h, 35, activation, jax.random.PRNGKey(1))
     w = jax.random.normal(jax.random.PRNGKey(2), (4, 6, h))
@@ -301,7 +392,8 @@ def test_carry_adjoint_matches_scan_twin_vjp(activation):
 
 
 @pytest.mark.parametrize("activation", [
-    pytest.param("sigmoid", marks=pytest.mark.slow), "tanh"])
+    pytest.param("sigmoid", marks=pytest.mark.slow),
+    pytest.param("tanh", marks=pytest.mark.slow)])
 def test_second_order_matches_xla(activation):
     """Grad-of-grad (the WGAN-GP gradient-penalty pattern, ∂/∂θ ∇_x c)
     through the pallas backend: the nested custom_vjp structure routes

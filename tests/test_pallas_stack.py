@@ -52,6 +52,7 @@ def test_first_order_grads_match(problem, wrt):
     assert _tree_max_err(got, ref) < 1e-5
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("wrt", [0, 1, 2])
 def test_second_order_gp_pattern_matches(problem, wrt):
     p1, p2, x, chained, fused = problem
@@ -63,6 +64,39 @@ def test_second_order_gp_pattern_matches(problem, wrt):
     ref = jax.grad(lambda *a: gp(*a, chained), argnums=wrt)(p1, p2, x)
     got = jax.grad(lambda *a: gp(*a, fused), argnums=wrt)(p1, p2, x)
     assert _tree_max_err(got, ref) < 1e-5
+
+
+@pytest.mark.slow
+def test_bf16_stack_forward_and_grads_match_f32(problem):
+    """bf16 operand streams through the fused stack's fwd/bwd kernels:
+    values and param grads track the f32 kernels to bf16 rounding;
+    cotangent dtypes follow the operands."""
+    p1, p2, x, chained, fused = problem
+
+    def to_bf16(t):
+        return jax.tree_util.tree_map(lambda v: v.astype(jnp.bfloat16), t)
+
+    ref = fused(p1, p2, x)
+    got = pallas_keras_lstm_stack(to_bf16(p1), to_bf16(p2),
+                                  x.astype(jnp.bfloat16), activation="tanh")
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(ref),
+                               atol=3e-2)
+
+    def loss(p1_, p2_, x_):
+        return jnp.sum(pallas_keras_lstm_stack(p1_, p2_, x_,
+                                               activation="tanh")
+                       .astype(jnp.float32) ** 2)
+
+    g32 = jax.grad(loss, argnums=(0, 1))(p1, p2, x)
+    g16 = jax.grad(loss, argnums=(0, 1))(to_bf16(p1), to_bf16(p2),
+                                         x.astype(jnp.bfloat16))
+    for a, r in zip(jax.tree_util.tree_leaves(g16),
+                    jax.tree_util.tree_leaves(g32)):
+        assert a.dtype == jnp.bfloat16
+        scale = float(jnp.abs(r).max()) or 1.0
+        np.testing.assert_allclose(np.asarray(a, np.float32) / scale,
+                                   np.asarray(r) / scale, atol=6e-2)
 
 
 def test_critic_params_identical_across_backends():
