@@ -136,15 +136,21 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
     use_kernel = backend == "pallas"
     if use_kernel:
         from hfrep_tpu.ops.pallas_lstm import (LANE, _supported,
+                                               kernel_eligible,
                                                lstm_seq_carry,
                                                pad_keras_params)
         _supported(activation, recurrent_activation)
-        if jax.default_backend() != "tpu":
+        if not kernel_eligible("pallas", x.dtype, hidden=max(h_dims)):
+            # measured VMEM ceiling (ops/pallas_lstm.py): oversized widths
+            # take the scan chunks instead of OOMing in the carry adjoint
+            use_kernel = False
+        elif jax.default_backend() != "tpu":
             raise NotImplementedError(
                 "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
                 "pallas cannot propagate vma under shard_map(check_vma)")
-        if x.dtype != jnp.float32:
+        elif x.dtype != jnp.float32:
             raise NotImplementedError("sp_lstm pallas backend runs f32")
+    if use_kernel:
         hp = [((h + LANE - 1) // LANE) * LANE for h in h_dims]
         lay = []
         for l, h, hpi in zip(layers, h_dims, hp):
@@ -313,6 +319,58 @@ def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
                         backend=backend, manual=manual)
 
 
+def sp_microbatch_plan(batch: int, n_dev: int, window: int = 168,
+                       hidden: int = 100,
+                       step_latency_s: float = 2e-6,
+                       mxu_flops: float = 1e14) -> dict:
+    """Analytic model of the microbatch count's two competing effects —
+    the M-vs-Bm trade the round-3 numbers (measured at D=1, where no
+    pipeline exists) do not constrain.
+
+    Critical path: S = M + D − 1 supersteps of W/D recurrence timesteps,
+    each costing ``t_step(Bm) = max(t_lat, 8·Bm·Hp² / mxu_flops)`` with
+    Bm = B/M rows.  Relative to the single-device scan (W steps at B
+    rows):
+
+    * **latency-bound** (t_lat dominates — true for every shape this
+      framework ships: at Hp=128, Bm=32 the matmul is ~21 ns against
+      ~2 µs of per-step latency): time ∝ S·W/D, so SMALL M wins — M=1
+      is latency-*parity* with the single device while cutting per-device
+      window state D×.  In this regime sequence parallelism is a memory/
+      capacity play, not a throughput play, and the pipeline 'utilization'
+      M/(M+D−1) is the wrong metric to optimize.
+    * **work-bound** (huge Bm·Hp²): time ∝ S·(W/D)·Bm ∝ (M+D−1)/M, so
+      LARGE M wins, approaching D× speedup — the classical pipeline
+      regime.  The crossover Bm* = t_lat·mxu_flops/(8·Hp²) sits at
+      ~1500 rows for Hp=128: far above any realistic batch here, which
+      is why the recommendation is latency-regime M unless hidden is
+      scaled into the thousands.
+
+    Returns per-M predictions (supersteps, Bm, predicted time relative
+    to the single-device scan) and the recommended M.  The model's core
+    assumption — t_step flat in Bm at these shapes — is validated on
+    chip by ``tools/bench_sp_microbatch.py`` (RESULTS.md round 4).
+    The pipeline's DEFAULT stays M = D (every published number used it);
+    this planner is advisory for pod runs.
+    """
+    from hfrep_tpu.ops.pallas_lstm import LANE
+
+    hp = ((hidden + LANE - 1) // LANE) * LANE
+    plans = []
+    for m in range(1, batch + 1):
+        if batch % m:
+            continue
+        bm = batch // m
+        t_step = max(step_latency_s, 8.0 * bm * hp * hp / mxu_flops)
+        t_single = window * max(step_latency_s, 8.0 * batch * hp * hp / mxu_flops)
+        rel = (m + n_dev - 1) * (window / n_dev) * t_step / t_single
+        plans.append({"microbatches": m, "rows": bm,
+                      "supersteps": m + n_dev - 1,
+                      "relative_time": rel})
+    best = min(plans, key=lambda p: p["relative_time"])
+    return {"plans": plans, "recommended": best["microbatches"]}
+
+
 def validate_sp_pair(pair) -> None:
     """The sp modules mirror the flagship LSTMGenerator/LSTMFlatCritic
     param trees and run f32 — shared precondition of the standalone sp
@@ -326,7 +384,8 @@ def validate_sp_pair(pair) -> None:
 
 
 def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
-                       axis_name: Optional[str] = None, jit: bool = True):
+                       axis_name: Optional[str] = None,
+                       microbatches: Optional[int] = None, jit: bool = True):
     """Sequence-parallel MTSS-WGAN-GP training: the full epoch (n_critic
     GP critic updates + generator update) with the window axis sharded.
 
@@ -355,15 +414,18 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     backend = resolve_lstm_backend(tcfg.lstm_backend)
     g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=axis_name,
                                        activation="sigmoid", slope=slope,
+                                       microbatches=microbatches,
                                        backend=backend)
     d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name,
+                                     microbatches=microbatches,
                                      backend=backend)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
     return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
 def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
-                       axis_name: Optional[str] = None, jit: bool = True):
+                       axis_name: Optional[str] = None,
+                       microbatches: Optional[int] = None, jit: bool = True):
     """``fn(state, key) -> (state, stacked_metrics)``:
     ``tcfg.steps_per_call`` sequence-parallel epochs scanned into ONE
     compiled program — the sp twin of
@@ -376,7 +438,8 @@ def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     from hfrep_tpu.train.steps import make_multi_step
 
     step = make_sp_train_step(pair, tcfg, dataset, mesh,
-                              axis_name=axis_name, jit=False)
+                              axis_name=axis_name,
+                              microbatches=microbatches, jit=False)
     return make_multi_step(pair, tcfg, dataset, jit=jit, step=step)
 
 
@@ -426,6 +489,7 @@ _sp_head = jax.jit(_sp_head_impl, static_argnames=("slope", "eps"))
 
 def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
               axis_name: Optional[str] = None,
+              microbatches: Optional[int] = None,
               backend: str = "xla",
               manual: bool = False) -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
@@ -451,7 +515,8 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     axis_name = _resolve_axis(mesh, axis_name)
     # both recurrences in ONE fused pipeline pass (see sp_lstm2)
     h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
-                  axis_name=axis_name, backend=backend, manual=manual)
+                  axis_name=axis_name, microbatches=microbatches,
+                  backend=backend, manual=manual)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     w = x.shape[1]
@@ -482,6 +547,7 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 axis_name: Optional[str] = None, slope: float = 0.2,
                 activation: str = "sigmoid",
                 ln_eps: float = 1e-3,
+                microbatches: Optional[int] = None,
                 backend: str = "xla",
                 manual: bool = False) -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
@@ -519,7 +585,8 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
         x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
                      inter=(lambda p, v: _sp_ln(p, v, ln_eps),
                             g_params["KerasLayerNorm_0"]),
-                     axis_name=axis_name, activation=activation,
+                     axis_name=axis_name, microbatches=microbatches,
+                     activation=activation,
                      backend=backend, manual=True)
         y = _sp_head_impl(g_params, x, slope, ln_eps)   # chunk-wise head
         wl = y.shape[1]
@@ -537,5 +604,6 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
     x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
                  inter=(lambda p, v: _sp_ln(p, v, ln_eps),
                         g_params["KerasLayerNorm_0"]),
-                 axis_name=axis_name, activation=activation, backend=backend)
+                 axis_name=axis_name, microbatches=microbatches,
+                 activation=activation, backend=backend)
     return _sp_head(g_params, x, slope, ln_eps)

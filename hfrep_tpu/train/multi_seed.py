@@ -18,7 +18,11 @@ throughput for the repo's own multi-seed workloads (seed-variance
 studies, family evaluation, GAN-augmentation ensembles) without
 touching reference semantics.  Measured on chip:
 ``tools/bench_multi_seed.py`` → RESULTS.md "Multi-seed vmapped
-training".
+training" — a NEGATIVE throughput result for vmap (distinct per-member
+weights can't row-pack the MXU), whose structural fix is
+:func:`make_seed_sharded_step`: one member per device on a ``('seed',)``
+mesh, linear aggregate scaling by construction
+(``MultiSeedTrainer(..., mesh="auto")``).
 """
 
 from __future__ import annotations
@@ -27,6 +31,8 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from hfrep_tpu.config import ExperimentConfig
 from hfrep_tpu.core.data import GanDataset
@@ -54,6 +60,50 @@ def make_multi_seed_step(pair, tcfg, dataset: jnp.ndarray, jit: bool = True):
     return jax.jit(fn, donate_argnums=(0,)) if jit else fn
 
 
+def make_seed_sharded_step(pair, tcfg, dataset: jnp.ndarray, mesh, jit: bool = True):
+    """The structural fix round 3's negative result implies: members don't
+    share weights, so put one member per DEVICE instead of row-packing
+    them into one device's MXU.
+
+    ``jax.vmap`` packs members' batch rows into wider matmuls — which
+    cannot help when each member multiplies a *distinct* weight matrix
+    (the measured 0.21×-per-model result, RESULTS.md "Multi-seed vmapped
+    training").  ``shard_map`` over a ``('seed',)`` mesh is exactly the
+    tool vmap isn't: each device holds its own member's weights and runs
+    the unmodified per-member program, so aggregate multi-seed throughput
+    scales linearly in devices *by construction* — there is no
+    cross-member arithmetic, no collective, nothing to contend on.  (On
+    this host's single chip there is nothing to measure — the expected
+    pod scaling is linear and is stated, not claimed measured;
+    member-exactness versus the standalone trainer is what the virtual
+    8-device mesh pins, tests/test_train.py.)
+
+    ``K`` (the stacked leading axis) must be a multiple of the mesh size;
+    K/n_dev members run vmapped WITHIN each device (the K == n_dev case
+    is a size-1 vmap — arithmetically the standalone program).
+    """
+    return _seed_shard(make_multi_step(pair, tcfg, dataset, jit=False),
+                       mesh, jit)
+
+
+def _seed_shard(step, mesh, jit: bool = True):
+    """shard_map a per-member ``step(state, key)`` over the ``('seed',)``
+    mesh — the member axis is purely spatial (no collectives), so the
+    wrapper is the same for a multi-epoch block and a single epoch (the
+    trainer's remainder path must shard the RAW step, not a
+    steps_per_call=1 block: the block scan folds the key per epoch,
+    a different stream than the standalone remainder epoch consumes)."""
+    (axis,) = mesh.axis_names
+
+    def per_device(states, keys):
+        return jax.vmap(step)(states, keys)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)),
+                   check_vma=True)
+    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+
+
 class MultiSeedTrainer:
     """K member-exact :class:`~hfrep_tpu.train.trainer.GanTrainer` runs
     in one jitted program.
@@ -69,19 +119,45 @@ class MultiSeedTrainer:
     """
 
     def __init__(self, cfg: ExperimentConfig, dataset: GanDataset | jnp.ndarray,
-                 seeds: Sequence[int]):
+                 seeds: Sequence[int], mesh=None):
+        """``mesh`` selects the member-parallel execution:
+
+        * ``None`` (default) — vmap row-packing on one device (the
+          measured-negative-throughput mode; kept as the single-device
+          behavior and the only option when devices < members).
+        * a 1-D ``('seed',)`` :class:`jax.sharding.Mesh` — one member
+          (or K/n) per device via :func:`make_seed_sharded_step`.
+        * ``"auto"`` — seed-sharded over ``len(seeds)`` devices when the
+          host has that many, else vmap.
+        """
         self.cfg = cfg
         self.seeds = tuple(seeds)
         self.windows = (dataset.windows if isinstance(dataset, GanDataset)
                         else jnp.asarray(dataset))
         self.scaler = dataset.scaler if isinstance(dataset, GanDataset) else None
         self.pair = build_gan(cfg.model)
+        if mesh == "auto":
+            mesh = None
+            if 1 < len(self.seeds) <= len(jax.devices()):
+                import numpy as np
+                from jax.sharding import Mesh
+                mesh = Mesh(np.asarray(jax.devices()[:len(self.seeds)]),
+                            ("seed",))
+        if mesh is not None and len(self.seeds) % mesh.devices.size:
+            raise ValueError(
+                f"{len(self.seeds)} members not divisible by the "
+                f"{mesh.devices.size}-device seed mesh")
+        self.mesh = mesh
         base = jnp.stack([jax.random.PRNGKey(s) for s in self.seeds])
         split = jax.vmap(jax.random.split)(base)          # (K, 2, 2)
         self.keys = split[:, 0]                           # per-member run keys
         self.states = init_multi_seed_states(split[:, 1], cfg.model, cfg.train,
                                              self.pair)
-        self._multi = make_multi_seed_step(self.pair, cfg.train, self.windows)
+        if mesh is not None:
+            self._multi = make_seed_sharded_step(self.pair, cfg.train,
+                                                 self.windows, mesh)
+        else:
+            self._multi = make_multi_seed_step(self.pair, cfg.train, self.windows)
         self._one = None
         self._gen = None
         self.epoch = 0
@@ -105,7 +181,10 @@ class MultiSeedTrainer:
         if remainder:
             if self._one is None:
                 step = make_train_step(self.pair, self.cfg.train, self.windows)
-                self._one = jax.jit(jax.vmap(step), donate_argnums=(0,))
+                if self.mesh is not None:
+                    self._one = _seed_shard(step, self.mesh)
+                else:
+                    self._one = jax.jit(jax.vmap(step), donate_argnums=(0,))
             for _ in range(remainder):
                 self.states, _ = self._one(self.states, self._split_keys())
                 self.epoch += 1

@@ -183,6 +183,110 @@ def test_two_process_dp_matches_single_device(tmp_path):
                                float(jnp.sum(leaf0)), atol=1e-4)
 
 
+SP_CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    from hfrep_tpu.parallel.mesh import initialize_distributed, replicate_to_global
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert len(jax.local_devices()) == 4 and len(jax.devices()) == 8
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_multi_step
+    from hfrep_tpu.train.states import init_gan_state
+
+    # the WINDOW axis spans the pod-wide mesh: devices 0-3 live in this
+    # process, 4-7 in the peer — every superstep's (h, c) ppermute between
+    # device 3 and 4 crosses the process boundary over Gloo/TCP
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state = replicate_to_global(state, mesh)
+    key = replicate_to_global(jax.random.PRNGKey(1), mesh)
+
+    state, metrics = make_sp_multi_step(pair, tcfg, dataset, mesh)(state, key)
+    host = jax.device_get(metrics)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    d0 = jax.tree_util.tree_leaves(state.d_params)[0]
+    print("RESULT " + json.dumps({
+        "process": pid,
+        "d_loss": [float(v) for v in host["d_loss"]],
+        "g_loss": [float(v) for v in host["g_loss"]],
+        "g_leaf0_sum": float(jnp.sum(g0)),
+        "d_leaf0_sum": float(jnp.sum(d0)),
+    }), flush=True)
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
+@pytest.mark.slow
+def test_two_process_sp_matches_single_device(tmp_path):
+    """Sequence-parallel training with the window axis spanning TWO real
+    processes (2×4 virtual devices over Gloo/TCP): the multi-host carry
+    handoff — the last untested claim of the sp story — must land on the
+    single-device trajectory exactly like the single-process sp mesh
+    does (tests/test_sequence.py)."""
+    script = tmp_path / "sp_child.py"
+    script.write_text(SP_CHILD)
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "JAX_PLATFORMS": ""}
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              env=env, text=True)
+             for pid in (0, 1)]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"sp child failed:\n{out}\n{err}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT "):])
+        results[r["process"]] = r
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["d_loss"], results[1]["d_loss"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"],
+                               results[1]["g_leaf0_sum"], rtol=1e-6)
+
+    # trajectory oracle: the plain single-device multi-step at the same key
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_multi_step
+
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state, metrics = make_multi_step(pair, tcfg, dataset)(
+        state, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(results[0]["d_loss"],
+                               np.asarray(metrics["d_loss"]), atol=1e-4)
+    np.testing.assert_allclose(results[0]["g_loss"],
+                               np.asarray(metrics["g_loss"]), atol=1e-4)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    d0 = jax.tree_util.tree_leaves(state.d_params)[0]
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"],
+                               float(jnp.sum(g0)), atol=1e-4)
+    np.testing.assert_allclose(results[0]["d_leaf0_sum"],
+                               float(jnp.sum(d0)), atol=1e-4)
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
 @pytest.mark.skipif(not os.path.isdir("/root/reference/cleaned_data"),
                     reason="reference data not mounted")

@@ -101,6 +101,7 @@ def test_dp_sp_multi_step_matches_sequential_plain_steps():
 
 
 @needs_8
+@pytest.mark.slow
 def test_dp_sp_iid_sampling_differs_per_dp_row():
     """i.i.d. mode folds the key by dp position: the run must stay finite
     and NOT reproduce the controlled-sampling trajectory (distinct

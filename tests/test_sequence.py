@@ -23,7 +23,9 @@ def _params(key, f, h, activation="tanh"):
 
 
 @needs_8
-@pytest.mark.parametrize("b,w,f,h,m", [(8, 64, 12, 16, 8), (16, 32, 6, 8, 4)])
+@pytest.mark.parametrize("b,w,f,h,m", [
+    pytest.param(8, 64, 12, 16, 8, marks=pytest.mark.slow),
+    (16, 32, 6, 8, 4)])
 def test_matches_single_device(b, w, f, h, m):
     key = jax.random.PRNGKey(0)
     mod, p = _params(key, f, h)
@@ -37,6 +39,7 @@ def test_matches_single_device(b, w, f, h, m):
 
 
 @needs_8
+@pytest.mark.slow
 def test_sigmoid_variant():
     """The reference generators' activation='sigmoid' override."""
     key = jax.random.PRNGKey(2)
@@ -149,6 +152,63 @@ def test_sp_train_step_matches_plain_step(window):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
     assert int(sp_state.step) == 1
+
+
+@needs_8
+@pytest.mark.slow
+@pytest.mark.parametrize("batch,m", [(8, 1), (8, 2), (16, 16)])
+def test_sp_train_step_microbatch_schedules(batch, m):
+    """Schedule correctness at M ≠ D (VERDICT r3 weak-5: the code accepted
+    ``microbatches`` but every test pinned the square M=D default):
+    M=1 (pure fill/drain, the latency-regime recommendation of
+    `sp_microbatch_plan`), M=2 < D, and M=16 > D must all follow the
+    plain step's trajectory."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_train_step
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=batch, n_critic=2)
+    dataset = jnp.asarray(np.random.default_rng(3).uniform(
+        0, 1, (32, 16, 5)).astype(np.float32))
+    pair = build_gan(mcfg)
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    sp_state, sp_m = make_sp_train_step(pair, tcfg, dataset, _mesh(8),
+                                        microbatches=m)(
+        s0, jax.random.PRNGKey(1))
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    ref_state, ref_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+
+    np.testing.assert_allclose(float(sp_m["d_loss"]), float(ref_m["d_loss"]),
+                               rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(sp_state.g_params),
+                    jax.tree_util.tree_leaves(ref_state.g_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sp_microbatch_plan_regimes():
+    """The analytic M-vs-Bm model: latency-bound shapes (every shipped
+    config) recommend the smallest M; a work-bound limit (zero latency
+    floor) recommends the largest."""
+    from hfrep_tpu.parallel.sequence import sp_microbatch_plan
+
+    lat = sp_microbatch_plan(32, 8)                  # flagship pod shape
+    assert lat["recommended"] == 1
+    m1 = next(p for p in lat["plans"] if p["microbatches"] == 1)
+    assert np.isclose(m1["relative_time"], 1.0)      # latency-parity with 1 dev
+    mD = next(p for p in lat["plans"] if p["microbatches"] == 8)
+    assert mD["relative_time"] > 1.5                 # square default pays ~2x here
+
+    work = sp_microbatch_plan(32, 8, step_latency_s=0.0)
+    assert work["recommended"] == 32                 # classical pipeline regime
+    wbest = next(p for p in work["plans"] if p["microbatches"] == 32)
+    assert wbest["relative_time"] < 0.2              # approaches D x speedup
 
 
 @needs_8

@@ -1,0 +1,74 @@
+"""Validate `sp_microbatch_plan`'s core assumption on the real chip.
+
+The analytic M-vs-Bm model (hfrep_tpu/parallel/sequence.py) rests on one
+measurable claim: at these shapes the recurrence superstep cost is
+LATENCY-bound — flat in the microbatch row count Bm — so total sp time
+scales with the superstep count (M+D−1)·W/D, not with rows.  On this
+host D=1, where supersteps = M·W: the model predicts time ∝ M with Bm
+halving having no offsetting benefit.  Measuring the full sp train epoch
+at M ∈ {1, 2, 4} tests exactly that (any work-bound component would bend
+the curve below linear).
+
+Same methodology as every round-3+ number: 50-epoch scanned blocks, two
+warmups, distinct keys per call.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def main(microbatches=(1, 2, 4), n_calls=6):
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_multi_step
+    from hfrep_tpu.train.states import init_gan_state
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=100, window=168,
+                       features=36)
+    tcfg = TrainConfig(batch_size=32, n_critic=5, steps_per_call=50)
+    data = jax.random.uniform(jax.random.PRNGKey(1), (256, 168, 36),
+                              jnp.float32)
+    pair = build_gan(mcfg)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
+    base = None
+    for m in microbatches:
+        step = make_sp_multi_step(pair, tcfg, data, mesh, microbatches=m)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+        # Two warmups (compile + donated-state retrace); keys are salted
+        # by M so no (program, inputs) pair ever repeats across configs —
+        # the tunneled backend dedupes identical executions server-side.
+        state, mm = step(state, jax.random.fold_in(jax.random.PRNGKey(1), m))
+        float(jax.device_get(mm["d_loss"])[-1])
+        state, mm = step(state, jax.random.fold_in(jax.random.PRNGKey(99), m))
+        float(jax.device_get(mm["d_loss"])[-1])
+        trials = []
+        for t in range(2):                     # back-to-back agreement check
+            t0 = time.perf_counter()
+            for i in range(n_calls):
+                state, mm = step(state, jax.random.fold_in(
+                    jax.random.PRNGKey(2 + 1000 * m + t), i))
+            # device_get is the fence: block_until_ready does not
+            # reliably fence on this backend (RESULTS.md), but the calls
+            # are state-threaded, so materializing the last metrics
+            # forces the whole chain.
+            last = float(jax.device_get(mm["d_loss"])[-1])
+            trials.append((time.perf_counter() - t0) / (n_calls * 50) * 1e3)
+            assert last == last, "non-finite loss"
+        ms = min(trials)
+        base = base or ms
+        print(f"M={m} (Bm={32 // m}): {ms:.2f} ms/epoch (trials "
+              f"{', '.join(f'{v:.2f}' for v in trials)}) "
+              f"({ms / base:.2f}x vs M=1; latency model predicts {m:.2f}x)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,8 +43,12 @@ def main(out="results/family_eval.json", seeds: int = 1):
             epochs = tr.epoch
         else:
             from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+            # "auto": one member per device when the host has >= K devices
+            # (linear aggregate scaling); vmap row-packing otherwise (the
+            # single-chip case here — measured 0.21x/model at K=4).
             mst = MultiSeedTrainer(cfg, ds,
-                                   [cfg.train.seed + k for k in range(seeds)])
+                                   [cfg.train.seed + k for k in range(seeds)],
+                                   mesh="auto")
             mst.train()
             wall = time.perf_counter() - t0
             cube = mst.generate(jax.random.PRNGKey(11), n, unscale=False)
@@ -59,13 +63,22 @@ def main(out="results/family_eval.json", seeds: int = 1):
             res = dict(per_seed[0])
         else:
             import numpy as np
+            # bool is an int subclass — exclude it so flag-like metrics
+            # don't average into meaningless means; nan-aware moments so
+            # one non-finite seed can't silently poison a metric (it is
+            # flagged instead).
             scalars = [k for k, v in per_seed[0].items()
-                       if isinstance(v, (int, float))]
-            res = {k: float(np.mean([p[k] for p in per_seed]))
-                   for k in scalars}
+                       if isinstance(v, (int, float))
+                       and not isinstance(v, bool)]
+            vals = {k: np.asarray([p[k] for p in per_seed], dtype=float)
+                    for k in scalars}
+            res = {k: float(np.nanmean(v)) for k, v in vals.items()}
             res["per_seed"] = per_seed
-            res["std"] = {k: float(np.std([p[k] for p in per_seed]))
-                          for k in scalars}
+            res["std"] = {k: float(np.nanstd(v)) for k, v in vals.items()}
+            nonfinite = {k: int(np.sum(~np.isfinite(v)))
+                         for k, v in vals.items() if not np.isfinite(v).all()}
+            if nonfinite:
+                res["nonfinite_seed_count"] = nonfinite
         res["train_wall_s"] = round(wall, 2)
         res["epochs"] = epochs
         res["n_seeds"] = seeds
