@@ -46,7 +46,8 @@ def multiplot(replication: np.ndarray, actual: np.ndarray,
               names: Sequence[str], path: str, ncols: int = 3,
               labels: tuple = ("replication", "actual"),
               ante: Optional[np.ndarray] = None,
-              ante_label: str = "replication (ex-ante)") -> str:
+              ante_label: str = "replication (ex-ante)",
+              reference_compat: bool = False) -> str:
     """Cumulative-return grid, one panel per strategy (cell 38's
     ``multiplot``): replicated vs actual index, compounded from monthly
     returns.
@@ -54,13 +55,23 @@ def multiplot(replication: np.ndarray, actual: np.ndarray,
     ``ante`` adds the third series of the reference's per-strategy chart
     (``Autoencoder_encapsulate.py:226-243`` overlays *Ex-ante, Ex_post,
     Real*; the reference cumsums raw returns where this grid compounds
-    them — same ranking, honest compounding)."""
+    them — same ranking, honest compounding).  ``reference_compat=True``
+    reproduces the original figure exactly: ``np.cumsum`` of raw monthly
+    returns (``Autoencoder_encapsulate.py:231-233``) instead of
+    compounding — the same switch convention every other reference quirk
+    (Ω exponent, FF5 usecols, NB label bug) gets."""
+    cum = ((lambda r: np.cumsum(r)) if reference_compat
+           else (lambda r: np.cumprod(1.0 + r) - 1.0))
+
     def draw(ax, j):
+        # Colors pinned per series: the two base series keep C0/C1
+        # whether or not the optional ante overlay consumes a cycle slot,
+        # so two- and three-series charts stay visually comparable.
         if ante is not None:
-            ax.plot(np.cumprod(1.0 + ante[:, j]) - 1.0, label=ante_label,
-                    linestyle="--")
-        ax.plot(np.cumprod(1.0 + replication[:, j]) - 1.0, label=labels[0])
-        ax.plot(np.cumprod(1.0 + actual[:, j]) - 1.0, label=labels[1])
+            ax.plot(cum(ante[:, j]), label=ante_label,
+                    linestyle="--", color="C2")
+        ax.plot(cum(replication[:, j]), label=labels[0], color="C0")
+        ax.plot(cum(actual[:, j]), label=labels[1], color="C1")
         ax.set_title(names[j], fontsize=9)
 
     return _panel_grid(replication.shape[1], ncols, (4.2, 3.0), draw, path)

@@ -255,8 +255,24 @@ def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto",
                     with warnings.catch_warnings(record=True) as caught:
                         warnings.simplefilter("always")
                         res = ks_2samp(r[:, j], f[:, j], method="exact")
-                    if any("exact" in str(c.message).lower() for c in caught):
-                        out.append(_exact_ks2_pvalue(n, m, float(res.statistic)))
+                    # Trust scipy's p-value only when (a) it did not
+                    # announce its silent exact→asymp switch (a
+                    # RuntimeWarning naming ks_2samp — matched by
+                    # category + origin, not a generic message substring)
+                    # and (b) its statistic agrees with ours (tie/ECDF
+                    # convention drift would otherwise pair our statistic
+                    # with a different distribution's p-value).  Either
+                    # failure rescues the column through the
+                    # overflow-proof DP on OUR statistic.
+                    switched = any(
+                        issubclass(c.category, RuntimeWarning)
+                        and "ks_2samp" in str(c.message)
+                        for c in caught)
+                    stat_ours = float(stats[j])
+                    stat_ok = (abs(float(res.statistic) - stat_ours)
+                               <= 1e-9 + 1e-6 * abs(stat_ours))
+                    if switched or not stat_ok:
+                        out.append(_exact_ks2_pvalue(n, m, stat_ours))
                     else:
                         out.append(float(res.pvalue))
                 return np.array(out)
@@ -460,14 +476,23 @@ class GanEval:
     def wasserstein(self):
         return float(wasserstein(self.real, self.fake))
 
-    def run_all(self, verbose: bool = False) -> Dict[str, float]:
+    def run_all(self, verbose: bool = False,
+                eyeball: Optional[str] = None) -> Dict[str, float]:
         """Evaluate all 12 metrics (``GAN_eval.py:447-458``; alphabetical,
-        matching the reference's ``dir(self)`` reflection order)."""
+        matching the reference's ``dir(self)`` reflection order).
+
+        ``eyeball`` (a path) additionally renders the ECDF grid after the
+        metrics — the reference's ``run_all`` unconditionally auto-invokes
+        ``self.eyeball()`` as its last act (``GAN_eval.py:457``); here the
+        plot goes to a file (offline-report style), and omitting the path
+        skips it, since a metric sweep usually wants numbers only."""
         res = {}
         for i, name in enumerate(self.METRICS):
             res[name] = getattr(self, name)()
             if verbose:
                 print(f"{i + 1} out of {len(self.METRICS)} done.")
+        if eyeball:
+            self.eyeball(eyeball)
         return res
 
     def to_frame(self, res: Optional[Dict[str, float]] = None):

@@ -271,3 +271,15 @@ class TestSuite:
         out = suite.eyeball(str(tmp_path / "ecdf.png"))
         import os
         assert os.path.getsize(out) > 0
+
+    def test_run_all_eyeball_parity(self, cubes, tmp_path):
+        """The reference's run_all auto-invokes eyeball() as its last act
+        (GAN_eval.py:457); run_all(eyeball=path) reproduces that with the
+        plot landing in a file."""
+        import os
+        real, fake, dataset = cubes
+        suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
+        path = str(tmp_path / "run_all_ecdf.png")
+        res = suite.run_all(eyeball=path)
+        assert set(res) == set(ge.GanEval.METRICS)
+        assert os.path.getsize(path) > 0
