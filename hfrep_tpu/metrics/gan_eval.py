@@ -269,8 +269,12 @@ def _ks_pvalues(stats: np.ndarray, n: int, m: int, method: str = "auto",
                         and "ks_2samp" in str(c.message)
                         for c in caught)
                     stat_ours = float(stats[j])
+                    # absolute term sized to the f32 statistic's rounding
+                    # (the ECDF differences are computed on device in f32;
+                    # scipy's are exact f64) so the guard trips on real
+                    # convention drift, not on precision noise
                     stat_ok = (abs(float(res.statistic) - stat_ours)
-                               <= 1e-9 + 1e-6 * abs(stat_ours))
+                               <= 2e-7 + 1e-6 * abs(stat_ours))
                     if switched or not stat_ok:
                         out.append(_exact_ks2_pvalue(n, m, stat_ours))
                     else:

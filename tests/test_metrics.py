@@ -253,12 +253,18 @@ class TestMetricOracles:
 
 
 class TestSuite:
-    def test_run_all_smoke(self, cubes):
+    def test_run_all_smoke(self, cubes, tmp_path):
+        """One sweep covers both contracts: the 12-metric dict AND the
+        reference's auto-invoked eyeball (GAN_eval.py:457), which
+        run_all(eyeball=path) renders to a file."""
+        import os
         real, fake, dataset = cubes
         suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
-        res = suite.run_all()
+        path = str(tmp_path / "run_all_ecdf.png")
+        res = suite.run_all(eyeball=path)
         assert set(res) == set(ge.GanEval.METRICS)
         assert all(np.isfinite(v) for v in res.values())
+        assert os.path.getsize(path) > 0
 
     def test_shape_mismatch_raises(self, cubes):
         real, fake, dataset = cubes
@@ -272,14 +278,3 @@ class TestSuite:
         import os
         assert os.path.getsize(out) > 0
 
-    def test_run_all_eyeball_parity(self, cubes, tmp_path):
-        """The reference's run_all auto-invokes eyeball() as its last act
-        (GAN_eval.py:457); run_all(eyeball=path) reproduces that with the
-        plot landing in a file."""
-        import os
-        real, fake, dataset = cubes
-        suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
-        path = str(tmp_path / "run_all_ecdf.png")
-        res = suite.run_all(eyeball=path)
-        assert set(res) == set(ge.GanEval.METRICS)
-        assert os.path.getsize(path) > 0

@@ -187,6 +187,31 @@ def section_train(mesh):
     check("sp train g_params", leaf(states["pallas"]), leaf(states["xla"]),
           1e-3)
 
+    # dp×sp MANUAL mode with the carry kernels, on real hardware: a 1×1
+    # ('dp','sp') mesh compiles the composed step's per-device body —
+    # chunk slicing, masked-psum reassembly, kernel-mode match_vma casts
+    # in a 2-D manual context — none of which the CPU suite can reach
+    # (interpret-mode pallas can't propagate vma).  Trajectory must land
+    # on the plain step's (multi-chip layout is pinned on the virtual
+    # mesh; the kernels' arithmetic is what needs the chip).
+    print("make_dp_sp_train_step 1x1 mesh, pallas chunks (manual mode)")
+    from jax.sharding import Mesh
+
+    from hfrep_tpu.parallel.dp_sp import make_dp_sp_train_step
+    from hfrep_tpu.train.steps import make_train_step
+
+    tcfg = TrainConfig(batch_size=8, n_critic=2, lstm_backend="pallas")
+    mesh2d = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    st2, m2 = make_dp_sp_train_step(pair, tcfg, dataset, mesh2d,
+                                    controlled_sampling=True)(
+        init_gan_state(jax.random.PRNGKey(6), mcfg, tcfg, pair),
+        jax.random.PRNGKey(7))
+    pst, pm = jax.jit(make_train_step(pair, tcfg, dataset))(
+        init_gan_state(jax.random.PRNGKey(6), mcfg, tcfg, pair),
+        jax.random.PRNGKey(7))
+    check("dp_sp manual-pallas d_loss", m2["d_loss"], pm["d_loss"], 1e-3)
+    check("dp_sp manual-pallas g_params", leaf(st2), leaf(pst), 1e-3)
+
 
 def section_speed(mesh, sp_lstm):
     """Long-window generator traversal, chunk kernels vs scan."""
