@@ -265,6 +265,10 @@ class TestSuite:
         assert set(res) == set(ge.GanEval.METRICS)
         assert all(np.isfinite(v) for v in res.values())
         assert os.path.getsize(path) > 0
+        # default path must NOT render (headless metric sweeps rely on it)
+        suite.eyeball = lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("eyeball invoked without a path"))
+        assert set(suite.run_all()) == set(ge.GanEval.METRICS)
 
     def test_shape_mismatch_raises(self, cubes):
         real, fake, dataset = cubes
