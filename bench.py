@@ -63,6 +63,28 @@ def load_dataset(mcfg: ModelConfig, include_rf: bool = False) -> jnp.ndarray:
             jax.random.PRNGKey(0), (1000, mcfg.window, mcfg.features), jnp.float32)
 
 
+def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
+                 steps_per_call: int) -> float:
+    """The ONE timing harness every measurement shares: state-threaded
+    calls with distinct keys (nothing to dedup server-side), ``n_warmups``
+    untimed dispatches (compile, plus the donated-state retrace on
+    resharded paths), and a ``device_get`` of the final metrics as the
+    fence — `block_until_ready` does not reliably fence on the tunneled
+    backend (RESULTS.md measurement traps), but the calls chain through
+    the donated state, so materializing the last loss forces them all."""
+    for i in range(n_warmups):
+        state, metrics = multi(state, jax.random.fold_in(key, i))
+        float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
+    t0 = time.perf_counter()
+    for i in range(n_warmups, n_warmups + n_calls):
+        state, metrics = multi(state, jax.random.fold_in(key, i))
+    float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
+    dt = time.perf_counter() - t0
+    for v in metrics.values():
+        assert jnp.isfinite(v).all()
+    return n_calls * steps_per_call / dt
+
+
 def measure(mcfg: ModelConfig, include_rf: bool, n_calls: int) -> float:
     tcfg = TrainConfig(steps_per_call=50)
     dataset = load_dataset(mcfg, include_rf)
@@ -70,26 +92,16 @@ def measure(mcfg: ModelConfig, include_rf: bool, n_calls: int) -> float:
     key = jax.random.PRNGKey(tcfg.seed)
     state = init_gan_state(key, mcfg, tcfg, pair)
     multi = make_multi_step(pair, tcfg, dataset)
-
-    # Warmup: compile + one full dispatch.
-    state, metrics = multi(state, jax.random.fold_in(key, 0))
-    jax.block_until_ready(metrics)
-
-    t0 = time.perf_counter()
-    for i in range(1, n_calls + 1):
-        state, metrics = multi(state, jax.random.fold_in(key, i))
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-
-    assert jnp.isfinite(metrics["d_loss"]).all() and jnp.isfinite(metrics["g_loss"]).all()
-    return n_calls * tcfg.steps_per_call / dt
+    return _timed_multi(multi, state, key, 1, n_calls, tcfg.steps_per_call)
 
 
 def measure_dp(n_calls: int) -> float:
     """The distributed path on real hardware: the same flagship epoch
     through `make_dp_multi_step` (shard_map over a Mesh of the available
     chips — dp=1 on a single-chip host, where the delta vs the plain jit
-    number is pure shard_map/collective overhead)."""
+    number is pure shard_map/collective overhead).  TWO warmups: the
+    first compile runs with unsharded inputs, the second retraces once
+    the state carries its mesh sharding."""
     from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
     from hfrep_tpu.parallel.mesh import make_mesh
 
@@ -99,22 +111,30 @@ def measure_dp(n_calls: int) -> float:
     pair = build_gan(mcfg)
     key = jax.random.PRNGKey(tcfg.seed)
     state = init_gan_state(key, mcfg, tcfg, pair)
-    mesh = make_mesh()
-    multi = make_dp_multi_step(pair, tcfg, dataset, mesh)
+    multi = make_dp_multi_step(pair, tcfg, dataset, make_mesh())
+    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call)
 
-    # TWO warmup calls: the first compile runs with unsharded inputs, the
-    # second retraces once the state carries its mesh sharding — timing
-    # from the third call on measures steady state only.
-    state, metrics = multi(state, jax.random.fold_in(key, 0))
-    state, metrics = multi(state, jax.random.fold_in(key, 1))
-    jax.block_until_ready(metrics)
-    t0 = time.perf_counter()
-    for i in range(2, n_calls + 2):
-        state, metrics = multi(state, jax.random.fold_in(key, i))
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-    assert jnp.isfinite(metrics["d_loss"]).all() and jnp.isfinite(metrics["g_loss"]).all()
-    return n_calls * tcfg.steps_per_call / dt
+
+def measure_sp(n_calls: int) -> float:
+    """The window-sharded (sequence-parallel) epoch at the production
+    shape — `make_sp_multi_step` on a 1-device ('sp',) mesh, the same
+    program a pod runs per chip.  Reported so the sp tax vs the plain
+    prod number is regression-tracked in the bench artifact (RESULTS.md
+    'Sequence-parallel pallas chunks': 7.5 vs 6.0 ms/epoch)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from hfrep_tpu.parallel.sequence import make_sp_multi_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", window=168, features=36)
+    tcfg = TrainConfig(steps_per_call=50)
+    dataset = load_dataset(mcfg, True)
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(tcfg.seed)
+    state = init_gan_state(key, mcfg, tcfg, pair)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
+    multi = make_sp_multi_step(pair, tcfg, dataset, mesh)
+    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call)
 
 
 def main() -> None:
@@ -126,10 +146,10 @@ def main() -> None:
     prod = measure(
         ModelConfig(family="mtss_wgan_gp", window=168, features=36), True,
         n_calls=10)
-    # The dp measurement costs two more compiles (~90 s through the
-    # tunnel); skip it rather than risk losing the whole JSON line to a
+    # The dp/sp measurements cost extra compiles (~90 s each through the
+    # tunnel); skip rather than risk losing the whole JSON line to a
     # driver timeout on a slow-compile day.
-    dp = None
+    dp = sp = None
     if time.perf_counter() - t_start < 300:
         try:
             dp = round(measure_dp(n_calls=10), 3)
@@ -137,6 +157,13 @@ def main() -> None:
             print(f"bench: dp measurement failed ({e!r})", file=sys.stderr)
     else:
         print("bench: skipping dp measurement (time budget)", file=sys.stderr)
+    if time.perf_counter() - t_start < 360:
+        try:
+            sp = round(measure_sp(n_calls=10), 3)
+        except Exception as e:  # likewise for the sp line
+            print(f"bench: sp measurement failed ({e!r})", file=sys.stderr)
+    else:
+        print("bench: skipping sp measurement (time budget)", file=sys.stderr)
 
     print(json.dumps({
         "metric": "mtss_wgan_gp_train_steps_per_sec",
@@ -146,6 +173,7 @@ def main() -> None:
         "vs_tf_unpinned": round(steps / TF_UNPINNED_EPOCHS_PER_SEC, 2),
         "prod_168x36_steps_per_sec": round(prod, 3),
         "dp_shard_map_steps_per_sec": dp,
+        "sp_prod_steps_per_sec": sp,
         "dp_devices": len(jax.devices()),
     }))
 
