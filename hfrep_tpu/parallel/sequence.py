@@ -420,7 +420,7 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                                      microbatches=microbatches,
                                      backend=backend)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
-    return jax.jit(step, donate_argnums=(0,)) if jit else step
+    return _jit_replicated_out(step, mesh) if jit else step
 
 
 def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -440,7 +440,23 @@ def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     step = make_sp_train_step(pair, tcfg, dataset, mesh,
                               axis_name=axis_name,
                               microbatches=microbatches, jit=False)
-    return make_multi_step(pair, tcfg, dataset, jit=jit, step=step)
+    multi = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
+    return _jit_replicated_out(multi, mesh) if jit else multi
+
+
+def _jit_replicated_out(fn, mesh: Mesh):
+    """jit with the (state, metrics) outputs pinned REPLICATED over the
+    mesh.  The sp step's state is logically replicated (every update is
+    computed from window-summed gradients), but an unconstrained jit
+    lets GSPMD pick output layouts, and with window-sharded
+    intermediates it may leave param leaves sharded — harmless on one
+    host, but on a multi-host mesh the trainer's checkpoint
+    `device_get` then faces non-addressable arrays.  Pinning P() makes
+    the replication a compiled fact.  Inputs are pinned identically so
+    the donated state's layout always matches the output it aliases."""
+    rep = NamedSharding(mesh, P())
+    return jax.jit(fn, donate_argnums=(0,),
+                   in_shardings=(rep, rep), out_shardings=(rep, rep))
 
 
 def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,

@@ -226,6 +226,34 @@ SP_CHILD = textwrap.dedent("""
         "g_leaf0_sum": float(jnp.sum(g0)),
         "d_leaf0_sum": float(jnp.sum(d0)),
     }), flush=True)
+
+    # the TRAINER on the pod-wide sp mesh: spans_processes promotes
+    # state/key to global arrays, the window-sharded multi-step runs the
+    # schedule, the leader writes the checkpoint, every process restores
+    # and resumes — the full round-4 sp-trainer wiring, multi-host.
+    import dataclasses
+    from jax.experimental import multihost_utils
+    from hfrep_tpu.config import ExperimentConfig
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    cfg = ExperimentConfig(model=mcfg, train=dataclasses.replace(
+        tcfg, epochs=4, steps_per_call=2))
+    tr = GanTrainer(cfg, dataset, mesh=mesh)
+    tr.train()
+    assert int(tr.state.step) == 4
+    ckpt_path = os.path.join(sys.argv[3], "ckpt_sp_4")
+    tr.save_checkpoint(ckpt_path)
+    multihost_utils.sync_global_devices("sp_ckpt_written")
+    assert os.path.exists(ckpt_path)
+    tr2 = GanTrainer(cfg, dataset, mesh=mesh)
+    tr2.restore_checkpoint(ckpt_path)
+    tr2.train(epochs=2)
+    assert int(tr2.state.step) == 6
+    print("TRAINER " + json.dumps({
+        "process": pid,
+        "g_loss": tr.history[-1]["g_loss"],
+        "resumed_g_loss": tr2.history[-1]["g_loss"],
+    }), flush=True)
 """)
 
 
@@ -340,22 +368,34 @@ def test_two_process_sp_matches_single_device(tmp_path):
     env = {**os.environ,
            "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
            "JAX_PLATFORMS": ""}
-    procs = [subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+    ckpt_dir = tmp_path / "ckpts"
+    ckpt_dir.mkdir()
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid), str(port),
+                               str(ckpt_dir)],
                               stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                               env=env, text=True)
              for pid in (0, 1)]
-    results = {}
+    results, trainer_results = {}, {}
     for p in procs:
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, f"sp child failed:\n{out}\n{err}"
         line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
         r = json.loads(line[len("RESULT "):])
         results[r["process"]] = r
+        tline = [l for l in out.splitlines() if l.startswith("TRAINER ")][-1]
+        t = json.loads(tline[len("TRAINER "):])
+        trainer_results[t["process"]] = t
     assert set(results) == {0, 1}
     np.testing.assert_allclose(results[0]["d_loss"], results[1]["d_loss"],
                                rtol=1e-6)
     np.testing.assert_allclose(results[0]["g_leaf0_sum"],
                                results[1]["g_leaf0_sum"], rtol=1e-6)
+    # sp TRAINER path (schedule + leader checkpoint + resume) agreed
+    # across processes
+    np.testing.assert_allclose(trainer_results[0]["g_loss"],
+                               trainer_results[1]["g_loss"], rtol=1e-6)
+    np.testing.assert_allclose(trainer_results[0]["resumed_g_loss"],
+                               trainer_results[1]["resumed_g_loss"], rtol=1e-6)
 
     # trajectory oracle: the plain single-device multi-step at the same key
     from hfrep_tpu.config import ModelConfig, TrainConfig
