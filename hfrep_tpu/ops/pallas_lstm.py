@@ -92,6 +92,17 @@ VMEM_BUDGET_BYTES = 16 * 2**20
 """Mosaic's scoped-vmem bound as measured on the v5e (RESULTS.md: the
 H=512 f32 adjoint OOMs wanting ~20 MB against a 16 MB limit)."""
 
+FUSED_STACK_BUDGET_BYTES = 8 * 2**20
+"""The fused two-layer stack's *preference* threshold — half the
+feasibility budget.  Fusion is an optimization, not a capacity need
+(per-layer kernels always remain available below their own ceiling),
+and it stops paying well before it stops fitting: measured on chip
+(RESULTS.md round 4), the fused stack wins at Hp=128 (~3-3.75 MB
+resident, +4% over per-layer, both dtypes) and LOSES at Hp=256
+(12.6-15.7 MB, −7% both dtypes) — the near-budget residency squeezes
+the compiler's scheduling headroom.  8 MB separates the two measured
+regimes."""
+
 
 def adjoint_vmem_bytes(hidden: int, eff_dtype, layers: int = 1) -> int:
     """VMEM residency of the heaviest kernel on the dispatch path — the
@@ -121,17 +132,23 @@ def kernel_eligible(backend, eff_dtype, hidden: int = None,
     * operand dtype f32 or bf16 — the kernels stream either (f32
       scratch/gate math/accumulation in both cases); other dtypes take
       the scan path so configured precision is honored;
-    * the adjoint's VMEM residency fits the measured scoped-vmem bound
-      (round-3 finding: the default ``auto`` dispatch OOM'd at H=512
-      f32 instead of falling back — shape-blind eligibility was the
-      bug).  ``hidden=None`` (legacy callers) keeps the flagship-size
-      behavior: eligible, since H≤256 fits in every configuration.
+    * the adjoint's VMEM residency fits the relevant budget.  For
+      single-layer kernels (``layers=1``) that is the measured
+      scoped-vmem bound — feasibility (round-3 finding: the default
+      ``auto`` dispatch OOM'd at H=512 f32 instead of falling back;
+      shape-blind eligibility was the bug).  For the FUSED stack
+      (``layers=2``) it is the tighter *preference* threshold
+      :data:`FUSED_STACK_BUDGET_BYTES`: past it the fusion measures
+      slower than the per-layer kernels it would replace, so the caller
+      falls through to chained per-layer dispatch.  ``hidden=None``
+      (legacy callers) keeps the flagship-size behavior: eligible.
     """
     if backend != "pallas" or eff_dtype not in (jnp.float32, jnp.bfloat16):
         return False
     if hidden is None:
         return True
-    return adjoint_vmem_bytes(hidden, eff_dtype, layers) <= VMEM_BUDGET_BYTES
+    budget = VMEM_BUDGET_BYTES if layers == 1 else FUSED_STACK_BUDGET_BYTES
+    return adjoint_vmem_bytes(hidden, eff_dtype, layers) <= budget
 
 
 def pad_keras_params(params: dict, h: int, hp: int) -> tuple:

@@ -147,17 +147,21 @@ class TestVmemCeiling:
 
         f32, bf16 = jnp.float32, jnp.bfloat16
         assert kernel_eligible("pallas", f32, hidden=100, layers=1)
-        assert kernel_eligible("pallas", f32, hidden=100, layers=2)   # flagship critic
-        assert kernel_eligible("pallas", f32, hidden=256, layers=2)   # measured fits
+        assert kernel_eligible("pallas", f32, hidden=100, layers=2)   # fusion wins @128
+        assert kernel_eligible("pallas", bf16, hidden=100, layers=2)
+        # Hp=256 stacks FIT the scoped-vmem bound but measure ~7% slower
+        # fused than per-layer (both dtypes, RESULTS round 4): the
+        # preference threshold says don't fuse — callers fall through to
+        # per-layer kernels, which remain eligible
+        assert not kernel_eligible("pallas", f32, hidden=256, layers=2)
+        assert not kernel_eligible("pallas", bf16, hidden=256, layers=2)
+        assert kernel_eligible("pallas", f32, hidden=256, layers=1)
         assert not kernel_eligible("pallas", f32, hidden=512)         # measured OOM
         assert not kernel_eligible("pallas", f32, hidden=512, layers=2)
         assert not kernel_eligible("pallas", f32, hidden=384, layers=2)
         assert kernel_eligible("pallas", f32, hidden=384, layers=1)
-        # bf16 halves the primal matrices (the f32 cotangent streams
-        # dominate the stack, so its ceiling moves less)
+        # bf16 halves the primal matrices: higher single-layer ceiling
         assert kernel_eligible("pallas", bf16, hidden=384, layers=1)
-        assert kernel_eligible("pallas", bf16, hidden=256, layers=2)
-        assert not kernel_eligible("pallas", bf16, hidden=384, layers=2)
         # other dtypes still take the scan path
         assert not kernel_eligible("pallas", jnp.float16, hidden=100)
         assert not kernel_eligible("xla", f32, hidden=100)
