@@ -1,6 +1,7 @@
 from hfrep_tpu.parallel.mesh import (  # noqa: F401
     initialize_distributed,
     make_mesh,
+    make_mesh_2d,
     replicate_to_global,
     spans_processes,
 )
@@ -15,4 +16,5 @@ from hfrep_tpu.parallel.sequence import (  # noqa: F401
     sp_critic,
     sp_generate,
     sp_lstm,
+    sp_microbatch_plan,
 )
