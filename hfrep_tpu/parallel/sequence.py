@@ -140,16 +140,20 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                                                lstm_seq_carry,
                                                pad_keras_params)
         _supported(activation, recurrent_activation)
+        if jax.default_backend() != "tpu":
+            raise NotImplementedError(
+                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
+                "pallas cannot propagate vma under shard_map(check_vma)")
+        if x.dtype != jnp.float32:
+            # a pallas backend request with an unsupported dtype must raise,
+            # not silently run scan chunks; only the width gate below falls
+            # back quietly.  (The framework's sp/dp×sp steps can't get here
+            # — validate_sp_pair pins f32 before the backend resolves.)
+            raise NotImplementedError("sp_lstm pallas backend runs f32")
         if not kernel_eligible("pallas", x.dtype, hidden=max(h_dims)):
             # measured VMEM ceiling (ops/pallas_lstm.py): oversized widths
             # take the scan chunks instead of OOMing in the carry adjoint
             use_kernel = False
-        elif jax.default_backend() != "tpu":
-            raise NotImplementedError(
-                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
-                "pallas cannot propagate vma under shard_map(check_vma)")
-        elif x.dtype != jnp.float32:
-            raise NotImplementedError("sp_lstm pallas backend runs f32")
     if use_kernel:
         hp = [((h + LANE - 1) // LANE) * LANE for h in h_dims]
         lay = []

@@ -124,11 +124,15 @@ class MultiSeedTrainer:
 
         * ``None`` (default) — vmap row-packing on one device (the
           measured-negative-throughput mode; kept as the single-device
-          behavior and the only option when devices < members).
+          behavior and the fallback when no usable seed mesh exists).
         * a 1-D ``('seed',)`` :class:`jax.sharding.Mesh` — one member
           (or K/n) per device via :func:`make_seed_sharded_step`.
-        * ``"auto"`` — seed-sharded over ``len(seeds)`` devices when the
-          host has that many, else vmap.
+        * ``"auto"`` — single-process hosts only: seed-sharded over the
+          largest mesh size n > 1 with ``K % n == 0`` and n ≤ devices
+          (K/n members vmapped within each device), else vmap.  On a
+          multi-process pod auto stays vmap — this trainer's states are
+          host-local arrays, so a process-spanning mesh must be the
+          caller's explicit, ``replicate_to_global``-style decision.
         """
         self.cfg = cfg
         self.seeds = tuple(seeds)
@@ -138,11 +142,19 @@ class MultiSeedTrainer:
         self.pair = build_gan(cfg.model)
         if mesh == "auto":
             mesh = None
-            if 1 < len(self.seeds) <= len(jax.devices()):
-                import numpy as np
-                from jax.sharding import Mesh
-                mesh = Mesh(np.asarray(jax.devices()[:len(self.seeds)]),
-                            ("seed",))
+            k = len(self.seeds)
+            # largest usable seed mesh: K % n == 0 (shard_map requirement),
+            # n > 1 (a 1-device mesh is vmap with extra steps); K > devices
+            # runs K/n members vmapped within each device.  Single-process
+            # only: this trainer holds host-local arrays, so auto must not
+            # build a process-spanning mesh behind the caller's back.
+            if jax.process_count() == 1:
+                n = max((d for d in range(2, min(k, len(jax.devices())) + 1)
+                         if k % d == 0), default=0)
+                if n:
+                    import numpy as np
+                    from jax.sharding import Mesh
+                    mesh = Mesh(np.asarray(jax.devices()[:n]), ("seed",))
         if mesh is not None and len(self.seeds) % mesh.devices.size:
             raise ValueError(
                 f"{len(self.seeds)} members not divisible by the "

@@ -385,3 +385,21 @@ def test_sp_pallas_requires_tpu():
     with pytest.raises(NotImplementedError, match="real TPU"):
         sp_lstm(params["kernel"], params["recurrent_kernel"], params["bias"],
                 x, _mesh(8), activation="sigmoid", backend="pallas")
+
+
+def test_sp_pallas_unsupported_dtype_raises():
+    """An EXPLICIT pallas backend request with an unsupported dtype must
+    raise, not silently run the scan chunks — only the VMEM width gate
+    is allowed to fall back quietly (on TPU the f16 call hits the
+    dtype raise; off-TPU it hits the real-TPU raise first; either way
+    the user is told the kernels did not run)."""
+    from hfrep_tpu.ops.lstm import KerasLSTM
+
+    mod = KerasLSTM(8, activation="sigmoid")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 5))
+    params = mod.init(jax.random.PRNGKey(1), x)["params"]
+    x16 = x.astype(jnp.float16)
+    p16 = jax.tree.map(lambda a: a.astype(jnp.float16), params)
+    with pytest.raises(NotImplementedError, match="sp_lstm"):
+        sp_lstm(p16["kernel"], p16["recurrent_kernel"], p16["bias"],
+                x16, _mesh(8), activation="sigmoid", backend="pallas")

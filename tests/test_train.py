@@ -405,10 +405,28 @@ class TestMultiSeed:
                 MultiSeedTrainer(cfg, dataset, (0, 1, 2),
                                  mesh=Mesh(np.asarray(jax.devices()[:4]),
                                            ("seed",)))
-        # auto: members <= devices -> sharded; more members than devices
-        # -> vmap fallback
+        # auto: members <= devices -> sharded over K devices; K > devices
+        # -> largest divisor of K that fits (K/n members vmapped within
+        # each device); no divisor > 1 -> vmap fallback
         mst = MultiSeedTrainer(cfg, dataset, (0, 1), mesh="auto")
         assert (mst.mesh is not None) == (len(jax.devices()) >= 2)
-        many = tuple(range(len(jax.devices()) + 1))
-        mst2 = MultiSeedTrainer(cfg, dataset, many, mesh="auto")
-        assert mst2.mesh is None
+        n_dev = len(jax.devices())
+        if n_dev >= 2:
+            many = tuple(range(2 * n_dev))          # K = 2·D uses all D
+            mst2 = MultiSeedTrainer(cfg, dataset, many, mesh="auto")
+            assert mst2.mesh is not None
+            assert mst2.mesh.devices.size == n_dev
+            # the K > n path (inner vmap of 2 per device) must stay
+            # member-exact vs the single-device vmap mode
+            ref = MultiSeedTrainer(cfg, dataset, many, mesh=None)
+            mst2.train(3)
+            ref.train(3)
+            for la, lb in zip(
+                    jax.tree_util.tree_leaves(mst2.states.g_params),
+                    jax.tree_util.tree_leaves(ref.states.g_params)):
+                np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                           rtol=0, atol=1e-6)
+        if n_dev < 11:
+            # prime K above the device count has no usable divisor
+            assert MultiSeedTrainer(cfg, dataset, tuple(range(11)),
+                                    mesh="auto").mesh is None

@@ -43,8 +43,9 @@ def main(out="results/family_eval.json", seeds: int = 1):
             epochs = tr.epoch
         else:
             from hfrep_tpu.train.multi_seed import MultiSeedTrainer
-            # "auto": one member per device when the host has >= K devices
-            # (linear aggregate scaling); vmap row-packing otherwise (the
+            # "auto": seed-sharded over the largest divisor of K that fits
+            # the host's devices (linear aggregate scaling, K/n members
+            # vmapped per device); vmap row-packing when no mesh fits (the
             # single-chip case here — measured 0.21x/model at K=4).
             mst = MultiSeedTrainer(cfg, ds,
                                    [cfg.train.seed + k for k in range(seeds)],
