@@ -50,6 +50,15 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="composed 2-D mesh, e.g. 2x4: batch sharded over "
                         "dp AND window sharded over sp in one step "
                         "(parallel/dp_sp.py)")
+    t.add_argument("--tp-mesh", type=int, default=None, metavar="N",
+                   help="tensor-parallel: every LSTM layer's hidden units "
+                        "sharded over the first N devices (the wide-model "
+                        "path, parallel/tensor.py; hidden width must "
+                        "divide by N; flagship mtss_wgan_gp only)")
+    t.add_argument("--dp-tp", default=None, metavar="DPxTP",
+                   help="composed 2-D mesh, e.g. 2x4: batch sharded over "
+                        "dp AND hidden units sharded over tp in one step "
+                        "(parallel/tensor.py)")
     t.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port — every "
                         "process runs this same command with its own "
@@ -139,9 +148,10 @@ def cmd_clean(args) -> int:
 
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
                   mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
-                  sp_mesh=False, dp_sp=None):
-    if sum(map(bool, (mesh, sp_mesh, dp_sp))) > 1:
-        raise SystemExit("--mesh, --sp-mesh and --dp-sp are mutually exclusive")
+                  sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None):
+    if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp))) > 1:
+        raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh and --dp-tp "
+                         "are mutually exclusive")
     import jax
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
@@ -165,6 +175,19 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
         except ValueError:
             raise SystemExit(f"--dp-sp wants DPxSP (e.g. 2x4), got {dp_sp!r}")
         device_mesh = make_mesh_2d(n_dp, n_sp)
+    elif tp_mesh is not None:
+        if tp_mesh < 1:
+            raise SystemExit(f"--tp-mesh wants N >= 1 devices, got {tp_mesh}")
+        from hfrep_tpu.config import MeshConfig
+        from hfrep_tpu.parallel import make_mesh
+        device_mesh = make_mesh(MeshConfig(dp=tp_mesh, axis_name="tp"))
+    elif dp_tp:
+        from hfrep_tpu.parallel.mesh import make_mesh_2d
+        try:
+            n_dp, n_tp = (int(v) for v in dp_tp.lower().split("x"))
+        except ValueError:
+            raise SystemExit(f"--dp-tp wants DPxTP (e.g. 2x4), got {dp_tp!r}")
+        device_mesh = make_mesh_2d(n_dp, n_tp, axis_names=("dp", "tp"))
 
     cfg = get_preset(preset)
     if checkpoint_dir:
@@ -189,13 +212,14 @@ def cmd_train_gan(args) -> int:
         from hfrep_tpu.parallel.mesh import initialize_distributed
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
-        if not (args.sp_mesh or args.dp_sp):
+        if not (args.sp_mesh or args.dp_sp or args.tp_mesh or args.dp_tp):
             args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,
         args.quiet, nan_guard=args.nan_guard,
         max_recoveries=args.max_recoveries,
-        sp_mesh=args.sp_mesh, dp_sp=args.dp_sp)
+        sp_mesh=args.sp_mesh, dp_sp=args.dp_sp,
+        tp_mesh=args.tp_mesh, dp_tp=args.dp_tp)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest

@@ -18,3 +18,11 @@ from hfrep_tpu.parallel.sequence import (  # noqa: F401
     sp_lstm,
     sp_microbatch_plan,
 )
+from hfrep_tpu.parallel.tensor import (  # noqa: F401
+    make_dp_tp_multi_step,
+    make_dp_tp_train_step,
+    make_tp_multi_step,
+    make_tp_train_step,
+    tp_critic,
+    tp_generate,
+)

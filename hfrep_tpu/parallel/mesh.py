@@ -27,24 +27,31 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     devices = list(devices) if devices is not None else jax.devices()
     n = cfg.dp if cfg.dp > 0 else len(devices)
     if n > len(devices):
-        raise ValueError(f"requested dp={n} but only {len(devices)} devices present")
+        raise ValueError(f"requested {cfg.axis_name}={n} but only "
+                         f"{len(devices)} devices present")
     return Mesh(np.asarray(devices[:n]), (cfg.axis_name,))
 
 
 def make_mesh_2d(dp: int, sp: int,
-                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """The composed ``('dp', 'sp')`` mesh for dp×sp training
-    (:mod:`hfrep_tpu.parallel.dp_sp`): ``dp·sp`` devices as a dp×sp grid.
-    On a real pod, lay dp outermost so the sp carry ppermutes ride
-    neighbouring ICI links (the default device order already does for
-    tori)."""
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 axis_names: Sequence[str] = ("dp", "sp")) -> Mesh:
+    """A composed 2-D mesh: ``dp·sp`` devices as a dp×<inner> grid —
+    ``('dp', 'sp')`` for dp×sp training (:mod:`hfrep_tpu.parallel.dp_sp`,
+    the default) or ``('dp', 'tp')`` for dp×tp
+    (:mod:`hfrep_tpu.parallel.tensor`).  On a real pod, lay dp outermost
+    so the inner axis's collectives (sp carry ppermutes / tp hidden-state
+    all_gathers) ride neighbouring ICI links (the default device order
+    already does for tori)."""
+    names = tuple(axis_names)
     if dp < 1 or sp < 1:
-        raise ValueError(f"dp×sp mesh dims must be >= 1, got {dp}×{sp}")
+        raise ValueError(
+            f"{names[0]}×{names[1]} mesh dims must be >= 1, got {dp}×{sp}")
     devices = list(devices) if devices is not None else jax.devices()
     if dp * sp > len(devices):
         raise ValueError(
-            f"requested dp×sp={dp}×{sp} but only {len(devices)} devices present")
-    return Mesh(np.asarray(devices[:dp * sp]).reshape(dp, sp), ("dp", "sp"))
+            f"requested {names[0]}×{names[1]}={dp}×{sp} but only "
+            f"{len(devices)} devices present")
+    return Mesh(np.asarray(devices[:dp * sp]).reshape(dp, sp), names)
 
 
 def initialize_distributed(coordinator: Optional[str] = None,

@@ -45,7 +45,10 @@ class GanTrainer:
             #   ('sp',)       window sharding      (sequence.py) — the
             #                 long-window path, now with the trainer's
             #                 full checkpoint/resume/nan-guard/logging
-            #   ('dp', 'sp')  both, one 2-D mesh   (dp_sp.py)
+            #   ('tp',)       hidden-unit sharding (tensor.py) — the
+            #                 wide-model path
+            #   ('dp', 'sp')  batch + window, one 2-D mesh (dp_sp.py)
+            #   ('dp', 'tp')  batch + width, one 2-D mesh  (tensor.py)
             from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
             names = tuple(mesh.axis_names)
             if names == ("dp",):
@@ -54,13 +57,19 @@ class GanTrainer:
             elif names == ("sp",):
                 from hfrep_tpu.parallel.sequence import make_sp_multi_step
                 self._multi = make_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            elif names == ("tp",):
+                from hfrep_tpu.parallel.tensor import make_tp_multi_step
+                self._multi = make_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
             elif names == ("dp", "sp"):
                 from hfrep_tpu.parallel.dp_sp import make_dp_sp_multi_step
                 self._multi = make_dp_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            elif names == ("dp", "tp"):
+                from hfrep_tpu.parallel.tensor import make_dp_tp_multi_step
+                self._multi = make_dp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
             else:
                 raise ValueError(
                     f"mesh axis names {names} not recognized; use ('dp',), "
-                    "('sp',), or ('dp', 'sp')")
+                    "('sp',), ('tp',), ('dp', 'sp'), or ('dp', 'tp')")
             if spans_processes(mesh):
                 # multi-host: promote the (identically-seeded) state and
                 # key to replicated global arrays for the pod-wide jit
@@ -233,9 +242,17 @@ class GanTrainer:
                 from hfrep_tpu.parallel.sequence import make_sp_train_step
                 self._single_step = make_sp_train_step(
                     self.pair, self.cfg.train, self.windows, self.mesh)
+            elif names == ("tp",):
+                from hfrep_tpu.parallel.tensor import make_tp_train_step
+                self._single_step = make_tp_train_step(
+                    self.pair, self.cfg.train, self.windows, self.mesh)
             elif names == ("dp", "sp"):
                 from hfrep_tpu.parallel.dp_sp import make_dp_sp_train_step
                 self._single_step = make_dp_sp_train_step(
+                    self.pair, self.cfg.train, self.windows, self.mesh)
+            elif names == ("dp", "tp"):
+                from hfrep_tpu.parallel.tensor import make_dp_tp_train_step
+                self._single_step = make_dp_tp_train_step(
                     self.pair, self.cfg.train, self.windows, self.mesh)
             else:
                 from hfrep_tpu.train.steps import make_train_step

@@ -232,15 +232,39 @@ class TestCli:
                    "--quiet", "--dp-sp", "2x4"])
         assert rc == 0
 
+    @pytest.mark.slow
+    def test_train_gan_cli_tp_mesh(self, tmp_path):
+        """--tp-mesh 4 and --dp-tp 2x4: hidden-unit-sharded flagship
+        training through the CLI (4 divides the preset's hidden=100)."""
+        from hfrep_tpu.experiments.cli import main
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        rc = main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                   "--quiet", "--tp-mesh", "4"])
+        assert rc == 0
+        rc = main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                   "--quiet", "--dp-tp", "2x4"])
+        assert rc == 0
+
     def test_train_gan_cli_mesh_flags_exclusive(self):
         from hfrep_tpu.experiments.cli import main
 
         with pytest.raises(SystemExit, match="mutually exclusive"):
             main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
                   "--quiet", "--mesh", "--sp-mesh"])
+        with pytest.raises(SystemExit, match="mutually exclusive"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--tp-mesh", "4", "--dp-tp", "2x4"])
         with pytest.raises(SystemExit, match="DPxSP"):
             main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
                   "--quiet", "--dp-sp", "nonsense"])
+        with pytest.raises(SystemExit, match="DPxTP"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--dp-tp", "nonsense"])
+        with pytest.raises(SystemExit, match="N >= 1"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--tp-mesh", "0"])
 
     def test_train_gan_resume_completes_schedule(self, tmp_path, capsys):
         """--resume must finish the configured schedule, not retrain the

@@ -1,0 +1,191 @@
+"""Tensor-parallel (hidden-unit-sharded) stack vs single device (exactness).
+
+The unit-sliced recurrence computes the identical contraction as the
+single-device cell (gate-block slicing commutes with the matmul), so
+forwards, gradients, and whole training trajectories must agree to f32
+round-off — same standard as the sp and dp×sp suites.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.parallel.tensor import (make_dp_tp_train_step,
+                                       make_tp_multi_step,
+                                       make_tp_train_step, tp_critic,
+                                       tp_generate)
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_train_step
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("tp",))
+
+
+def _mesh2(dp, tp):
+    return Mesh(np.asarray(jax.devices()[:dp * tp]).reshape(dp, tp),
+                ("dp", "tp"))
+
+
+def _setup(window=16, batch=8, n_critic=2, hidden=8):
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=window,
+                      hidden=hidden)
+    tcfg = TrainConfig(batch_size=batch, n_critic=n_critic)
+    dataset = jnp.asarray(np.random.default_rng(7).uniform(
+        0, 1, (32, window, 5)).astype(np.float32))
+    return mcfg, tcfg, dataset, build_gan(mcfg)
+
+
+def _assert_tree_close(a, b, **tol):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), **tol)
+
+
+@needs_8
+@pytest.mark.parametrize("tp", [8, pytest.param(4, marks=pytest.mark.slow)])
+def test_tp_generate_matches_single_device(tp):
+    """Full MTSS generator with hidden units sharded (Hl = 1 at tp=8)
+    equals the single-device apply."""
+    mcfg, _, _, pair = _setup()
+    key = jax.random.PRNGKey(0)
+    z = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 5))
+    params = pair.generator.init(key, z)["params"]
+    want = pair.generator.apply({"params": params}, z)
+    got = tp_generate(params, z, _mesh(tp))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_8
+@pytest.mark.slow
+def test_tp_critic_matches_single_device_with_grads():
+    """Unit-sharded critic (sliced gates + psum'd flatten head) matches
+    LSTMFlatCritic in value AND gradients w.r.t. params and inputs —
+    the pieces tp WGAN-GP training differentiates."""
+    mcfg, _, _, pair = _setup()
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 5))
+    params = pair.discriminator.init(key, x)["params"]
+    mesh = _mesh(8)
+
+    want = pair.discriminator.apply({"params": params}, x)
+    got = tp_critic(params, x, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_single(p, xx):
+        return jnp.sum(pair.discriminator.apply({"params": p}, xx))
+
+    def loss_tp(p, xx):
+        return jnp.sum(tp_critic(p, xx, mesh))
+
+    gp_w, gx_w = jax.grad(loss_single, argnums=(0, 1))(params, x)
+    gp_g, gx_g = jax.grad(loss_tp, argnums=(0, 1))(params, x)
+    _assert_tree_close(gp_g, gp_w, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gx_g), np.asarray(gx_w),
+                               rtol=2e-4, atol=2e-5)
+
+
+@needs_8
+def test_tp_train_step_matches_plain_step():
+    """One tensor-parallel epoch (n_critic GP critic updates + generator
+    update, hidden units sharded over 4 devices) follows the
+    single-device step's trajectory at the same key — gradient
+    penalty's second-order path included."""
+    mcfg, tcfg, dataset, pair = _setup()
+    mesh = _mesh(4)
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    st, m = make_tp_train_step(pair, tcfg, dataset, mesh)(
+        s0, jax.random.PRNGKey(1))
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    ref_st, ref_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+
+    for k in ref_m:
+        np.testing.assert_allclose(float(m[k]), float(ref_m[k]),
+                                   rtol=1e-4, atol=1e-5)
+    _assert_tree_close((st.g_params, st.d_params),
+                       (ref_st.g_params, ref_st.d_params),
+                       rtol=1e-4, atol=1e-5)
+    assert int(st.step) == 1
+
+
+@needs_8
+@pytest.mark.slow
+def test_tp_multi_step_matches_sequential_plain_steps():
+    """The scanned tp multi-epoch block follows the single-device
+    trajectory over 3 epochs (same key-per-epoch folding as
+    make_multi_step)."""
+    mcfg, _, dataset, pair = _setup()
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    key = jax.random.PRNGKey(1)
+
+    multi = make_tp_multi_step(pair, tcfg, dataset, _mesh(8), jit=False)
+    st_a, metrics = multi(init_gan_state(key, mcfg, tcfg, pair),
+                          jax.random.PRNGKey(2))
+    assert metrics["d_loss"].shape == (3,)
+    assert np.isfinite(np.asarray(metrics["d_loss"])).all()
+
+    step = make_train_step(pair, tcfg, dataset)
+    st_b = init_gan_state(key, mcfg, tcfg, pair)
+    for i in range(3):
+        st_b, _ = step(st_b, jax.random.fold_in(jax.random.PRNGKey(2), i))
+    _assert_tree_close(st_a.g_params, st_b.g_params, rtol=1e-3, atol=1e-4)
+    _assert_tree_close(st_a.d_params, st_b.d_params, rtol=1e-3, atol=1e-4)
+
+
+@needs_8
+@pytest.mark.slow
+def test_dp_tp_train_step_matches_plain_step():
+    """Batch sharded over dp AND hidden units sharded over tp on one
+    2-D mesh, controlled sampling: same trajectory as the single-device
+    step at the same global batch."""
+    mcfg, tcfg, dataset, pair = _setup()
+    mesh = _mesh2(2, 4)
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    st, m = make_dp_tp_train_step(pair, tcfg, dataset, mesh,
+                                  controlled_sampling=True)(
+        s0, jax.random.PRNGKey(1))
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    ref_st, ref_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+
+    for k in ref_m:
+        np.testing.assert_allclose(float(m[k]), float(ref_m[k]),
+                                   rtol=1e-4, atol=1e-5)
+    _assert_tree_close((st.g_params, st.d_params),
+                       (ref_st.g_params, ref_st.d_params),
+                       rtol=1e-4, atol=1e-5)
+
+
+@needs_8
+def test_tp_validation_errors():
+    mcfg, tcfg, dataset, pair = _setup()
+    # hidden=8 does not split over 3 devices
+    with pytest.raises(ValueError, match="not divisible by tp"):
+        make_tp_train_step(pair, tcfg, dataset, _mesh(3))
+    wrong = build_gan(ModelConfig(family="wgan_gp", features=5, window=16,
+                                  hidden=8))
+    with pytest.raises(ValueError, match="mtss_wgan_gp"):
+        make_tp_train_step(wrong, tcfg, dataset, _mesh(4))
+    with pytest.raises(ValueError, match=r"\('dp', 'tp'\)"):
+        make_dp_tp_train_step(
+            pair, tcfg, dataset,
+            Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("a", "b")))
+    with pytest.raises(ValueError, match="not divisible by dp"):
+        make_dp_tp_train_step(
+            pair, dataclasses.replace(tcfg, batch_size=9), dataset,
+            _mesh2(2, 4))
