@@ -212,7 +212,8 @@ def cmd_train_gan(args) -> int:
         from hfrep_tpu.parallel.mesh import initialize_distributed
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
-        if not (args.sp_mesh or args.dp_sp or args.tp_mesh or args.dp_tp):
+        if not (args.sp_mesh or args.dp_sp or args.tp_mesh is not None
+                or args.dp_tp):
             args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,

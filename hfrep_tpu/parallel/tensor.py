@@ -274,6 +274,24 @@ def validate_tp_pair(pair, n_tp: int) -> None:
     _check_width(pair.generator.hidden, n_tp)
 
 
+def _validate_tp_backend(tcfg) -> None:
+    """Same backend policy as the sp path's dtype gate: an EXPLICIT
+    pallas request must refuse (the per-step cross-chip all_gather is
+    what the fused kernels cannot express — module docstring), never
+    silently run the scan; ``'auto'`` quietly takes the scan (on a tp
+    mesh that IS the best available backend); invalid values get
+    `resolve_lstm_backend`'s usual ValueError."""
+    from hfrep_tpu.train.steps import resolve_lstm_backend
+
+    if tcfg.lstm_backend == "pallas":
+        raise NotImplementedError(
+            "tensor-parallel training runs the XLA scan recurrence: the "
+            "pallas kernels keep gate matrices VMEM-resident across the "
+            "whole traversal and cannot express the per-timestep "
+            "cross-chip all_gather; use lstm_backend='auto' or 'xla'")
+    resolve_lstm_backend(tcfg.lstm_backend)
+
+
 def _tp_apply_fns(pair, axis_name: str) -> Tuple:
     slope = pair.generator.slope
     g_apply = lambda p, z: _tp_generate_local(p, z, axis_name, slope,
@@ -310,6 +328,7 @@ def make_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
 
     axis_name = _resolve_tp_axis(mesh, axis_name)
     validate_tp_pair(pair, mesh.shape[axis_name])
+    _validate_tp_backend(tcfg)
     inner = make_train_step(pair, tcfg, dataset,
                             apply_fns=_tp_apply_fns(pair, axis_name))
     return _wrap_replicated(inner, mesh, jit)
@@ -324,6 +343,7 @@ def make_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
 
     axis_name = _resolve_tp_axis(mesh, axis_name)
     validate_tp_pair(pair, mesh.shape[axis_name])
+    _validate_tp_backend(tcfg)
     step = make_train_step(pair, tcfg, dataset,
                            apply_fns=_tp_apply_fns(pair, axis_name))
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
@@ -347,6 +367,7 @@ def _make_dp_tp_inner(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh,
 
     dp_axis, tp_axis = _split_dp_tp(mesh)
     validate_tp_pair(pair, mesh.shape[tp_axis])
+    _validate_tp_backend(tcfg)
     n_dp = mesh.shape[dp_axis]
     if tcfg.batch_size % n_dp:
         raise ValueError(

@@ -423,6 +423,150 @@ def test_two_process_sp_matches_single_device(tmp_path):
                                float(jnp.sum(d0)), atol=1e-4)
 
 
+TP_CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    from hfrep_tpu.parallel.mesh import initialize_distributed, replicate_to_global
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert len(jax.local_devices()) == 4 and len(jax.devices()) == 8
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.tensor import make_tp_multi_step
+    from hfrep_tpu.train.states import init_gan_state
+
+    # the HIDDEN-UNIT axis spans the pod-wide mesh: units 0-3 live in
+    # this process, 4-7 in the peer (Hl=1) — every recurrence timestep's
+    # hidden-slice all_gather crosses the process boundary over Gloo/TCP
+    # (the tp twin of SP_CHILD's cross-process carry ppermute; a
+    # cross-process dp axis is covered by DPSP_CHILD, so the three tests
+    # together span all three collectives' DCN paths)
+    mesh = Mesh(np.asarray(jax.devices()), ("tp",))
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state = replicate_to_global(state, mesh)
+    key = replicate_to_global(jax.random.PRNGKey(1), mesh)
+
+    state, metrics = make_tp_multi_step(pair, tcfg, dataset, mesh)(state, key)
+    host = jax.device_get(metrics)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    d0 = jax.tree_util.tree_leaves(state.d_params)[0]
+    print("RESULT " + json.dumps({
+        "process": pid,
+        "d_loss": [float(v) for v in host["d_loss"]],
+        "g_loss": [float(v) for v in host["g_loss"]],
+        "g_leaf0_sum": float(jnp.sum(g0)),
+        "d_leaf0_sum": float(jnp.sum(d0)),
+    }), flush=True)
+
+    # the TRAINER on the pod-wide tp mesh: global-array promotion,
+    # schedule, leader-only checkpoint, restore + resume on every process
+    import dataclasses
+    from jax.experimental import multihost_utils
+    from hfrep_tpu.config import ExperimentConfig
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    cfg = ExperimentConfig(model=mcfg, train=dataclasses.replace(
+        tcfg, epochs=4, steps_per_call=2))
+    tr = GanTrainer(cfg, dataset, mesh=mesh)
+    tr.train()
+    assert int(tr.state.step) == 4
+    ckpt_path = os.path.join(sys.argv[3], "ckpt_tp_4")
+    tr.save_checkpoint(ckpt_path)
+    multihost_utils.sync_global_devices("tp_ckpt_written")
+    assert os.path.exists(ckpt_path)
+    tr2 = GanTrainer(cfg, dataset, mesh=mesh)
+    tr2.restore_checkpoint(ckpt_path)
+    tr2.train(epochs=2)
+    assert int(tr2.state.step) == 6
+    print("TRAINER " + json.dumps({
+        "process": pid,
+        "g_loss": tr.history[-1]["g_loss"],
+        "resumed_g_loss": tr2.history[-1]["g_loss"],
+    }), flush=True)
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
+@pytest.mark.slow
+def test_two_process_tp_matches_single_device(tmp_path):
+    """Tensor-parallel training with the hidden-unit axis spanning TWO
+    real processes (2×4 virtual devices over Gloo/TCP): the multi-host
+    per-timestep hidden-slice all_gather must land on the single-device
+    trajectory exactly like the single-process tp mesh does
+    (tests/test_tensor_parallel.py), and the trainer's
+    checkpoint/resume leg must work on the pod mesh."""
+    script = tmp_path / "tp_child.py"
+    script.write_text(TP_CHILD)
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "JAX_PLATFORMS": ""}
+    ckpt_dir = tmp_path / "ckpts"
+    ckpt_dir.mkdir()
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid), str(port),
+                               str(ckpt_dir)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              env=env, text=True)
+             for pid in (0, 1)]
+    results, trainer_results = {}, {}
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"tp child failed:\n{out}\n{err}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT "):])
+        results[r["process"]] = r
+        tline = [l for l in out.splitlines() if l.startswith("TRAINER ")][-1]
+        t = json.loads(tline[len("TRAINER "):])
+        trainer_results[t["process"]] = t
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["d_loss"], results[1]["d_loss"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"],
+                               results[1]["g_leaf0_sum"], rtol=1e-6)
+    np.testing.assert_allclose(trainer_results[0]["g_loss"],
+                               trainer_results[1]["g_loss"], rtol=1e-6)
+    np.testing.assert_allclose(trainer_results[0]["resumed_g_loss"],
+                               trainer_results[1]["resumed_g_loss"], rtol=1e-6)
+
+    # trajectory oracle: the plain single-device multi-step at the same key
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_multi_step
+
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state, metrics = make_multi_step(pair, tcfg, dataset)(
+        state, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(results[0]["d_loss"],
+                               np.asarray(metrics["d_loss"]), atol=1e-4)
+    np.testing.assert_allclose(results[0]["g_loss"],
+                               np.asarray(metrics["g_loss"]), atol=1e-4)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    d0 = jax.tree_util.tree_leaves(state.d_params)[0]
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"],
+                               float(jnp.sum(g0)), atol=1e-4)
+    np.testing.assert_allclose(results[0]["d_leaf0_sum"],
+                               float(jnp.sum(d0)), atol=1e-4)
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
 @pytest.mark.skipif(not os.path.isdir("/root/reference/cleaned_data"),
                     reason="reference data not mounted")

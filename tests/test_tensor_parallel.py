@@ -189,3 +189,14 @@ def test_tp_validation_errors():
         make_dp_tp_train_step(
             pair, dataclasses.replace(tcfg, batch_size=9), dataset,
             _mesh2(2, 4))
+    # explicit pallas requests refuse (the kernels can't express the
+    # per-step cross-chip gather); 'auto' quietly takes the scan and
+    # invalid values get resolve_lstm_backend's usual error
+    with pytest.raises(NotImplementedError, match="all_gather"):
+        make_tp_train_step(
+            pair, dataclasses.replace(tcfg, lstm_backend="pallas"),
+            dataset, _mesh(4))
+    with pytest.raises(ValueError, match="lstm_backend"):
+        make_dp_tp_train_step(
+            pair, dataclasses.replace(tcfg, lstm_backend="pallax"),
+            dataset, _mesh2(2, 4))
