@@ -59,6 +59,10 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="composed 2-D mesh, e.g. 2x4: batch sharded over "
                         "dp AND hidden units sharded over tp in one step "
                         "(parallel/tensor.py)")
+    t.add_argument("--dp-sp-tp", default=None, metavar="DPxSPxTP",
+                   help="full 3-D mesh, e.g. 2x2x2: batch over dp, window "
+                        "over sp, hidden units over tp in one step "
+                        "(parallel/dp_sp_tp.py)")
     t.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port — every "
                         "process runs this same command with its own "
@@ -148,10 +152,12 @@ def cmd_clean(args) -> int:
 
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
                   mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
-                  sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None):
-    if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp))) > 1:
-        raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh and --dp-tp "
-                         "are mutually exclusive")
+                  sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None,
+                  dp_sp_tp=None):
+    if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp,
+                      dp_sp_tp))) > 1:
+        raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh, --dp-tp and "
+                         "--dp-sp-tp are mutually exclusive")
     import jax
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
@@ -188,6 +194,22 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
         except ValueError:
             raise SystemExit(f"--dp-tp wants DPxTP (e.g. 2x4), got {dp_tp!r}")
         device_mesh = make_mesh_2d(n_dp, n_tp, axis_names=("dp", "tp"))
+    elif dp_sp_tp:
+        import numpy as np
+        from jax.sharding import Mesh
+        try:
+            n_dp, n_sp, n_tp = (int(v) for v in dp_sp_tp.lower().split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"--dp-sp-tp wants DPxSPxTP (e.g. 2x2x2), got {dp_sp_tp!r}")
+        n_need = n_dp * n_sp * n_tp
+        if n_dp < 1 or n_sp < 1 or n_tp < 1 or n_need > len(jax.devices()):
+            raise SystemExit(
+                f"--dp-sp-tp {dp_sp_tp} needs {n_need} devices >= 1 each; "
+                f"{len(jax.devices())} present")
+        device_mesh = Mesh(
+            np.asarray(jax.devices()[:n_need]).reshape(n_dp, n_sp, n_tp),
+            ("dp", "sp", "tp"))
 
     cfg = get_preset(preset)
     if checkpoint_dir:
@@ -213,14 +235,14 @@ def cmd_train_gan(args) -> int:
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
         if not (args.sp_mesh or args.dp_sp or args.tp_mesh is not None
-                or args.dp_tp):
+                or args.dp_tp or args.dp_sp_tp):
             args.mesh = True
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,
         args.quiet, nan_guard=args.nan_guard,
         max_recoveries=args.max_recoveries,
         sp_mesh=args.sp_mesh, dp_sp=args.dp_sp,
-        tp_mesh=args.tp_mesh, dp_tp=args.dp_tp)
+        tp_mesh=args.tp_mesh, dp_tp=args.dp_tp, dp_sp_tp=args.dp_sp_tp)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest

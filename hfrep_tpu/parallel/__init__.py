@@ -18,6 +18,10 @@ from hfrep_tpu.parallel.sequence import (  # noqa: F401
     sp_lstm,
     sp_microbatch_plan,
 )
+from hfrep_tpu.parallel.dp_sp_tp import (  # noqa: F401
+    make_dp_sp_tp_multi_step,
+    make_dp_sp_tp_train_step,
+)
 from hfrep_tpu.parallel.tensor import (  # noqa: F401
     make_dp_tp_multi_step,
     make_dp_tp_train_step,

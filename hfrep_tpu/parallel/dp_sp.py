@@ -48,21 +48,36 @@ from hfrep_tpu.parallel.sequence import (sp_critic, sp_generate,
                                          validate_sp_pair)
 
 
-def _split_axes(mesh: Mesh) -> Tuple[str, str]:
-    if tuple(mesh.axis_names) != ("dp", "sp"):
+def _split_axes(mesh: Mesh, tp_axis=None) -> Tuple[str, str]:
+    want = ("dp", "sp", "tp") if tp_axis is not None else ("dp", "sp")
+    if tuple(mesh.axis_names) != want:
         raise ValueError(
-            f"dp×sp composition wants a ('dp', 'sp') mesh, got {mesh.axis_names}")
+            f"dp×sp{'×tp' if tp_axis is not None else ''} composition wants "
+            f"a {want} mesh, got {mesh.axis_names}")
     return "dp", "sp"
 
 
 def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                mesh: Mesh, controlled_sampling: bool):
+                mesh: Mesh, controlled_sampling: bool, tp_axis=None):
     """The per-device epoch step: plain-step semantics with manual-mode
-    window-sharded apply fns, dp-axis gradient normalization."""
+    window-sharded apply fns, dp-axis gradient normalization.  The ONE
+    home of the composed-mesh inner-step contract: ``tp_axis`` extends
+    it to the 3-D ``('dp', 'sp', 'tp')`` mesh
+    (:mod:`hfrep_tpu.parallel.dp_sp_tp`) with the hidden units
+    additionally sharded inside every pipeline chunk (XLA-scan chunks —
+    see the tp backend note in :mod:`hfrep_tpu.parallel.tensor`)."""
     from hfrep_tpu.train.steps import make_train_step, resolve_lstm_backend
 
-    dp_axis, sp_axis = _split_axes(mesh)
+    dp_axis, sp_axis = _split_axes(mesh, tp_axis)
     validate_sp_pair(pair)
+    if tp_axis is not None:
+        from hfrep_tpu.parallel.tensor import (_check_width,
+                                               _validate_tp_backend)
+        _validate_tp_backend(tcfg)
+        _check_width(pair.generator.hidden, mesh.shape[tp_axis])
+        backend = "xla"
+    else:
+        backend = resolve_lstm_backend(tcfg.lstm_backend)
     n_dp = mesh.shape[dp_axis]
     n_sp = mesh.shape[sp_axis]
     if tcfg.batch_size % n_dp:
@@ -76,13 +91,14 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     if dataset.shape[1] % n_sp:
         raise ValueError(
             f"window {dataset.shape[1]} not divisible by sp={n_sp}")
-    backend = resolve_lstm_backend(tcfg.lstm_backend)
     slope = pair.generator.slope
     g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=sp_axis,
                                        activation="sigmoid", slope=slope,
-                                       backend=backend, manual=True)
+                                       backend=backend, manual=True,
+                                       tp_axis=tp_axis)
     d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=sp_axis,
-                                     backend=backend, manual=True)
+                                     backend=backend, manual=True,
+                                     tp_axis=tp_axis)
     local_tcfg = dataclasses.replace(tcfg, batch_size=local_batch)
     return make_train_step(
         pair, local_tcfg, dataset, axis_name=dp_axis,

@@ -1,0 +1,80 @@
+"""Full 3-D composition: data × sequence × tensor parallelism on one
+``('dp', 'sp', 'tp')`` mesh.
+
+Round 4 built the pairwise compositions — dp×sp
+(:mod:`hfrep_tpu.parallel.dp_sp`) and dp×tp
+(:mod:`hfrep_tpu.parallel.tensor`).  This module closes the set: one
+``shard_map`` region over the 3-D mesh where
+
+* **dp** shards the batch — each dp slab samples its own rows (i.i.d.
+  folded keys, or controlled global sampling for trajectory tests) and
+  gradients are globally batch-mean normalized by the existing
+  `_psum_if` vma machinery;
+* **sp** shards the window — the pipelined chunk recurrence with
+  ppermute carry handoffs (:func:`hfrep_tpu.parallel.sequence._sp_pipeline`);
+* **tp** shards the hidden units *inside* each pipeline chunk — the
+  chunk scans carry (Bm, H/T) unit slices and all_gather them per
+  timestep (:func:`~hfrep_tpu.parallel.sequence._local_chunk_scan_tp`),
+  the :mod:`hfrep_tpu.parallel.tensor` layout composed into the sp
+  superstep schedule.  Carry handoffs ppermute the slices over ``sp``
+  (the T unit pipelines run the same schedule in lockstep); inter-layer
+  transforms and the heads see full-H tp-invariant chunks via masked
+  psum, so :func:`~hfrep_tpu.parallel.sequence.sp_generate` /
+  :func:`~hfrep_tpu.parallel.sequence.sp_critic` compose unchanged.
+
+Params and optimizer state stay replicated over all three axes
+(``check_vma=True`` proves it), and a controlled-sampling run at the
+same global batch follows the single-device trajectory to f32 round-off
+(``tests/test_dp_sp_tp.py`` on a 2×2×2 virtual mesh) — on a pod,
+scaling any of batch, window length, or model width is a mesh-shape
+change, not a semantics change.  The reference anchor is the loop being
+scaled, ``GAN/MTSS_WGAN_GP.py:254-292`` (single device, W ≤ 168,
+H = 100).  XLA-scan chunks only (see the tp backend note in
+:mod:`hfrep_tpu.parallel.tensor`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from hfrep_tpu.config import TrainConfig
+from hfrep_tpu.models.registry import GanPair
+from hfrep_tpu.parallel.dp_sp import _make_inner
+
+
+def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
+                             dataset: jnp.ndarray, mesh: Mesh, *,
+                             controlled_sampling: bool = False,
+                             jit: bool = True):
+    """One dp×sp×tp epoch: ``fn(state, key) -> (state, metrics)`` with
+    state replicated over the 3-D mesh and metrics pmean'd over ``dp``.
+    ``controlled_sampling=True`` consumes the exact single-device sample
+    stream at the same global batch (the trajectory-test mode).
+
+    The inner step is the dp×sp contract's ONE home
+    (:func:`hfrep_tpu.parallel.dp_sp._make_inner`) with ``tp_axis``
+    threaded through the pipelines — validation, sampling streams, and
+    gradient normalization cannot drift between the 2-D and 3-D meshes.
+    """
+    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
+
+    inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
+                        tp_axis="tp")
+    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)
+
+
+def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
+                             dataset: jnp.ndarray, mesh: Mesh, *,
+                             controlled_sampling: bool = False,
+                             jit: bool = True):
+    """``tcfg.steps_per_call`` dp×sp×tp epochs scanned into ONE compiled
+    program — the launch shape for real pod runs (dispatched from the
+    trainer's ordinary block loop)."""
+    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
+    from hfrep_tpu.train.steps import make_multi_step
+
+    step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
+                       tp_axis="tp")
+    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
+    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)

@@ -66,6 +66,20 @@ def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarr
     return lax.scan(cell, carry, xz_chunk)
 
 
+def _local_chunk_scan_tp(xz_chunk: jnp.ndarray,
+                         carry: Tuple[jnp.ndarray, jnp.ndarray],
+                         r_loc: jnp.ndarray, act, rec_act, tp_axis: str):
+    """The tp twin of :func:`_local_chunk_scan`: the chunk's gates and
+    (h, c) carry are this device's Hl = H/T unit slices, and the
+    recurrence is the SAME shared cell the plain tp layer scans
+    (:func:`hfrep_tpu.parallel.tensor.tp_chunk_scan` — per-step hidden
+    all_gather against the local gate columns), so the sp-pipelined and
+    standalone tp paths cannot drift arithmetically."""
+    from hfrep_tpu.parallel.tensor import tp_chunk_scan
+
+    return tp_chunk_scan(xz_chunk, carry, r_loc, act, rec_act, tp_axis)
+
+
 def _resolve_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
     """Default the sharded-window axis: the mesh's only axis for a 1-D
     mesh (dp- or sp-named — callers need not thread axis names), or an
@@ -87,7 +101,8 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                  recurrent_activation: str = "sigmoid",
                  backend: str = "xla",
                  inters=None,
-                 manual: bool = False) -> jnp.ndarray:
+                 manual: bool = False,
+                 tp_axis: Optional[str] = None) -> jnp.ndarray:
     """N stacked LSTMs through ONE window-sharded pipeline pass.
 
     ``layers`` is a list of KerasLSTM param dicts ({kernel,
@@ -116,11 +131,39 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
     critic; never all_gather — see :func:`sp_generate`).  The vma casts adapt automatically: loop carries are
     matched against the pre-projected chunk's actual variance
     (``match_vma``), which is {sp} standalone and {dp, sp} composed.
+
+    ``tp_axis`` (manual mode only) additionally shards every layer's
+    HIDDEN UNITS over that mesh axis, the
+    :mod:`hfrep_tpu.parallel.tensor` layout composed into the pipeline:
+    each device's chunk scan carries its (Bm, H/T) unit slices (carry
+    handoffs ppermute the slices over ``axis_name`` — the T unit
+    pipelines run the same schedule in lockstep), every timestep
+    all_gathers the slices over ``tp_axis``
+    (:func:`_local_chunk_scan_tp`), inter-layer transforms see the full
+    width via a masked-psum reassembly per chunk, and the returned
+    chunk is full-H, typed tp-*invariant* — so the sp callers
+    (:func:`sp_generate` / :func:`sp_critic`) work unchanged on top.
+    XLA-scan backend only (a per-step cross-chip gather is what the
+    fused kernels cannot express).
     """
     axis_name = _resolve_axis(mesh, axis_name)
     n_dev = mesh.shape[axis_name]
     b, w, f = x.shape
     h_dims = [l["recurrent_kernel"].shape[0] for l in layers]
+    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    if tp_axis is not None:
+        if not manual:
+            raise ValueError("tp_axis requires manual mode (an enclosing "
+                             "shard_map over the ('…', 'sp', 'tp') mesh)")
+        if backend == "pallas":
+            raise NotImplementedError(
+                "the pipelined chunks run the XLA scan under tp_axis: the "
+                "pallas kernels cannot express the per-timestep cross-chip "
+                "all_gather of the hidden slices")
+        for h in h_dims:
+            if h % n_tp:
+                raise ValueError(
+                    f"hidden width {h} not divisible by tp={n_tp} devices")
     m = microbatches or n_dev
     if b % m:
         raise ValueError(f"batch {b} not divisible by microbatches {m}")
@@ -164,6 +207,9 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
     else:
         hp = h_dims
         lay = list(layers)
+    # Per-device gate/carry widths: the tp-sliced Hl when the hidden
+    # units are sharded, the (possibly lane-padded) full width otherwise.
+    wid = [h // n_tp for h in h_dims] if tp_axis is not None else hp
 
     fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
 
@@ -171,11 +217,20 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         # x_local: (B, Wl, F) — this device's time chunk for every row.
         wl = x_local.shape[1]
         k_idx = lax.axis_index(axis_name)
+        if tp_axis is not None:
+            # Composed width sharding: slice this tp rank's gate columns
+            # out of every layer — the same shared layout helper the
+            # plain tp path uses (parallel/tensor.py).
+            from hfrep_tpu.parallel.tensor import _slice_gate_params
+
+            t_tp = lax.axis_index(tp_axis)
+            lay = [_slice_gate_params(l, t_tp, hl)
+                   for l, hl in zip(lay, wid)]
         # Hoisted layer-0 input projection: one MXU matmul for the whole
         # chunk (padded-gate layout when the pallas kernels run it).
         # Deeper layers' projections run per superstep — their inputs
         # only exist once the previous layer's chunk has run.
-        g0 = 4 * hp[0]
+        g0 = 4 * wid[0]
         xz = (x_local.reshape(b * wl, f) @ lay[0]["kernel"]
               + lay[0]["bias"]).reshape(b, wl, g0)
         xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp0)
@@ -183,11 +238,12 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
 
         # Cast the loop state to the variance the loop body will produce:
         # the pre-projected chunk carries the true vma ({sp} standalone,
-        # {dp, sp} under the composed dp×sp step), so matching against it
-        # keeps the scan's carry-in/carry-out types equal in both modes.
+        # {dp, sp} under the composed dp×sp step, plus {tp} when the
+        # units are sharded), so matching against it keeps the scan's
+        # carry-in/carry-out types equal in every mode.
         carry_reg = tuple(
             (match_vma(jnp.zeros((bm, hpi), xz.dtype), xz),
-             match_vma(jnp.zeros((bm, hpi), xz.dtype), xz)) for hpi in hp)
+             match_vma(jnp.zeros((bm, hpi), xz.dtype), xz)) for hpi in wid)
 
         # Kernel mode: the pallas custom_vjp emits *varying* cotangents
         # (hand-computed per-device, never auto-psum'd), so a replicated
@@ -204,6 +260,9 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
             if use_kernel:
                 h_seq, c_f = lstm_seq_carry(xz_s, recs[i], h0, c0, act_name)
                 return (h_seq[-1], c_f), h_seq
+            if tp_axis is not None:
+                return _local_chunk_scan_tp(xz_s, (h0, c0), recs[i],
+                                            act, rec_act, tp_axis)
             return _local_chunk_scan(xz_s, (h0, c0), recs[i], act, rec_act)
 
         # Scan-then-gather: every superstep emits its chunk's last-layer
@@ -227,11 +286,19 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                 if i > 0:
                     # previous layer's real lanes → inter-layer transform
                     # → this layer's input projection (one (Wl·Bm)-row
-                    # MXU matmul per chunk)
-                    y = seq[..., :h_dims[i - 1]]
+                    # MXU matmul per chunk).  Under tp the chunk holds
+                    # only this rank's unit slices: reassemble the full
+                    # width by masked psum so the transform (LayerNorm
+                    # normalizes over ALL H units) and the projection's
+                    # H-contraction see the true sequence.
+                    if tp_axis is not None:
+                        from hfrep_tpu.parallel.tensor import _tp_assemble
+                        y = _tp_assemble(seq, tp_axis)
+                    else:
+                        y = seq[..., :h_dims[i - 1]]
                     if inter_fns[i - 1] is not None:
                         y = inter_fns[i - 1](inter_params[i - 1], y)
-                    gi = 4 * hp[i]
+                    gi = 4 * wid[i]
                     seq = (y.reshape(wl * bm, h_dims[i - 1]) @ lay[i]["kernel"]
                            + lay[i]["bias"]).reshape(wl, bm, gi)
                 h_in, c_in = carry[i]
@@ -259,8 +326,14 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                          jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp[-1])
         out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp[-1])
         # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
-        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, hp[-1])
-        return jnp.swapaxes(out, 0, 1)[..., :h_dims[-1]]
+        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, wid[-1])
+        out = jnp.swapaxes(out, 0, 1)
+        if tp_axis is not None:
+            # Full-H, typed tp-invariant — the sp callers' reassembly
+            # and head logic work unchanged on top.
+            from hfrep_tpu.parallel.tensor import _tp_assemble
+            return _tp_assemble(out, tp_axis)
+        return out[..., :h_dims[-1]]
 
     if manual:
         # Already inside a shard_map region: slice this device's window
@@ -308,19 +381,21 @@ def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
              activation: str = "tanh",
              recurrent_activation: str = "sigmoid",
              backend: str = "xla",
-             manual: bool = False) -> jnp.ndarray:
+             manual: bool = False,
+             tp_axis: Optional[str] = None) -> jnp.ndarray:
     """Two stacked LSTMs fused into ONE pipeline pass (optionally with a
     per-timestep ``inter = (fn, params)`` transform between them, applied
     as ``fn(params, y)``) — the sp analogue of the single-device fused
     stack kernels (`ops/pallas_lstm_stack.py`): one fill/drain and one
     shard_map region instead of two of each.  ``manual=True`` runs
     inside an enclosing shard_map and returns the local window chunk
-    (see :func:`_sp_pipeline`)."""
+    (see :func:`_sp_pipeline`); ``tp_axis`` additionally shards the
+    hidden units of both layers over that axis (manual mode only)."""
     return _sp_pipeline([p0, p1], x, mesh, inters=[inter, None],
                         axis_name=axis_name, microbatches=microbatches,
                         activation=activation,
                         recurrent_activation=recurrent_activation,
-                        backend=backend, manual=manual)
+                        backend=backend, manual=manual, tp_axis=tp_axis)
 
 
 def sp_microbatch_plan(batch: int, n_dev: int, window: int = 168,
@@ -511,7 +586,8 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
               axis_name: Optional[str] = None,
               microbatches: Optional[int] = None,
               backend: str = "xla",
-              manual: bool = False) -> jnp.ndarray:
+              manual: bool = False,
+              tp_axis: Optional[str] = None) -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
@@ -531,12 +607,16 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     full-window batch shard inside an enclosing shard_map; the pipeline
     returns the local chunk and the head dots it with this device's
     W/D-slice of the flatten-Dense kernel before the same psum.
+    ``tp_axis`` additionally shards the recurrences' hidden units over
+    that axis (the pipeline's chunks come back full-H tp-invariant, so
+    the head below is unchanged — dp×sp×tp composition,
+    :mod:`hfrep_tpu.parallel.dp_sp_tp`).
     """
     axis_name = _resolve_axis(mesh, axis_name)
     # both recurrences in ONE fused pipeline pass (see sp_lstm2)
     h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
                   axis_name=axis_name, microbatches=microbatches,
-                  backend=backend, manual=manual)
+                  backend=backend, manual=manual, tp_axis=tp_axis)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     w = x.shape[1]
@@ -569,7 +649,8 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 ln_eps: float = 1e-3,
                 microbatches: Optional[int] = None,
                 backend: str = "xla",
-                manual: bool = False) -> jnp.ndarray:
+                manual: bool = False,
+                tp_axis: Optional[str] = None) -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
     Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
     window axis sharded over ``axis_name`` — long-window synthesis
@@ -607,7 +688,7 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                             g_params["KerasLayerNorm_0"]),
                      axis_name=axis_name, microbatches=microbatches,
                      activation=activation,
-                     backend=backend, manual=True)
+                     backend=backend, manual=True, tp_axis=tp_axis)
         y = _sp_head_impl(g_params, x, slope, ln_eps)   # chunk-wise head
         wl = y.shape[1]
         buf = jnp.zeros((y.shape[0], wl * mesh.shape[axis_name], y.shape[2]),

@@ -87,6 +87,61 @@ def _check_width(h: int, n_dev: int) -> int:
     return h // n_dev
 
 
+def _slice_gate_params(params: dict, t_idx, hl: int) -> dict:
+    """This tp rank's Hl unit columns of a Keras LSTM param dict, in the
+    flat gate-blocked layout ({kernel: (Fin, 4·Hl), recurrent_kernel:
+    (H, 4·Hl), bias: (4·Hl,)}).
+
+    Gate blocks stay Keras-ordered [i|f|c|o] within the sliced 4·Hl —
+    slicing each block's own-unit columns commutes with every
+    contraction.  axis_index-dependent slices type the results
+    tp-varying, which is what makes AD psum the parameter cotangents
+    back to the replicated trees at the boundary.  Shared by the tp
+    layer forward here and the sp pipeline's tp-sliced chunks
+    (:mod:`hfrep_tpu.parallel.sequence`), so the two layouts cannot
+    drift."""
+    f_in = params["kernel"].shape[0]
+    h = params["recurrent_kernel"].shape[0]
+    k = lax.dynamic_slice_in_dim(
+        params["kernel"].reshape(f_in, 4, h), t_idx * hl, hl, axis=2)
+    r = lax.dynamic_slice_in_dim(
+        params["recurrent_kernel"].reshape(h, 4, h), t_idx * hl, hl, axis=2)
+    bb = lax.dynamic_slice_in_dim(
+        params["bias"].reshape(4, h), t_idx * hl, hl, axis=1)
+    return {"kernel": k.reshape(f_in, 4 * hl),
+            "recurrent_kernel": r.reshape(h, 4 * hl),
+            "bias": bb.reshape(4 * hl)}
+
+
+def tp_chunk_scan(xz_chunk: jnp.ndarray, carry, r_loc: jnp.ndarray,
+                  act, rec_act, tp_axis: str):
+    """Scan a (W, B, 4·Hl) pre-projected gate-slice chunk from the given
+    (B, Hl) carry slices — the tp recurrence kernel shared by the plain
+    tp layer and the sp pipeline's tp-sliced chunks.
+
+    Each timestep all_gathers the T hidden slices into the full (B, H)
+    state in unit order (device t owns columns [t·Hl, (t+1)·Hl) — tiled
+    concat order matches :func:`_slice_gate_params`'s column slicing;
+    the ONLY per-step tp communication) and contracts it against the
+    local (H, 4·Hl) recurrent columns; gate math updates the owned
+    slice elementwise, arithmetic identical to the single-device cell
+    (`ops/lstm.py::lstm_cell_step`) on those units."""
+
+    def cell(c, xz_t):
+        h_loc, c_loc = c
+        h_full = lax.all_gather(h_loc, tp_axis, axis=1, tiled=True)
+        z = xz_t + h_full @ r_loc
+        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+        i = rec_act(zi)
+        fgt = rec_act(zf)
+        cc = fgt * c_loc + i * act(zc)
+        o = rec_act(zo)
+        h_t = o * act(cc)
+        return (h_t, cc), h_t
+
+    return lax.scan(cell, carry, xz_chunk)
+
+
 def _tp_lstm_local(params: dict, x: jnp.ndarray, axis_name: str, *,
                    activation: Optional[str],
                    recurrent_activation: str = "sigmoid") -> jnp.ndarray:
@@ -96,61 +151,27 @@ def _tp_lstm_local(params: dict, x: jnp.ndarray, axis_name: str, *,
     ``x`` is the full (B, W, Fin) input (tp-invariant — either the raw
     noise/window or a previous layer's reassembled sequence); returns
     this device's LOCAL (B, W, Hl) hidden-sequence slice (tp-varying).
-
-    Arithmetic is the single-device cell's exactly (`ops/lstm.py::
-    lstm_cell_step`): the gate blocks are Keras-ordered [i|f|c|o], and
-    slicing each block's own-unit columns commutes with the contraction
-    — the (B, H) @ (H, 4H) recurrent matmul becomes (B, H) @ (H, 4·Hl)
-    against the gathered full hidden state.  The input projection for
-    the whole window is hoisted out of the recurrence as one MXU matmul,
-    same as the single-device path.
+    The input projection for the whole window is hoisted out of the
+    recurrence as one MXU matmul, same as the single-device path; the
+    recurrence is :func:`tp_chunk_scan` from the zero carry.
     """
-    h4 = params["recurrent_kernel"].shape[1]
-    h = h4 // 4
-    n_dev = lax.axis_size(axis_name)
-    hl = _check_width(h, n_dev)
-    t_idx = lax.axis_index(axis_name)
+    h = params["recurrent_kernel"].shape[0]
+    hl = _check_width(h, lax.axis_size(axis_name))
     act = ACTIVATIONS[activation]
     rec_act = ACTIVATIONS[recurrent_activation]
 
     b, w, f = x.shape
-    # Gate-blocked views (…, 4, H): slice this device's Hl unit columns
-    # out of every gate block.  axis_index-dependent slices type the
-    # results tp-varying, which is what makes AD psum the parameter
-    # cotangents back to the replicated trees at the boundary.
-    k_loc = lax.dynamic_slice_in_dim(
-        params["kernel"].reshape(f, 4, h), t_idx * hl, hl, axis=2)
-    r_loc = lax.dynamic_slice_in_dim(
-        params["recurrent_kernel"].reshape(h, 4, h), t_idx * hl, hl, axis=2)
-    b_loc = lax.dynamic_slice_in_dim(
-        params["bias"].reshape(4, h), t_idx * hl, hl, axis=1)
-
+    loc = _slice_gate_params(params, lax.axis_index(axis_name), hl)
     # Hoisted input projection for all timesteps: (B·W, Fin) @ (Fin, 4·Hl).
-    xz = (x.reshape(b * w, f) @ k_loc.reshape(f, 4 * hl)
-          + b_loc.reshape(4 * hl)).reshape(b, w, 4, hl)
-    xz = jnp.swapaxes(xz, 0, 1)                       # time-major (W, B, 4, Hl)
-    r2 = r_loc.reshape(h, 4 * hl)
-
-    def cell(carry, xz_t):
-        h_loc, c_loc = carry                          # (B, Hl) slices
-        # The only per-step communication: gather the T hidden slices
-        # into the full (B, H) state in unit order (device t owns
-        # columns [t·Hl, (t+1)·Hl) — tiled concat order matches the
-        # column slicing above).
-        h_full = lax.all_gather(h_loc, axis_name, axis=1, tiled=True)
-        z = xz_t + (h_full @ r2).reshape(-1, 4, hl)
-        i = rec_act(z[:, 0])
-        fgt = rec_act(z[:, 1])
-        c = fgt * c_loc + i * act(z[:, 2])
-        o = rec_act(z[:, 3])
-        h_t = o * act(c)
-        return (h_t, c), h_t
+    xz = (x.reshape(b * w, f) @ loc["kernel"] + loc["bias"]).reshape(b, w, 4 * hl)
+    xz = jnp.swapaxes(xz, 0, 1)                       # time-major (W, B, 4·Hl)
 
     # Carry slices vary over every axis the projected input does (tp
     # always; dp too under the composed dp×tp step).
     init = match_vma((jnp.zeros((b, hl), xz.dtype),
                       jnp.zeros((b, hl), xz.dtype)), xz)
-    _, hs = lax.scan(cell, init, xz)                  # (W, B, Hl)
+    _, hs = tp_chunk_scan(xz, init, loc["recurrent_kernel"], act, rec_act,
+                          axis_name)                  # (W, B, Hl)
     return jnp.swapaxes(hs, 0, 1)                     # (B, W, Hl)
 
 

@@ -49,6 +49,7 @@ class GanTrainer:
             #                 wide-model path
             #   ('dp', 'sp')  batch + window, one 2-D mesh (dp_sp.py)
             #   ('dp', 'tp')  batch + width, one 2-D mesh  (tensor.py)
+            #   ('dp', 'sp', 'tp')  all three, one 3-D mesh (dp_sp_tp.py)
             from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
             names = tuple(mesh.axis_names)
             if names == ("dp",):
@@ -66,10 +67,14 @@ class GanTrainer:
             elif names == ("dp", "tp"):
                 from hfrep_tpu.parallel.tensor import make_dp_tp_multi_step
                 self._multi = make_dp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            elif names == ("dp", "sp", "tp"):
+                from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_multi_step
+                self._multi = make_dp_sp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
             else:
                 raise ValueError(
                     f"mesh axis names {names} not recognized; use ('dp',), "
-                    "('sp',), ('tp',), ('dp', 'sp'), or ('dp', 'tp')")
+                    "('sp',), ('tp',), ('dp', 'sp'), ('dp', 'tp'), or "
+                    "('dp', 'sp', 'tp')")
             if spans_processes(mesh):
                 # multi-host: promote the (identically-seeded) state and
                 # key to replicated global arrays for the pod-wide jit
@@ -253,6 +258,10 @@ class GanTrainer:
             elif names == ("dp", "tp"):
                 from hfrep_tpu.parallel.tensor import make_dp_tp_train_step
                 self._single_step = make_dp_tp_train_step(
+                    self.pair, self.cfg.train, self.windows, self.mesh)
+            elif names == ("dp", "sp", "tp"):
+                from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_train_step
+                self._single_step = make_dp_sp_tp_train_step(
                     self.pair, self.cfg.train, self.windows, self.mesh)
             else:
                 from hfrep_tpu.train.steps import make_train_step

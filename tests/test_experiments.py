@@ -247,6 +247,17 @@ class TestCli:
                    "--quiet", "--dp-tp", "2x4"])
         assert rc == 0
 
+    @pytest.mark.slow
+    def test_train_gan_cli_dp_sp_tp(self, tmp_path):
+        """--dp-sp-tp 2x2x2: the full 3-D mesh through the CLI."""
+        from hfrep_tpu.experiments.cli import main
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        rc = main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                   "--quiet", "--dp-sp-tp", "2x2x2"])
+        assert rc == 0
+
     def test_train_gan_cli_mesh_flags_exclusive(self):
         from hfrep_tpu.experiments.cli import main
 
@@ -265,6 +276,9 @@ class TestCli:
         with pytest.raises(SystemExit, match="N >= 1"):
             main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
                   "--quiet", "--tp-mesh", "0"])
+        with pytest.raises(SystemExit, match="DPxSPxTP"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--dp-sp-tp", "nonsense"])
 
     def test_train_gan_resume_completes_schedule(self, tmp_path, capsys):
         """--resume must finish the configured schedule, not retrain the
