@@ -195,21 +195,13 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
             raise SystemExit(f"--dp-tp wants DPxTP (e.g. 2x4), got {dp_tp!r}")
         device_mesh = make_mesh_2d(n_dp, n_tp, axis_names=("dp", "tp"))
     elif dp_sp_tp:
-        import numpy as np
-        from jax.sharding import Mesh
+        from hfrep_tpu.parallel.mesh import make_mesh_3d
         try:
             n_dp, n_sp, n_tp = (int(v) for v in dp_sp_tp.lower().split("x"))
         except ValueError:
             raise SystemExit(
                 f"--dp-sp-tp wants DPxSPxTP (e.g. 2x2x2), got {dp_sp_tp!r}")
-        n_need = n_dp * n_sp * n_tp
-        if n_dp < 1 or n_sp < 1 or n_tp < 1 or n_need > len(jax.devices()):
-            raise SystemExit(
-                f"--dp-sp-tp {dp_sp_tp} needs {n_need} devices >= 1 each; "
-                f"{len(jax.devices())} present")
-        device_mesh = Mesh(
-            np.asarray(jax.devices()[:n_need]).reshape(n_dp, n_sp, n_tp),
-            ("dp", "sp", "tp"))
+        device_mesh = make_mesh_3d(n_dp, n_sp, n_tp)
 
     cfg = get_preset(preset)
     if checkpoint_dir:

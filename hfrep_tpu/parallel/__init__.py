@@ -2,6 +2,7 @@ from hfrep_tpu.parallel.mesh import (  # noqa: F401
     initialize_distributed,
     make_mesh,
     make_mesh_2d,
+    make_mesh_3d,
     replicate_to_global,
     spans_processes,
 )

@@ -106,13 +106,14 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         apply_fns=(g_apply, d_apply))
 
 
-def _wrap(inner, mesh: Mesh, controlled_sampling: bool, jit: bool):
+def _wrap(inner, mesh: Mesh, controlled_sampling: bool, jit: bool,
+          tp_axis=None):
     """The shared batch-parallel shard_map wrapper along the dp axis —
-    on the 2-D mesh, check_vma additionally proves state replication
-    over sp."""
+    on the composed meshes, check_vma additionally proves state
+    replication over sp (and tp on the 3-D mesh)."""
     from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
 
-    dp_axis, _ = _split_axes(mesh)
+    dp_axis, _ = _split_axes(mesh, tp_axis)
     return wrap_batch_parallel(inner, mesh, dp_axis, controlled_sampling, jit)
 
 

@@ -40,7 +40,7 @@ from jax.sharding import Mesh
 
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.parallel.dp_sp import _make_inner
+from hfrep_tpu.parallel.dp_sp import _make_inner, _wrap
 
 
 def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
@@ -52,16 +52,15 @@ def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
     ``controlled_sampling=True`` consumes the exact single-device sample
     stream at the same global batch (the trajectory-test mode).
 
-    The inner step is the dp×sp contract's ONE home
-    (:func:`hfrep_tpu.parallel.dp_sp._make_inner`) with ``tp_axis``
-    threaded through the pipelines — validation, sampling streams, and
-    gradient normalization cannot drift between the 2-D and 3-D meshes.
+    Both the inner step and the batch-parallel wrapper are the dp×sp
+    contract's ONE home (:func:`hfrep_tpu.parallel.dp_sp._make_inner` /
+    ``_wrap``) with ``tp_axis`` threaded through — validation, sampling
+    streams, gradient normalization, and the shard_map wrap cannot
+    drift between the 2-D and 3-D meshes.
     """
-    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
-
     inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
                         tp_axis="tp")
-    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)
+    return _wrap(inner, mesh, controlled_sampling, jit, tp_axis="tp")
 
 
 def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
@@ -71,10 +70,9 @@ def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
     """``tcfg.steps_per_call`` dp×sp×tp epochs scanned into ONE compiled
     program — the launch shape for real pod runs (dispatched from the
     trainer's ordinary block loop)."""
-    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
     from hfrep_tpu.train.steps import make_multi_step
 
     step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
                        tp_axis="tp")
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)
+    return _wrap(inner, mesh, controlled_sampling, jit, tp_axis="tp")

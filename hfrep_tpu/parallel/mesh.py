@@ -54,6 +54,26 @@ def make_mesh_2d(dp: int, sp: int,
     return Mesh(np.asarray(devices[:dp * sp]).reshape(dp, sp), names)
 
 
+def make_mesh_3d(dp: int, sp: int, tp: int,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The full 3-D ``('dp', 'sp', 'tp')`` mesh for dp×sp×tp training
+    (:mod:`hfrep_tpu.parallel.dp_sp_tp`).  dp outermost so its gradient
+    psums ride DCN on a multi-host pod while each sp×tp tile's carry
+    ppermutes and hidden-state all_gathers stay on neighbouring ICI
+    links (same guidance as :func:`make_mesh_2d`)."""
+    for name, n in (("dp", dp), ("sp", sp), ("tp", tp)):
+        if n < 1:
+            raise ValueError(f"dp×sp×tp mesh dims must be >= 1, got {name}={n}")
+    devices = list(devices) if devices is not None else jax.devices()
+    n_need = dp * sp * tp
+    if n_need > len(devices):
+        raise ValueError(
+            f"requested dp×sp×tp={dp}×{sp}×{tp} ({n_need} devices) but only "
+            f"{len(devices)} devices present")
+    return Mesh(np.asarray(devices[:n_need]).reshape(dp, sp, tp),
+                ("dp", "sp", "tp"))
+
+
 def initialize_distributed(coordinator: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:

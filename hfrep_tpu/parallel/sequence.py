@@ -160,10 +160,9 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                 "the pipelined chunks run the XLA scan under tp_axis: the "
                 "pallas kernels cannot express the per-timestep cross-chip "
                 "all_gather of the hidden slices")
+        from hfrep_tpu.parallel.tensor import _check_width
         for h in h_dims:
-            if h % n_tp:
-                raise ValueError(
-                    f"hidden width {h} not divisible by tp={n_tp} devices")
+            _check_width(h, n_tp)
     m = microbatches or n_dev
     if b % m:
         raise ValueError(f"batch {b} not divisible by microbatches {m}")

@@ -423,6 +423,54 @@ def test_two_process_sp_matches_single_device(tmp_path):
                                float(jnp.sum(d0)), atol=1e-4)
 
 
+DPSPTP_CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    from hfrep_tpu.parallel.mesh import initialize_distributed, replicate_to_global
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 8
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_train_step
+    from hfrep_tpu.train.states import init_gan_state
+
+    # the FULL 3-D mesh over the pod in the production layout (dp
+    # outermost): with [proc0: devs 0-3, proc1: devs 4-7] reshaped
+    # (2, 2, 2), the dp gradient psums ride the process boundary while
+    # each sp×tp tile stays intra-process — the realistic pod topology
+    # (parallel/mesh.py::make_mesh_2d note); the cross-process sp-carry
+    # and tp-gather paths are covered by SP_CHILD / TP_CHILD above
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
+                ("dp", "sp", "tp"))
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state = replicate_to_global(state, mesh)
+    key = replicate_to_global(jax.random.PRNGKey(1), mesh)
+
+    step = make_dp_sp_tp_train_step(pair, tcfg, dataset, mesh,
+                                    controlled_sampling=True)
+    state, metrics = step(state, key)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    print("RESULT " + json.dumps({
+        "process": pid,
+        "d_loss": float(jax.device_get(metrics["d_loss"])),
+        "g_leaf0_sum": float(jnp.sum(g0)),
+    }), flush=True)
+""")
+
+
 TP_CHILD = textwrap.dedent("""
     import json, os, sys
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -496,6 +544,55 @@ TP_CHILD = textwrap.dedent("""
         "resumed_g_loss": tr2.history[-1]["g_loss"],
     }), flush=True)
 """)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
+@pytest.mark.slow
+def test_two_process_dp_sp_tp_matches_single_device(tmp_path):
+    """The FULL 3-D dp×sp×tp step on a pod-wide 2×2×2 mesh spanning two
+    real processes (dp over the process boundary, sp×tp tiles
+    intra-process — the production layout): controlled sampling must
+    land on the single-device trajectory."""
+    script = tmp_path / "dpsptp_child.py"
+    script.write_text(DPSPTP_CHILD)
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "JAX_PLATFORMS": ""}
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              env=env, text=True)
+             for pid in (0, 1)]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"dp_sp_tp child failed:\n{out}\n{err}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT "):])
+        results[r["process"]] = r
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"],
+                               results[1]["g_leaf0_sum"], rtol=1e-6)
+
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_train_step
+
+    dataset = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    state, metrics = jax.jit(make_train_step(pair, tcfg, dataset))(
+        state, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(results[0]["d_loss"], float(metrics["d_loss"]),
+                               atol=1e-4)
+    g0 = jax.tree_util.tree_leaves(state.g_params)[0]
+    np.testing.assert_allclose(results[0]["g_leaf0_sum"], float(jnp.sum(g0)),
+                               atol=1e-4)
 
 
 @pytest.mark.skipif(sys.platform != "linux", reason="gloo/tcp path")
