@@ -61,6 +61,13 @@ class TrainConfig:
                                    # (50 measures ~7% faster than 25 through the
                                    # tunnel's ~4ms dispatch latency)
     lstm_backend: str = "auto"     # auto|pallas|xla — see ops/pallas_lstm.py
+    sp_microbatches: Optional[int] = None
+                                   # pipeline microbatch count M for the
+                                   # window-sharded (sp) paths; None = the sp
+                                   # axis size (square pipeline).  The measured
+                                   # recommendation at shipped shapes is M=1
+                                   # (latency-bound regime —
+                                   # parallel/sequence.py::sp_microbatch_plan)
 
 
 @dataclasses.dataclass(frozen=True)

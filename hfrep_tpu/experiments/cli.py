@@ -63,6 +63,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="full 3-D mesh, e.g. 2x2x2: batch over dp, window "
                         "over sp, hidden units over tp in one step "
                         "(parallel/dp_sp_tp.py)")
+    t.add_argument("--sp-microbatches", type=int, default=None, metavar="M",
+                   help="pipeline microbatch count for the window-sharded "
+                        "paths (--sp-mesh/--dp-sp/--dp-sp-tp); default: the "
+                        "sp axis size.  The measured recommendation at "
+                        "shipped shapes is 1 (latency-bound regime — "
+                        "parallel/sequence.py::sp_microbatch_plan)")
     t.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port — every "
                         "process runs this same command with its own "
@@ -153,7 +159,7 @@ def cmd_clean(args) -> int:
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
                   mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
                   sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None,
-                  dp_sp_tp=None):
+                  dp_sp_tp=None, sp_microbatches=None):
     if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp,
                       dp_sp_tp))) > 1:
         raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh, --dp-tp and "
@@ -207,6 +213,16 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
     if checkpoint_dir:
         cfg = dataclasses.replace(
             cfg, train=dataclasses.replace(cfg.train, checkpoint_dir=checkpoint_dir))
+    if sp_microbatches is not None:
+        if sp_microbatches < 1:
+            raise SystemExit(
+                f"--sp-microbatches wants M >= 1, got {sp_microbatches}")
+        if not (sp_mesh or dp_sp or dp_sp_tp):
+            raise SystemExit("--sp-microbatches requires a window-sharded "
+                             "mesh (--sp-mesh, --dp-sp or --dp-sp-tp)")
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train,
+                                           sp_microbatches=sp_microbatches))
     panel = load_panel(cleaned_dir)
     ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
     style = {"gan": "gan", "mtss_gan": "gan", "wgan": "wgan", "mtss_wgan": "wgan"}.get(
@@ -234,7 +250,8 @@ def cmd_train_gan(args) -> int:
         args.quiet, nan_guard=args.nan_guard,
         max_recoveries=args.max_recoveries,
         sp_mesh=args.sp_mesh, dp_sp=args.dp_sp,
-        tp_mesh=args.tp_mesh, dp_tp=args.dp_tp, dp_sp_tp=args.dp_sp_tp)
+        tp_mesh=args.tp_mesh, dp_tp=args.dp_tp, dp_sp_tp=args.dp_sp_tp,
+        sp_microbatches=args.sp_microbatches)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest

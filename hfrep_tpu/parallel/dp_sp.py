@@ -84,19 +84,29 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         raise ValueError(
             f"global batch {tcfg.batch_size} not divisible by dp={n_dp}")
     local_batch = tcfg.batch_size // n_dp
-    if local_batch % n_sp:
+    if tcfg.sp_microbatches is None:
+        if local_batch % n_sp:
+            raise ValueError(
+                f"per-dp-row batch {local_batch} not divisible by sp={n_sp} "
+                "(the pipeline's default microbatch count)")
+    elif tcfg.sp_microbatches < 1:
         raise ValueError(
-            f"per-dp-row batch {local_batch} not divisible by sp={n_sp} "
-            "(the pipeline's default microbatch count)")
+            f"sp_microbatches must be >= 1, got {tcfg.sp_microbatches}")
+    elif local_batch % tcfg.sp_microbatches:
+        raise ValueError(
+            f"per-dp-row batch {local_batch} not divisible by "
+            f"sp_microbatches={tcfg.sp_microbatches}")
     if dataset.shape[1] % n_sp:
         raise ValueError(
             f"window {dataset.shape[1]} not divisible by sp={n_sp}")
     slope = pair.generator.slope
     g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=sp_axis,
                                        activation="sigmoid", slope=slope,
+                                       microbatches=tcfg.sp_microbatches,
                                        backend=backend, manual=True,
                                        tp_axis=tp_axis)
     d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=sp_axis,
+                                     microbatches=tcfg.sp_microbatches,
                                      backend=backend, manual=True,
                                      tp_axis=tp_axis)
     local_tcfg = dataclasses.replace(tcfg, batch_size=local_batch)

@@ -163,7 +163,9 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         from hfrep_tpu.parallel.tensor import _check_width
         for h in h_dims:
             _check_width(h, n_tp)
-    m = microbatches or n_dev
+    m = n_dev if microbatches is None else microbatches
+    if m < 1:
+        raise ValueError(f"microbatches must be >= 1, got {m}")
     if b % m:
         raise ValueError(f"batch {b} not divisible by microbatches {m}")
     if w % n_dev:
@@ -484,6 +486,11 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
 
     axis_name = _resolve_axis(mesh, axis_name)
     validate_sp_pair(pair)
+    if microbatches is None:
+        # config-driven M (TrainConfig.sp_microbatches; the measured
+        # recommendation at shipped shapes is M=1 — sp_microbatch_plan);
+        # an explicit kwarg wins.
+        microbatches = tcfg.sp_microbatches
     slope = pair.generator.slope
 
     # Same resolution/validation as the plain step: 'auto' → pallas on a

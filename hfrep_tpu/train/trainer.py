@@ -56,6 +56,8 @@ class GanTrainer:
                 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
                 self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
             elif names == ("sp",):
+                # sp_microbatches reaches the pipeline via cfg.train
+                # (the step builders resolve it from their tcfg)
                 from hfrep_tpu.parallel.sequence import make_sp_multi_step
                 self._multi = make_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
             elif names == ("tp",):

@@ -436,20 +436,19 @@ DPSPTP_CHILD = textwrap.dedent("""
 
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh
     from hfrep_tpu.config import ModelConfig, TrainConfig
     from hfrep_tpu.models.registry import build_gan
     from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_train_step
+    from hfrep_tpu.parallel.mesh import make_mesh_3d
     from hfrep_tpu.train.states import init_gan_state
 
     # the FULL 3-D mesh over the pod in the production layout (dp
-    # outermost): with [proc0: devs 0-3, proc1: devs 4-7] reshaped
-    # (2, 2, 2), the dp gradient psums ride the process boundary while
-    # each sp×tp tile stays intra-process — the realistic pod topology
-    # (parallel/mesh.py::make_mesh_2d note); the cross-process sp-carry
-    # and tp-gather paths are covered by SP_CHILD / TP_CHILD above
-    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
-                ("dp", "sp", "tp"))
+    # outermost, make_mesh_3d): with [proc0: devs 0-3, proc1: devs 4-7]
+    # reshaped (2, 2, 2), the dp gradient psums ride the process
+    # boundary while each sp×tp tile stays intra-process — the realistic
+    # pod topology; the cross-process sp-carry and tp-gather paths are
+    # covered by SP_CHILD / TP_CHILD above
+    mesh = make_mesh_3d(2, 2, 2)
     dataset = jnp.asarray(
         np.random.default_rng(3).uniform(0, 1, (32, 16, 5)).astype(np.float32))
     mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)

@@ -25,8 +25,8 @@ needs_8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 
 
 def _mesh(dp=2, sp=2, tp=2):
-    return Mesh(np.asarray(jax.devices()[:dp * sp * tp]).reshape(dp, sp, tp),
-                ("dp", "sp", "tp"))
+    from hfrep_tpu.parallel.mesh import make_mesh_3d
+    return make_mesh_3d(dp, sp, tp)
 
 
 def _setup(window=16, batch=8, n_critic=2, hidden=8):

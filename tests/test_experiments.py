@@ -279,6 +279,9 @@ class TestCli:
         with pytest.raises(SystemExit, match="DPxSPxTP"):
             main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
                   "--quiet", "--dp-sp-tp", "nonsense"])
+        with pytest.raises(SystemExit, match="window-sharded"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--sp-microbatches", "1"])
 
     def test_train_gan_resume_completes_schedule(self, tmp_path, capsys):
         """--resume must finish the configured schedule, not retrain the
