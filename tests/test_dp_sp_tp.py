@@ -45,13 +45,17 @@ def _assert_tree_close(a, b, **tol):
 
 
 @needs_8
-def test_dp_sp_tp_train_step_matches_plain_step():
-    """One epoch on the 2×2×2 mesh (4-row dp slabs, 8-timestep sp
-    chunks, 4-unit tp slices), controlled sampling: same trajectory as
-    the single-device step — gradient penalty's second-order path
-    through the unit-sharded pipelined recurrences included."""
+@pytest.mark.parametrize("dims", [
+    (2, 2, 2),
+    pytest.param((1, 4, 2), marks=pytest.mark.slow)])
+def test_dp_sp_tp_train_step_matches_plain_step(dims):
+    """One epoch on the 3-D mesh, controlled sampling: same trajectory
+    as the single-device step — gradient penalty's second-order path
+    through the unit-sharded pipelined recurrences included.  The
+    (1, 4, 2) case proves the composition is not square-mesh-only
+    (whole batch on one dp slab, 4-timestep sp chunks)."""
     mcfg, tcfg, dataset, pair = _setup()
-    mesh = _mesh()
+    mesh = _mesh(*dims)
 
     s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
     st, m = make_dp_sp_tp_train_step(pair, tcfg, dataset, mesh,

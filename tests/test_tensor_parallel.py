@@ -67,6 +67,22 @@ def test_tp_generate_matches_single_device(tp):
 
 @needs_8
 @pytest.mark.slow
+def test_tp_generate_non_power_of_two_width():
+    """Hl need not be a power of two: H=12 over tp=3 (Hl=4) matches the
+    single-device apply — the slicing/gather layout generalizes beyond
+    the H % 2^k shapes the other tests use."""
+    _, _, _, pair = _setup(hidden=12)
+    key = jax.random.PRNGKey(5)
+    z = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 5))
+    params = pair.generator.init(key, z)["params"]
+    want = pair.generator.apply({"params": params}, z)
+    got = tp_generate(params, z, _mesh(3))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_8
+@pytest.mark.slow
 def test_tp_critic_matches_single_device_with_grads():
     """Unit-sharded critic (sliced gates + psum'd flatten head) matches
     LSTMFlatCritic in value AND gradients w.r.t. params and inputs —
