@@ -139,3 +139,13 @@ def test_dp_sp_validation_errors():
                                   hidden=8))
     with pytest.raises(ValueError, match="mtss_wgan_gp"):
         make_dp_sp_train_step(wrong, tcfg, dataset, _mesh(2, 4))
+    # TrainConfig.sp_microbatches reaches the composed path: per-dp-row
+    # batch 4 does not split into 3 microbatches, and M<1 refuses
+    with pytest.raises(ValueError, match="sp_microbatches=3"):
+        make_dp_sp_train_step(
+            pair, dataclasses.replace(tcfg, sp_microbatches=3), dataset,
+            _mesh(2, 4))
+    with pytest.raises(ValueError, match="must be >= 1"):
+        make_dp_sp_train_step(
+            pair, dataclasses.replace(tcfg, sp_microbatches=0), dataset,
+            _mesh(2, 4))

@@ -51,32 +51,20 @@ def _assert_tree_close(a, b, **tol):
 
 
 @needs_8
-@pytest.mark.parametrize("tp", [8, pytest.param(4, marks=pytest.mark.slow)])
-def test_tp_generate_matches_single_device(tp):
-    """Full MTSS generator with hidden units sharded (Hl = 1 at tp=8)
-    equals the single-device apply."""
-    mcfg, _, _, pair = _setup()
+@pytest.mark.parametrize("tp,hidden", [
+    (8, 8),
+    pytest.param(4, 8, marks=pytest.mark.slow),
+    pytest.param(3, 12, marks=pytest.mark.slow)])
+def test_tp_generate_matches_single_device(tp, hidden):
+    """Full MTSS generator with hidden units sharded equals the
+    single-device apply — Hl = 1 at tp=8, and the (3, 12) case proves
+    Hl need not be a power of two (Hl=4 over three devices)."""
+    mcfg, _, _, pair = _setup(hidden=hidden)
     key = jax.random.PRNGKey(0)
     z = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 5))
     params = pair.generator.init(key, z)["params"]
     want = pair.generator.apply({"params": params}, z)
     got = tp_generate(params, z, _mesh(tp))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=2e-5, atol=2e-5)
-
-
-@needs_8
-@pytest.mark.slow
-def test_tp_generate_non_power_of_two_width():
-    """Hl need not be a power of two: H=12 over tp=3 (Hl=4) matches the
-    single-device apply — the slicing/gather layout generalizes beyond
-    the H % 2^k shapes the other tests use."""
-    _, _, _, pair = _setup(hidden=12)
-    key = jax.random.PRNGKey(5)
-    z = jax.random.normal(jax.random.fold_in(key, 1), (8, 16, 5))
-    params = pair.generator.init(key, z)["params"]
-    want = pair.generator.apply({"params": params}, z)
-    got = tp_generate(params, z, _mesh(3))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
 
