@@ -45,16 +45,19 @@ def _assert_tree_close(a, b, **tol):
 
 
 @needs_8
-@pytest.mark.parametrize("dims", [
-    (2, 2, 2),
-    pytest.param((1, 4, 2), marks=pytest.mark.slow)])
-def test_dp_sp_tp_train_step_matches_plain_step(dims):
+@pytest.mark.parametrize("dims,window", [
+    ((2, 2, 2), 16),
+    pytest.param((1, 4, 2), 16, marks=pytest.mark.slow),
+    pytest.param((1, 4, 2), 672, marks=pytest.mark.slow)])
+def test_dp_sp_tp_train_step_matches_plain_step(dims, window):
     """One epoch on the 3-D mesh, controlled sampling: same trajectory
     as the single-device step — gradient penalty's second-order path
     through the unit-sharded pipelined recurrences included.  The
     (1, 4, 2) case proves the composition is not square-mesh-only
-    (whole batch on one dp slab, 4-timestep sp chunks)."""
-    mcfg, tcfg, dataset, pair = _setup()
+    (whole batch on one dp slab, 4-timestep sp chunks); its W=672 case
+    is true long-context 3-D training (168 timesteps per sp device,
+    width-sharded)."""
+    mcfg, tcfg, dataset, pair = _setup(window=window)
     mesh = _mesh(*dims)
 
     s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
