@@ -24,6 +24,13 @@ against TF at default threading on the same host; this host has a single
 CPU core so the two anchors nearly coincide (documented in RESULTS.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The bench is also a regression GATE (VERDICT r4 item 5): each measured
+line has a floor derived from the four-round history (562.6 / 552.7 /
+551.1 headline; 168.8 / 168.1 prod; 518-540 dp; 133 sp) minus ~3%
+session-to-session jitter headroom.  A silent drift below any floor
+turns into a nonzero exit code — the driver's BENCH_r{N}.json records
+``rc`` — while the JSON line is still emitted for the record.
 """
 
 from __future__ import annotations
@@ -176,6 +183,17 @@ def main() -> None:
         "sp_prod_steps_per_sec": sp,
         "dp_devices": len(jax.devices()),
     }))
+
+    # Regression floors (RESULTS.md §bench-gate): fail loudly on silent
+    # drift.  Skipped measurements (dp/sp None) don't gate — their floors
+    # only apply when the number exists.
+    floors = {"headline": (steps, 535.0), "prod_168x36": (prod, 160.0),
+              "dp_shard_map": (dp, 500.0), "sp_prod": (sp, 125.0)}
+    failed = {n: (v, f) for n, (v, f) in floors.items()
+              if v is not None and v < f}
+    if failed:
+        print(f"bench: REGRESSION below floor: {failed}", file=sys.stderr)
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -90,7 +90,11 @@ class AEConfig:
     patience: int = 5              # :72 EarlyStopping(patience=5)
     leaky_slope: float = 0.2       # :25,:29
     ols_window: int = 24           # :133
-    lr: float = 2e-3               # keras Nadam() default lr=0.002 (:80)
+    lr: float = 1e-3               # tf.keras Nadam() default (:80 runs 2022-era
+                                   # tf.keras whose Nadam default is 1e-3 —
+                                   # verified against tf 2.21 in-image; 2e-3 was
+                                   # the standalone-Keras-1.x value and rounds
+                                   # 1-4 shipped it by mistake)
     seed: int = 123
     beta_mode: str = "first"       # "first" replicates ante()'s use of ae_ols_beta[0]
                                    # for every window (Autoencoder_encapsulate.py:167);

@@ -22,6 +22,15 @@ Round 4 built the pairwise compositions — dp×sp
   psum, so :func:`~hfrep_tpu.parallel.sequence.sp_generate` /
   :func:`~hfrep_tpu.parallel.sequence.sp_critic` compose unchanged.
 
+Honest costing note (ADVICE r4): in this 3-D path the inter-layer
+``_tp_assemble`` masked psum runs **once per superstep per layer** —
+O((M + D_sp − 1) · layers) collectives, including on inactive fill/drain
+supersteps — where the plain tp path reassembles once per layer.  At the
+shipped shapes (M=1, D_sp ≤ 4, 2 LSTM layers) that is ≤ 10 extra psums
+of (Bm, W/D, H) chunks per epoch; on a pod, weigh it against the 2-D
+meshes before picking the 3-D layout (RESULTS.md §tensor-parallel
+honest-costing).
+
 Params and optimizer state stay replicated over all three axes
 (``check_vma=True`` proves it), and a controlled-sampling run at the
 same global batch follows the single-device trajectory to f32 round-off

@@ -491,6 +491,20 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
         # recommendation at shipped shapes is M=1 — sp_microbatch_plan);
         # an explicit kwarg wins.
         microbatches = tcfg.sp_microbatches
+    # Mirror the dp×sp builder's build-time checks (dp_sp.py:87-103) so a
+    # bad M refuses here rather than on the first call inside _sp_pipeline.
+    n_sp = mesh.shape[axis_name]
+    m_eff = n_sp if microbatches is None else microbatches
+    if m_eff < 1:
+        raise ValueError(f"sp_microbatches must be >= 1, got {m_eff}")
+    if tcfg.batch_size % m_eff:
+        raise ValueError(
+            f"batch {tcfg.batch_size} not divisible by sp_microbatches="
+            f"{m_eff}" + ("" if microbatches is not None else
+                          " (the pipeline's default M = sp devices)"))
+    if dataset.shape[1] % n_sp:
+        raise ValueError(
+            f"window {dataset.shape[1]} not divisible by sp={n_sp} devices")
     slope = pair.generator.slope
 
     # Same resolution/validation as the plain step: 'auto' → pallas on a

@@ -68,16 +68,22 @@ from hfrep_tpu.utils.vma import match_vma
 
 
 def _resolve_tp_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
-    """The tp axis: the mesh's only axis for a 1-D mesh, or the axis
-    literally named ``"tp"`` on a multi-axis mesh."""
+    """The tp axis: the axis literally named ``"tp"``, else whatever the
+    caller names explicitly.  A bare single-axis mesh named e.g.
+    ``('dp',)`` is refused rather than silently width-sharded — handing
+    the wrong mesh to a tp builder is a mix-up, not a request
+    (consistent with the trainer's name-based dispatch,
+    ``train/trainer.py:48-51``)."""
     if axis_name is not None:
+        if axis_name not in mesh.axis_names:
+            raise ValueError(
+                f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
         return axis_name
-    if len(mesh.axis_names) == 1:
-        return mesh.axis_names[0]
     if "tp" in mesh.axis_names:
         return "tp"
     raise ValueError(
-        f"pass axis_name explicitly for multi-axis mesh {mesh.axis_names}")
+        f"mesh {mesh.axis_names} has no 'tp' axis; pass axis_name "
+        f"explicitly to shard hidden units over a differently-named axis")
 
 
 def _check_width(h: int, n_dev: int) -> int:
@@ -293,6 +299,9 @@ def validate_tp_pair(pair, n_tp: int) -> None:
         raise ValueError(f"tensor-parallel step supports the "
                          f"mtss_wgan_gp family, got {pair.family!r}")
     _check_width(pair.generator.hidden, n_tp)
+    # the critic's width is sliced by the same Hl arithmetic — validate it
+    # here too so a mismatched pair fails at build, not at trace
+    _check_width(pair.discriminator.hidden, n_tp)
 
 
 def _validate_tp_backend(tcfg) -> None:

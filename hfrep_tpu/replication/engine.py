@@ -36,6 +36,7 @@ from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core import costs
 from hfrep_tpu.core import scaler as mm
 from hfrep_tpu.models.autoencoder import Autoencoder, latent_mask
+from hfrep_tpu.ops.optimizers import keras_nadam
 from hfrep_tpu.ops.rolling import expanding_minmax_scale, rolling_ols_beta
 
 import optax
@@ -71,7 +72,7 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
 
     key, init_key = jax.random.split(key)
     params = model.init(init_key, x_fit[:1])["params"]
-    tx = optax.nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # Keras Nadam defaults
+    tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # tf.keras-exact Nadam
     opt_state = tx.init(params)
 
     n_batches, padded = _epoch_batches(n_train, cfg.batch_size)

@@ -59,3 +59,28 @@ def test_exported_artifact_loads_in_keras(tmp_path):
     model = tf.keras.models.load_model(path, compile=False)
     got = model.predict(np.asarray(z), verbose=0)
     np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+@needs_tf
+def test_keras_oracle_at_production_shape(tmp_path):
+    """The real consumer check at the real artifact shape: export the
+    production-config generator (h=100, 168×36 — the shape of
+    ``MTTS_GAN_GP20220621_02-49-32.h5``), load it with
+    ``tf.keras.models.load_model``, and compare ``predict`` outputs to
+    the Flax module within the importer-oracle tolerance (≤1e-4)."""
+    import tensorflow as tf
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=100, window=168,
+                       features=36)
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(2)
+    z = jax.random.normal(key, (4, mcfg.window, mcfg.features))
+    params = pair.generator.init(key, z)["params"]
+    expected = np.asarray(pair.generator.apply({"params": params}, z))
+
+    from hfrep_tpu.utils.keras_export import export_keras_generator
+    path = export_keras_generator(mcfg, params, str(tmp_path / "gen.h5"))
+    model = tf.keras.models.load_model(path, compile=False)
+    got = model.predict(np.asarray(z), verbose=0)
+    assert got.shape == (4, mcfg.window, mcfg.features)
+    np.testing.assert_allclose(got, expected, atol=1e-4)

@@ -275,6 +275,118 @@ class TestSuite:
         with pytest.raises(ValueError):
             ge.GanEval(real[:5], fake, dataset)
 
+    @pytest.mark.slow
+    @pytest.mark.skipif(
+        not __import__("os").path.exists("/root/reference/GAN/GAN_eval.py"),
+        reason="reference GAN_eval.py not mounted")
+    def test_compat_run_all_matches_reference_end_to_end(self):
+        """The WHOLE 12-metric suite in ``reference_compat=True`` vs the
+        reference's own ``GAN_eval`` class executed on the same cubes
+        (VERDICT r4 item 8): 'reproduces the original behavior' asserted
+        as one vector, not per-metric.  The reference module is pure
+        numpy/scipy/sklearn/statsmodels (``GAN/GAN_eval.py:1-12``) so it
+        runs as the oracle directly."""
+        import importlib.util
+        import sys
+        import types
+        import matplotlib
+        matplotlib.use("Agg")
+
+        # The image ships no statsmodels; the reference uses exactly
+        # three symbols from it.  Stub them with the textbook formulas
+        # (statsmodels acf = biased autocovariance ratio; OLS without
+        # constant = lstsq; ECDF is eyeball-only).
+        def _acf(x, nlags):
+            x = np.asarray(x, float)
+            xc = x - x.mean()
+            denom = np.dot(xc, xc)
+            return np.array([1.0] + [np.dot(xc[:-k], xc[k:]) / denom
+                                     for k in range(1, nlags + 1)])
+
+        class _OLSFit:
+            def __init__(self, params):
+                self.params = params
+
+            def predict(self, x):
+                return np.asarray(x, float) @ self.params
+
+        class _OLS:
+            def __init__(self, y, x):
+                self._y = np.asarray(y, float)
+                self._x = np.asarray(x, float)
+
+            def fit(self):
+                params = np.linalg.lstsq(self._x, self._y, rcond=None)[0]
+                return _OLSFit(params)
+
+        class _ECDF:
+            def __init__(self, sample):
+                self._s = np.sort(np.asarray(sample, float))
+
+            def __call__(self, v):
+                return np.searchsorted(self._s, v, side="right") / len(self._s)
+
+        sm = types.ModuleType("statsmodels")
+        sm_dist = types.ModuleType("statsmodels.distributions")
+        sm_dist.ECDF = _ECDF
+        sm_reg = types.ModuleType("statsmodels.regression.linear_model")
+        sm_reg.OLS = _OLS
+        sm_tsa = types.ModuleType("statsmodels.tsa.stattools")
+        sm_tsa.acf = _acf
+        mods = {"statsmodels": sm, "statsmodels.distributions": sm_dist,
+                "statsmodels.regression": types.ModuleType("statsmodels.regression"),
+                "statsmodels.regression.linear_model": sm_reg,
+                "statsmodels.tsa": types.ModuleType("statsmodels.tsa"),
+                "statsmodels.tsa.stattools": sm_tsa}
+        saved = {k: sys.modules.get(k) for k in mods}
+        sys.modules.update(mods)
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "ref_gan_eval", "/root/reference/GAN/GAN_eval.py")
+            ref_mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(ref_mod)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    sys.modules.pop(k, None)
+                else:
+                    sys.modules[k] = v
+
+        g = np.random.default_rng(7)
+        # window > nlags=17 for a full ACF, and features=20 > nlags+1=18
+        # so the reference ACF aggregation's range(shape[1]) quirk (it
+        # averages the first 18 feature rows — GAN_eval.py:358-359, fine
+        # at the real F=35, crash at F<18) is exercised the way the
+        # reference's own shapes exercise it.
+        shape = (24, 20, 20)
+        real = g.normal(size=shape).astype(np.float32)
+        fake = (g.normal(size=shape) * 1.2 + 0.1).astype(np.float32)
+        dataset = g.normal(size=shape).astype(np.float32)
+
+        oracle = ref_mod.GAN_eval(real.astype(np.float64),
+                                  fake.astype(np.float64),
+                                  dataset.astype(np.float64),
+                                  ["t"] * shape[2], ["Benchmark"])
+        ours = ge.GanEval(real, fake, dataset,
+                          model_name=["Benchmark"],
+                          reference_compat=True).run_all()
+
+        # f32-vs-f64 per-metric tolerances; FID additionally crosses
+        # eigh-sqrtm vs scipy sqrtm
+        tol = {"FID": 2e-3, "ACF": 1e-3, "Inception_score": 1e-3,
+               "R2_relative_error": 5e-3, "gaussian_MMD": 1e-3,
+               "js_div": 2e-3, "kl_div": 2e-3, "ks_test": 1e-3,
+               "linear_MMD": 1e-3, "lp_dist": 1e-3, "poly_MMD": 1e-3,
+               "wasserstein": 1e-3}
+        mism = {}
+        for name in ge.GanEval.METRICS:
+            expected = float(np.asarray(getattr(oracle, name)()))
+            got = ours[name]
+            denom = max(abs(expected), 1e-3)
+            if abs(got - expected) / denom > tol[name]:
+                mism[name] = (got, expected)
+        assert not mism, mism
+
     def test_eyeball_writes_png(self, cubes, tmp_path):
         real, fake, dataset = cubes
         suite = ge.GanEval(real, fake, dataset, model_name=["Benchmark"])
