@@ -23,6 +23,11 @@ from hfrep_tpu.parallel.dp_sp_tp import (  # noqa: F401
     make_dp_sp_tp_multi_step,
     make_dp_sp_tp_train_step,
 )
+from hfrep_tpu.parallel.layer_pipeline import (  # noqa: F401
+    make_pp_train_step,
+    pp_critic,
+    pp_generate,
+)
 from hfrep_tpu.parallel.tensor import (  # noqa: F401
     make_dp_tp_multi_step,
     make_dp_tp_train_step,

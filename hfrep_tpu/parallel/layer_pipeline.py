@@ -1,0 +1,288 @@
+"""Layer (pipeline) parallelism over the 2-LSTM stack — built to measure,
+measured to be dominated (RESULTS.md "Layer pipeline: the depth axis").
+
+The reference's models are two stacked LSTMs plus a small head
+(``GAN/MTSS_WGAN_GP.py:237-284``) on one GPU — no layer pipelining
+exists to port.  This module is the classic GPipe-style depth split the
+VERDICT r4 stretch item asks about, composed the TPU way:
+
+* a ``('pp',)`` mesh of exactly 2 devices (the stack's depth) — stage 0
+  owns layer 0's LSTM weights, stage 1 owns layer 1's (stacked leading
+  axis sharded over ``pp``; the tiny non-recurrent params — LayerNorms,
+  output/score Dense — ride replicated, they are <2% of the bytes);
+* the batch splits into M microbatches; stage k runs microbatch m at
+  superstep s = k + m, so both stages compute concurrently after a
+  1-superstep fill;
+* the full (Bm, W, H) hidden *sequence* of stage 0 crosses to stage 1
+  via `lax.ppermute` each superstep — layer pipelining's inter-stage
+  traffic is W·H floats per row where sequence parallelism's carry
+  handoff is 2·H (the first structural strike against the axis);
+* outputs accumulate on stage 1 and reassemble with a masked `psum`
+  (typed invariant — same rationale as :func:`sp_generate`).
+
+Exactness: stage selection is by masking inside one SPMD program (both
+stages trace the same ops; each superstep runs ONE full-window
+zero-carry scan with this device's stage weights), so values and
+gradients — including the WGAN-GP second-order path — match the plain
+modules to f32 round-off (tests/test_layer_pipeline.py), and
+:func:`make_pp_train_step` is trajectory-exact vs the plain step via the
+same ``make_train_step(apply_fns=...)`` contract as sp/tp.
+
+Why it loses (the measured negative, RESULTS.md): at the shipped shapes
+the per-timestep recurrent matmul is latency-floor-bound below ~32 rows
+(the sp microbatch study's measured t_step), so an M-way microbatch
+split does not shrink superstep time — pp time ≈ (M+1)·W·t vs the plain
+step's 2·W·t: parity at M=1 *using two devices*, strictly worse for
+M ≥ 2, against dp=2's ~1.9× on the same two devices.  The capacity
+lever is just as empty: stages would shard ~0.4 MB of parameters while
+the real HBM driver is W-proportional activations — the axis sequence
+parallelism already shards (results/sp_capacity.json).  Kept as a
+working, tested implementation so the negative is a measurement, not an
+opinion.
+
+Backend: XLA scan only.  An explicit ``lstm_backend='pallas'`` refuses
+(the fused kernels are single-device whole-stack programs; splitting the
+stack across chips is exactly what pp does, so the kernel fusion and the
+pp axis are mutually exclusive by construction); ``'auto'`` quietly
+takes the scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hfrep_tpu.ops.layers import ACTIVATIONS
+from hfrep_tpu.utils.vma import match_vma
+from hfrep_tpu.parallel.sequence import (_local_chunk_scan, _sp_head_impl,
+                                         _sp_ln)
+
+N_STAGES = 2          # the stack's depth — pp's one honest configuration
+
+
+def _resolve_pp_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
+    """Fail fast on mesh mix-ups (the ADVICE r4 tp lesson applied from
+    birth): the axis must be literally named ``'pp'`` unless the caller
+    names one explicitly, and must span exactly 2 devices."""
+    if axis_name is None:
+        if "pp" not in mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} have no 'pp' axis; pass "
+                f"axis_name explicitly to shard layers over another name")
+        axis_name = "pp"
+    if mesh.shape[axis_name] != N_STAGES:
+        raise ValueError(
+            f"layer pipeline needs exactly {N_STAGES} '{axis_name}' devices "
+            f"(the stack depth), got {mesh.shape[axis_name]}")
+    return axis_name
+
+
+def _stack_stage_params(l0: dict, l1: dict, pad_to: int):
+    """Stack the two layers' LSTM params on a leading stage axis, zero-
+    padding layer 0's (F, 4H) kernel rows up to ``pad_to`` so both
+    stages run the identical SPMD op shapes.  Zero rows never touch real
+    values: the padded input lanes are zero-filled in lockstep."""
+    k0, k1 = l0["kernel"], l1["kernel"]
+    k0 = jnp.pad(k0, ((0, pad_to - k0.shape[0]), (0, 0)))
+    k1 = jnp.pad(k1, ((0, pad_to - k1.shape[0]), (0, 0)))
+    return {"kernel": jnp.stack([k0, k1]),
+            "recurrent_kernel": jnp.stack([l0["recurrent_kernel"],
+                                           l1["recurrent_kernel"]]),
+            "bias": jnp.stack([l0["bias"], l1["bias"]])}
+
+
+def _pp_pipeline(stage_params, aux_params, x: jnp.ndarray, mesh: Mesh, *,
+                 axis_name: str, microbatches: Optional[int],
+                 send_fn, head_fn, out_tail: Tuple[int, ...],
+                 activation: str, recurrent_activation: str = "sigmoid"):
+    """Run the 2-stage GPipe schedule; returns stage 1's head outputs
+    reassembled to (B, *out_tail), replicated over the mesh.
+
+    ``send_fn(aux, h_seq)`` transforms stage 0's scan output before the
+    inter-stage handoff (the generator's first LayerNorm; identity for
+    the critic).  ``head_fn(aux, h_seq)`` maps stage 1's scan output to
+    the model output.  Both are traced on BOTH devices (SPMD) and
+    masked — they are per-timestep/head ops, <2% of a superstep's FLOPs
+    next to the W-step recurrence.
+    """
+    n_dev = mesh.shape[axis_name]
+    b, w, f = x.shape
+    h = stage_params["recurrent_kernel"].shape[-2]
+    pad_to = stage_params["kernel"].shape[-2]
+    m = microbatches if microbatches is not None else N_STAGES
+    if m < 1:
+        raise ValueError(f"microbatches must be >= 1, got {m}")
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    bm = b // m
+    act = ACTIVATIONS[activation]
+    rec_act = ACTIVATIONS[recurrent_activation]
+
+    def per_device(sp_loc, aux, x_full):
+        # sp_loc: this stage's (1, ...) param slices; squeeze the stage axis.
+        kern = sp_loc["kernel"][0]                  # (P, 4H)
+        rec = sp_loc["recurrent_kernel"][0]         # (H, 4H)
+        bias = sp_loc["bias"][0]                    # (4H,)
+        k_idx = lax.axis_index(axis_name)
+        is_first = k_idx == 0
+        is_last = k_idx == n_dev - 1
+        # Replicated input, padded to the common lane width and split
+        # into microbatches: (M, Bm, W, P).
+        x_pad = jnp.pad(x_full, ((0, 0), (0, 0), (0, pad_to - f)))
+        x_mb = x_pad.reshape(m, bm, w, pad_to)
+
+        def superstep(recv, s):
+            mb = s - k_idx                          # this stage's microbatch id
+            active = jnp.logical_and(mb >= 0, mb < m)
+            x_sel = lax.dynamic_index_in_dim(x_mb, jnp.clip(mb, 0, m - 1),
+                                             axis=0, keepdims=False)
+            y_in = jnp.where(is_first, x_sel, recv)  # (Bm, W, P)
+            # One full-window zero-carry scan with this stage's weights —
+            # the projection is one (Bm·W, P) MXU matmul, the recurrence
+            # the same fused cell every other path scans.
+            xz = (y_in.reshape(bm * w, pad_to) @ kern + bias)
+            xz = jnp.swapaxes(xz.reshape(bm, w, 4 * h), 0, 1)
+            zeros = match_vma(jnp.zeros((bm, h), xz.dtype), xz)
+            _, h_seq = _local_chunk_scan(xz, (zeros, zeros), rec, act, rec_act)
+            h_seq = jnp.swapaxes(h_seq, 0, 1)       # (Bm, W, H)
+            # Stage 0 → stage 1 handoff: the transformed full hidden
+            # sequence, re-padded to the common lane width.  Masking
+            # keeps fill/drain garbage out of the pipe (bounded here —
+            # the activations saturate — but zeroing is free and makes
+            # the schedule's data flow exact by construction).
+            send = send_fn(aux, h_seq)
+            send = jnp.pad(send, ((0, 0), (0, 0), (0, pad_to - h)))
+            send = jnp.where(active, send, 0.0)
+            recv_next = lax.ppermute(send, axis_name,
+                                     perm=[(k, k + 1) for k in range(n_dev - 1)])
+            out = head_fn(aux, h_seq)               # (Bm, *out_tail)
+            out = jnp.where(jnp.logical_and(is_last, active), out, 0.0)
+            return recv_next, out
+
+        recv0 = match_vma(jnp.zeros((bm, w, pad_to), x_full.dtype),
+                          lax.axis_index(axis_name))
+        _, ys = lax.scan(superstep, recv0, jnp.arange(m + n_dev - 1))
+        # Stage 1 emits microbatch mb at superstep mb + (n_dev - 1); the
+        # masked psum reassembles (only the last stage contributes).
+        outs = ys[(n_dev - 1) + jnp.arange(m)]      # (M, Bm, *out_tail)
+        outs = lax.psum(outs, axis_name)
+        return outs.reshape(b, *out_tail)
+
+    return shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=P())(stage_params, aux_params, x)
+
+
+def pp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
+                axis_name: Optional[str] = None, slope: float = 0.2,
+                activation: str = "sigmoid", ln_eps: float = 1e-3,
+                microbatches: Optional[int] = None) -> jnp.ndarray:
+    """The FULL MTSS generator with the two recurrences on different
+    pipeline stages: stage 0 = LSTM₀ + LayerNorm₀, stage 1 = LSTM₁ +
+    (LeakyReLU → LayerNorm₁ → Dense) — the same head helpers the sp path
+    runs (:func:`hfrep_tpu.parallel.sequence._sp_head_impl`), so the two
+    parallel modes share one arithmetic."""
+    axis_name = _resolve_pp_axis(mesh, axis_name)
+    f = z.shape[-1]
+    h = g_params["KerasLSTM_0"]["recurrent_kernel"].shape[0]
+    stage = _stack_stage_params(g_params["KerasLSTM_0"],
+                                g_params["KerasLSTM_1"], max(f, h))
+    aux = {"KerasLayerNorm_0": g_params["KerasLayerNorm_0"],
+           "KerasLayerNorm_1": g_params["KerasLayerNorm_1"],
+           "KerasDense_0": g_params["KerasDense_0"]}
+    return _pp_pipeline(
+        stage, aux, z, mesh, axis_name=axis_name, microbatches=microbatches,
+        send_fn=lambda a, v: _sp_ln(a["KerasLayerNorm_0"], v, ln_eps),
+        head_fn=lambda a, v: _sp_head_impl(a, v, slope, ln_eps),
+        out_tail=(z.shape[1], f), activation=activation)
+
+
+def pp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
+              axis_name: Optional[str] = None,
+              microbatches: Optional[int] = None) -> jnp.ndarray:
+    """The MTSS-WGAN-GP critic depth-split: stage 0 = LSTM₀, stage 1 =
+    LSTM₁ + flattened (W·H → 1) score head; (B, W, F) → (B, 1)."""
+    axis_name = _resolve_pp_axis(mesh, axis_name)
+    f = x.shape[-1]
+    h = d_params["KerasLSTM_0"]["recurrent_kernel"].shape[0]
+    stage = _stack_stage_params(d_params["KerasLSTM_0"],
+                                d_params["KerasLSTM_1"], max(f, h))
+
+    def head(aux, h_seq):
+        dense = aux["Dense_0"]
+        bb = h_seq.shape[0]
+        s = h_seq.reshape(bb, -1) @ dense["kernel"]
+        return s + dense["bias"] if "bias" in dense else s
+
+    return _pp_pipeline(
+        stage, d_params["KerasDense_0"], x, mesh, axis_name=axis_name,
+        microbatches=microbatches,
+        send_fn=lambda a, v: v, head_fn=head,
+        out_tail=(1,), activation="tanh")
+
+
+def validate_pp_pair(pair) -> None:
+    """Same flagship-family precondition as the sp/tp steps: the pp
+    modules mirror the LSTMGenerator / LSTMFlatCritic trees, f32."""
+    if pair.family != "mtss_wgan_gp":
+        raise ValueError(f"layer-pipeline step supports the mtss_wgan_gp "
+                         f"family, got {pair.family!r}")
+    if (pair.generator.dtype or jnp.float32) != jnp.float32:
+        raise NotImplementedError(
+            "layer-pipeline step runs f32; configure dtype=float32")
+
+
+def _validate_pp_backend(tcfg) -> None:
+    from hfrep_tpu.train.steps import resolve_lstm_backend
+
+    if tcfg.lstm_backend == "pallas":
+        raise NotImplementedError(
+            "layer-pipeline recurrences run the XLA scan: the pallas "
+            "kernels fuse the WHOLE 2-layer stack into one single-device "
+            "program (ops/pallas_lstm_stack.py) — splitting the stack "
+            "across chips is the opposite layout, so the kernel fusion "
+            "and the pp axis are mutually exclusive by construction")
+    resolve_lstm_backend(tcfg.lstm_backend)      # keep the usual ValueError
+
+
+def make_pp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: Optional[str] = None,
+                       microbatches: Optional[int] = None, jit: bool = True):
+    """Layer-pipelined MTSS-WGAN-GP training: the full epoch (n_critic GP
+    critic updates + generator update) with the stack depth-split over
+    the ``pp`` mesh axis, trajectory-exact vs the plain step.
+
+    Step semantics (sampling streams, critic loop, optimizer updates)
+    are shared verbatim with the single-device step via
+    ``make_train_step(apply_fns=...)`` — the same reuse contract as the
+    sp/tp/dp×sp steps, so no parallel mode can drift arithmetically.
+    Exists to make the depth axis *measurable*; the measurement is a
+    negative (module docstring + RESULTS.md), so nothing in the trainer
+    or CLI dispatches to it — the classroom copy, kept honest.
+    """
+    from hfrep_tpu.parallel.sequence import _jit_replicated_out
+    from hfrep_tpu.train.steps import make_train_step
+
+    axis_name = _resolve_pp_axis(mesh, axis_name)
+    validate_pp_pair(pair)
+    _validate_pp_backend(tcfg)
+    m_eff = N_STAGES if microbatches is None else microbatches
+    if m_eff < 1:
+        raise ValueError(f"microbatches must be >= 1, got {m_eff}")
+    if tcfg.batch_size % m_eff:
+        raise ValueError(f"batch {tcfg.batch_size} not divisible by "
+                         f"microbatches={m_eff}")
+    slope = pair.generator.slope
+    g_apply = lambda p, z: pp_generate(p, z, mesh, axis_name=axis_name,
+                                       slope=slope, microbatches=microbatches)
+    d_apply = lambda p, x: pp_critic(p, x, mesh, axis_name=axis_name,
+                                     microbatches=microbatches)
+    step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
+    return _jit_replicated_out(step, mesh) if jit else step

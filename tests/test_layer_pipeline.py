@@ -1,0 +1,147 @@
+"""Layer-pipelined (depth-split) stack vs single-device modules.
+
+The pp axis is a measured negative (RESULTS.md "Layer pipeline: the
+depth axis") — these tests pin that the implementation the measurement
+rests on is exact: values, gradients (incl. the GP second-order path via
+the trajectory test), and the build-time refusals.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+needs_2 = pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+
+
+@needs_2
+@pytest.mark.parametrize("m", [1, 2, 4])
+def test_pp_generator_matches_single_device(m):
+    from hfrep_tpu.models.generators import LSTMGenerator
+    from hfrep_tpu.parallel.layer_pipeline import pp_generate
+
+    gen = LSTMGenerator(features=6, hidden=8)
+    key = jax.random.PRNGKey(0)
+    z = jax.random.normal(jax.random.fold_in(key, 1), (8, 12, 6))
+    params = gen.init(key, z)["params"]
+    want = gen.apply({"params": params}, z)
+    got = pp_generate(params, z, _mesh(), microbatches=m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_2
+def test_pp_critic_matches_single_device_with_grads():
+    """Values AND gradients w.r.t. params and inputs (the GP path)."""
+    from hfrep_tpu.models.discriminators import LSTMFlatCritic
+    from hfrep_tpu.parallel.layer_pipeline import pp_critic
+
+    critic = LSTMFlatCritic(hidden=8)
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (8, 10, 6))
+    params = critic.init(key, x)["params"]
+    mesh = _mesh()
+
+    want = critic.apply({"params": params}, x)
+    got = pp_critic(params, x, mesh, microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_ref(p, v):
+        return jnp.sum(critic.apply({"params": p}, v) ** 2)
+
+    def loss_pp(p, v):
+        return jnp.sum(pp_critic(p, v, mesh, microbatches=2) ** 2)
+
+    gp_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(params, x)
+    gp_pp, gx_pp = jax.grad(loss_pp, argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(gp_pp),
+                    jax.tree_util.tree_leaves(gp_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx_pp), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@needs_2
+@pytest.mark.slow
+@pytest.mark.parametrize("m", [1, 4])
+def test_pp_train_step_matches_plain_step(m):
+    """Depth-split WGAN-GP training (GP second-order through both
+    pipeline stages) follows the plain single-device trajectory."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.layer_pipeline import make_pp_train_step
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=12, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2)
+    dataset = jnp.asarray(np.random.default_rng(3).uniform(
+        0, 1, (32, 12, 5)).astype(np.float32))
+    pair = build_gan(mcfg)
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    pp_state, pp_m = make_pp_train_step(pair, tcfg, dataset, _mesh(),
+                                        microbatches=m)(
+        s0, jax.random.PRNGKey(1))
+
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    ref_state, ref_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+
+    for k in ref_m:
+        np.testing.assert_allclose(float(pp_m[k]), float(ref_m[k]),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pp_state.g_params)
+                    + jax.tree_util.tree_leaves(pp_state.d_params),
+                    jax.tree_util.tree_leaves(ref_state.g_params)
+                    + jax.tree_util.tree_leaves(ref_state.d_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    assert int(pp_state.step) == 1
+
+
+@needs_2
+def test_pp_build_time_refusals():
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.layer_pipeline import (_resolve_pp_axis,
+                                                   make_pp_train_step)
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=12, hidden=8)
+    pair = build_gan(mcfg)
+    dataset = jnp.zeros((32, 12, 5))
+    mesh = _mesh()
+
+    # mesh without a 'pp' axis fails fast (the ADVICE r4 tp lesson)
+    dp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    with pytest.raises(ValueError, match="no 'pp' axis"):
+        _resolve_pp_axis(dp_mesh, None)
+    # wrong stage count
+    if len(jax.devices()) >= 4:
+        wide = Mesh(np.asarray(jax.devices()[:4]), ("pp",))
+        with pytest.raises(ValueError, match="exactly 2"):
+            _resolve_pp_axis(wide, None)
+    # bad M refuses at build, not first call
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_train_step(pair, TrainConfig(batch_size=8), dataset, mesh,
+                           microbatches=3)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_pp_train_step(pair, TrainConfig(batch_size=8), dataset, mesh,
+                           microbatches=0)
+    # wrong family
+    vcfg = ModelConfig(family="gan", features=5, window=12, hidden=8)
+    with pytest.raises(ValueError, match="mtss_wgan_gp"):
+        make_pp_train_step(build_gan(vcfg), TrainConfig(batch_size=8),
+                           dataset, mesh)
+    # pallas request refuses with the fusion rationale
+    with pytest.raises(NotImplementedError, match="mutually exclusive"):
+        make_pp_train_step(pair, TrainConfig(batch_size=8,
+                                             lstm_backend="pallas"),
+                           dataset, mesh)

@@ -1,0 +1,116 @@
+"""Measure the layer-pipeline (pp) axis against its competitors on the
+same 2 devices (VERDICT r4 item 9 — the depth-axis negative).
+
+Three steps, identical flagship semantics, identical (B=32, W, F, H):
+
+  plain   single-device step (1 device busy)
+  dp=2    batch split over ('dp', 2) — the incumbent use of 2 devices
+  pp M=k  depth split over ('pp', 2), microbatches M ∈ {1, 2, 4}
+
+Run on the 8-virtual-device CPU mesh (the only multi-device host we
+have; the schedule and collectives are the real ones, the clock is a
+CPU's).  The chip-anchored prediction — supersteps × per-timestep
+latency with the measured ~2 µs floor from the sp microbatch study —
+is printed next to each measurement; on TPU the recurrence is
+latency-bound at these shapes, so the CPU ratios UNDERSTATE pp's
+penalty wherever CPU matmul time scales with Bm (the chip's doesn't).
+
+run: python tools/bench_pp.py [--window 48] [--reps 5]
+(forces the CPU backend itself — sitecustomize's JAX_PLATFORMS=axon pin
+is overridden via jax.config.update, the only override that wins)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The image's sitecustomize pins JAX_PLATFORMS=axon (the tunneled TPU);
+# config.update is the override that actually wins (tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.train.states import init_gan_state
+
+
+def _time_step(step, state, reps):
+    state, m = step(state, jax.random.PRNGKey(99))      # compile + warm
+    jax.block_until_ready(m["d_loss"])
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, m = step(state, jax.random.PRNGKey(100 + r))
+        jax.block_until_ready(m["d_loss"])
+    return (time.perf_counter() - t0) / reps * 1e3      # ms/epoch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--window", type=int, default=48)
+    ap.add_argument("--features", type=int, default=35)
+    ap.add_argument("--hidden", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
+    from hfrep_tpu.parallel.layer_pipeline import make_pp_train_step
+    from hfrep_tpu.train.steps import make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", window=args.window,
+                       features=args.features, hidden=args.hidden)
+    tcfg = TrainConfig(batch_size=32, steps_per_call=1, lstm_backend="xla")
+    dataset = jax.random.uniform(
+        jax.random.PRNGKey(0), (256, args.window, args.features))
+    pair = build_gan(mcfg)
+
+    def fresh():
+        return init_gan_state(jax.random.PRNGKey(1), mcfg, tcfg, pair)
+
+    rows = []
+    t_plain = _time_step(jax.jit(make_train_step(pair, tcfg, dataset)),
+                         fresh(), args.reps)
+    rows.append({"config": "plain (1 dev)", "ms_per_epoch": t_plain,
+                 "vs_plain": 1.0, "chip_model": 1.0})
+
+    dp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    t_dp = _time_step(make_dp_multi_step(pair, tcfg, dataset, dp_mesh),
+                      fresh(), args.reps)
+    rows.append({"config": "dp=2", "ms_per_epoch": t_dp,
+                 "vs_plain": t_dp / t_plain,
+                 "chip_model": None})   # dp splits rows: latency-parity on chip
+
+    pp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    for m in (1, 2, 4):
+        t_pp = _time_step(
+            make_pp_train_step(pair, tcfg, dataset, pp_mesh, microbatches=m),
+            fresh(), args.reps)
+        rows.append({"config": f"pp=2 M={m}", "ms_per_epoch": t_pp,
+                     "vs_plain": t_pp / t_plain,
+                     # latency-bound chip prediction: (M+1)·W·t vs 2·W·t
+                     "chip_model": (m + 1) / 2})
+
+    for r in rows:
+        cm = "" if r["chip_model"] is None else f"  chip-model {r['chip_model']:.2f}x"
+        print(f"{r['config']:14s} {r['ms_per_epoch']:9.1f} ms/epoch  "
+              f"{r['vs_plain']:.2f}x plain{cm}")
+    os.makedirs("results", exist_ok=True)
+    with open("results/bench_pp.json", "w") as f:
+        json.dump({"window": args.window, "rows": rows}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

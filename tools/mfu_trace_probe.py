@@ -1,0 +1,134 @@
+"""Profiler cross-check of the analytic MFU numbers (VERDICT r4 item 6).
+
+`tools/flops_accounting.py` derives 19.6-21 TFLOP/s achieved from
+analytic model FLOPs x measured steps/s (XLA's cost model can't see into
+`pallas_call`, so analytic is the only option for the *numerator*).
+This probe cross-checks the *time* side with the XLA profiler:
+
+1. run a steady flagship block under `jax.profiler.trace`,
+2. parse the emitted perfetto trace (`plugins/profile/*/*.trace.json.gz`),
+3. sum per-op durations on the TPU device tracks -> device-busy time per
+   epoch and the share spent inside the pallas LSTM kernels,
+4. reconcile: analytic executed-FLOPs / trace device time = device-level
+   TFLOP/s, to compare against the wall-clock-derived figure (they agree
+   when the step is device-bound, i.e. wall ~= device-busy).
+
+Falls back loudly if the tunneled axon platform emits no device events.
+
+run (chip): python tools/mfu_trace_probe.py [--epochs 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_train_step
+
+
+def run_traced_block(log_dir: str, epochs: int) -> float:
+    """Returns steady wall seconds for `epochs` epochs (compile excluded)."""
+    mcfg = ModelConfig(family="mtss_wgan_gp")  # flagship (48, 35)
+    tcfg = TrainConfig(batch_size=32, steps_per_call=epochs)
+    key = jax.random.PRNGKey(0)
+    dataset = jax.random.uniform(key, (512, mcfg.window, mcfg.features))
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(1), mcfg, tcfg, pair)
+    step = jax.jit(make_train_step(pair, tcfg, dataset), donate_argnums=0)
+    state, m = step(state, jax.random.PRNGKey(2))     # compile + warm
+    jax.block_until_ready(m["d_loss"])
+    t0 = time.perf_counter()
+    with jax.profiler.trace(log_dir):
+        state, m = step(state, jax.random.PRNGKey(3))
+        jax.block_until_ready(m["d_loss"])
+    return time.perf_counter() - t0
+
+
+def parse_trace(log_dir: str) -> dict:
+    paths = glob.glob(os.path.join(log_dir, "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        return {"error": f"no trace file under {log_dir}"}
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        tr = json.load(f)
+    ev = tr.get("traceEvents", [])
+    # device tracks: process_name metadata containing "TPU" (e.g. "/device:TPU:0")
+    pid_name, tid_name = {}, {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+        elif e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_name[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_name.items() if "TPU" in n.upper() or "device" in n.lower()}
+    # Sum ONLY the leaf-op thread ("XLA Ops"): each device pid also carries
+    # wrapper tracks ("XLA Modules", "Steps") whose events SPAN the leaf
+    # ops — summing every X event on the pid would double/triple-count.
+    op_tids = {pt for pt, n in tid_name.items()
+               if pt[0] in dev_pids and "XLA Ops" in n}
+    leaf_only = bool(op_tids)
+    by_op = defaultdict(float)
+    total = 0.0
+    for e in ev:
+        if e.get("ph") != "X":
+            continue
+        if leaf_only:
+            if (e.get("pid"), e.get("tid")) not in op_tids:
+                continue
+        elif e.get("pid") not in dev_pids:
+            continue
+        dur = float(e.get("dur", 0.0)) * 1e-6        # us -> s
+        by_op[e.get("name", "")] += dur
+        total += dur
+    top = sorted(by_op.items(), key=lambda kv: -kv[1])[:15]
+    pallas = sum(d for n, d in by_op.items()
+                 if "pallas" in n.lower() or "custom-call" in n.lower())
+    return {"trace_file": os.path.relpath(path),
+            "device_total_s": total,
+            "leaf_op_thread_found": leaf_only,   # False ⇒ total may overcount
+            "pallas_or_customcall_s": pallas,
+            "top_ops": [(n, round(d, 4)) for n, d in top],
+            "thread_names": sorted(set(tid_name.values()))[:20],
+            "process_names": sorted(pid_name.values())}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=200)
+    ap.add_argument("--log-dir", default="/tmp/mfu_trace")
+    args = ap.parse_args()
+
+    wall = run_traced_block(args.log_dir, args.epochs)
+    info = parse_trace(args.log_dir)
+    info["epochs"] = args.epochs
+    info["wall_s"] = wall
+    info["wall_steps_per_s"] = args.epochs / wall
+    if "device_total_s" in info:
+        # analytic executed FLOPs per epoch from flops_accounting (padded)
+        from flops_accounting import epoch_flops, HP
+        ex = epoch_flops(48, 35, HP)
+        lo = epoch_flops(48, 35, 100)
+        info["analytic_executed_gflops_per_epoch"] = ex / 1e9
+        per_epoch_dev = info["device_total_s"] / args.epochs
+        info["device_s_per_epoch"] = per_epoch_dev
+        if per_epoch_dev > 0:
+            info["device_tflops_executed"] = ex / per_epoch_dev / 1e12
+            info["device_tflops_model"] = lo / per_epoch_dev / 1e12
+            info["device_busy_frac_of_wall"] = info["device_total_s"] / wall
+    print(json.dumps(info, indent=2))
+
+
+if __name__ == "__main__":
+    main()

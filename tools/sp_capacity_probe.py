@@ -37,11 +37,15 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if len(sys.argv) > 1 and sys.argv[1] == "spcpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8")
 
 import jax
+
+if len(sys.argv) > 1 and sys.argv[1] == "spcpu":
+    # sitecustomize pins JAX_PLATFORMS=axon; config.update wins
+    # (tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 
@@ -102,15 +106,23 @@ def cmd_search() -> int:
     if last_ok is None:
         print("nothing fits?!")
         return 1
-    # binary refine between last_ok and the first overflow
+    # binary refine between last_ok and the first overflow.  At boundary
+    # widths XLA's buffer assignment raises RESOURCE_EXHAUSTED from
+    # .compile() itself (with a multi-MB allocation dump) rather than
+    # returning an analysis — treat a failed compile as "doesn't fit".
     lo, hi = last_ok, w
     while hi - lo > max(64, lo // 50):
         mid = (lo + hi) // 2 // 8 * 8
-        m = plain_step_memory(mid)
-        fits = m["total_bytes"] < HBM_BYTES * 0.95
-        print(f"W={mid}: temp={m['temp_bytes']/2**30:.2f} GiB fits={fits}",
-              flush=True)
-        pts.append(m)
+        try:
+            m = plain_step_memory(mid)
+            fits = m["total_bytes"] < HBM_BYTES * 0.95
+            print(f"W={mid}: temp={m['temp_bytes']/2**30:.2f} GiB fits={fits}",
+                  flush=True)
+            pts.append(m)
+        except Exception as e:
+            fits = False
+            print(f"W={mid}: compile failed ({type(e).__name__}) fits=False",
+                  flush=True)
         if fits:
             lo = mid
         else:
