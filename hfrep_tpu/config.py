@@ -68,6 +68,14 @@ class TrainConfig:
                                    # recommendation at shipped shapes is M=1
                                    # (latency-bound regime —
                                    # parallel/sequence.py::sp_microbatch_plan)
+    sp_remat: bool = False         # rematerialize each sp superstep in the
+                                   # backward pass (jax.checkpoint around the
+                                   # pipeline's scan body): trades recompute
+                                   # for O(W)-residual memory on the xla-scan
+                                   # backend — the same strategy the pallas
+                                   # kernels' adjoints use natively.  For
+                                   # long-window training near the HBM wall
+                                   # (RESULTS.md sp capacity study).
 
 
 @dataclasses.dataclass(frozen=True)

@@ -63,6 +63,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="full 3-D mesh, e.g. 2x2x2: batch over dp, window "
                         "over sp, hidden units over tp in one step "
                         "(parallel/dp_sp_tp.py)")
+    t.add_argument("--sp-remat", action="store_true",
+                   help="rematerialize the sp pipeline's backward in time "
+                        "blocks (TrainConfig.sp_remat): O(W)-residual "
+                        "memory for long-window runs near the HBM wall, "
+                        "identical trajectory (RESULTS.md sp capacity "
+                        "study).  --sp-mesh / --dp-sp only")
     t.add_argument("--sp-microbatches", type=int, default=None, metavar="M",
                    help="pipeline microbatch count for the window-sharded "
                         "paths (--sp-mesh/--dp-sp/--dp-sp-tp); default: the "
@@ -159,7 +165,7 @@ def cmd_clean(args) -> int:
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
                   mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
                   sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None,
-                  dp_sp_tp=None, sp_microbatches=None):
+                  dp_sp_tp=None, sp_microbatches=None, sp_remat=False):
     if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp,
                       dp_sp_tp))) > 1:
         raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh, --dp-tp and "
@@ -223,6 +229,13 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
         cfg = dataclasses.replace(
             cfg, train=dataclasses.replace(cfg.train,
                                            sp_microbatches=sp_microbatches))
+    if sp_remat:
+        if not (sp_mesh or dp_sp):
+            raise SystemExit("--sp-remat requires --sp-mesh or --dp-sp "
+                             "(the tp-composed chunk scan is not "
+                             "time-blocked; dp×sp×tp refuses)")
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, sp_remat=True))
     panel = load_panel(cleaned_dir)
     ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
     style = {"gan": "gan", "mtss_gan": "gan", "wgan": "wgan", "mtss_wgan": "wgan"}.get(
@@ -251,7 +264,7 @@ def cmd_train_gan(args) -> int:
         max_recoveries=args.max_recoveries,
         sp_mesh=args.sp_mesh, dp_sp=args.dp_sp,
         tp_mesh=args.tp_mesh, dp_tp=args.dp_tp, dp_sp_tp=args.dp_sp_tp,
-        sp_microbatches=args.sp_microbatches)
+        sp_microbatches=args.sp_microbatches, sp_remat=args.sp_remat)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest

@@ -73,6 +73,13 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     if tp_axis is not None:
         from hfrep_tpu.parallel.tensor import (_check_width,
                                                _validate_tp_backend)
+        if tcfg.sp_remat:
+            # build-time twin of _sp_pipeline's refusal: the tp chunk
+            # scan is not time-blocked, so remat would silently degrade
+            raise NotImplementedError(
+                "sp_remat supports the sp and dp×sp meshes only, not the "
+                "3-D dp×sp×tp composition (the per-timestep hidden-slice "
+                "all_gather is not time-blocked)")
         _validate_tp_backend(tcfg)
         _check_width(pair.generator.hidden, mesh.shape[tp_axis])
         backend = "xla"
@@ -104,11 +111,13 @@ def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                                        activation="sigmoid", slope=slope,
                                        microbatches=tcfg.sp_microbatches,
                                        backend=backend, manual=True,
-                                       tp_axis=tp_axis)
+                                       tp_axis=tp_axis,
+                                       remat=tcfg.sp_remat)
     d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=sp_axis,
                                      microbatches=tcfg.sp_microbatches,
                                      backend=backend, manual=True,
-                                     tp_axis=tp_axis)
+                                     tp_axis=tp_axis,
+                                     remat=tcfg.sp_remat)
     local_tcfg = dataclasses.replace(tcfg, batch_size=local_batch)
     return make_train_step(
         pair, local_tcfg, dataset, axis_name=dp_axis,

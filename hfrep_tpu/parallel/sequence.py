@@ -66,6 +66,54 @@ def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarr
     return lax.scan(cell, carry, xz_chunk)
 
 
+#: Time-block length for rematerialized chunk scans: sized so one
+#: block's transient recompute residuals (~16 × (REMAT_BLOCK, Bm, 4Hp)
+#: buffers in the GP second-order pass — the chip OOM dump's census) stay
+#: ~100 MB while the stored per-block carries remain negligible.
+REMAT_BLOCK = 512
+
+
+def _local_chunk_scan_remat(y_chunk, kernel, bias, carry, recurrent,
+                            act, rec_act, block: Optional[int] = None):
+    """:func:`_local_chunk_scan` with remat over the TIME axis — and the
+    input projection pulled INSIDE each block: the chunk scans in
+    ``block``-timestep slices, each slice's ``y @ kernel + bias``
+    projection AND recurrence wrapped together in one `jax.checkpoint`,
+    so the stored residual per block is the raw (block, Bm, F/H) input —
+    not the 4H-wide gate buffer (the difference is what XLA's memory
+    report showed: a hoisted projection kept an 11.5 GiB gate tensor
+    alive as a checkpoint input at W=37 632).  The backward (and the GP
+    second-order backward-of-backward) recomputes one block at a time:
+    O(Wl/block · Bm·H) carries + one transient block of residuals,
+    instead of O(Wl · Bm·4Hp · ~16).  This is what lets remat move the
+    memory wall even at sp=1, where superstep checkpointing alone
+    degenerates (one superstep = the whole window — measured: W=37 632
+    still wants 55 GiB without time blocking, 40 GiB with blocking but a
+    hoisted projection, see RESULTS.md).  Identical recurrence,
+    identical order — trajectory pinned in tests/test_sequence.py."""
+    if block is None:
+        block = REMAT_BLOCK          # late-bound so tests can shrink it
+    gates = kernel.shape[1]
+
+    def proj_scan(c, y_b):
+        rows = y_b.shape[0] * y_b.shape[1]
+        xz_b = (y_b.reshape(rows, y_b.shape[-1]) @ kernel
+                + bias).reshape(*y_b.shape[:-1], gates)
+        return _local_chunk_scan(xz_b, c, recurrent, act, rec_act)
+
+    wl = y_chunk.shape[0]
+    if wl <= block:
+        return proj_scan(carry, y_chunk)
+    nb = wl // block
+    main = y_chunk[:nb * block].reshape(nb, block, *y_chunk.shape[1:])
+    carry, hs = lax.scan(jax.checkpoint(proj_scan), carry, main)
+    h_seq = hs.reshape(nb * block, *y_chunk.shape[1:-1], hs.shape[-1])
+    if wl % block:
+        carry, h_tail = proj_scan(carry, y_chunk[nb * block:])
+        h_seq = jnp.concatenate([h_seq, h_tail], axis=0)
+    return carry, h_seq
+
+
 def _local_chunk_scan_tp(xz_chunk: jnp.ndarray,
                          carry: Tuple[jnp.ndarray, jnp.ndarray],
                          r_loc: jnp.ndarray, act, rec_act, tp_axis: str):
@@ -102,7 +150,8 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                  backend: str = "xla",
                  inters=None,
                  manual: bool = False,
-                 tp_axis: Optional[str] = None) -> jnp.ndarray:
+                 tp_axis: Optional[str] = None,
+                 remat: bool = False) -> jnp.ndarray:
     """N stacked LSTMs through ONE window-sharded pipeline pass.
 
     ``layers`` is a list of KerasLSTM param dicts ({kernel,
@@ -151,6 +200,13 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
     b, w, f = x.shape
     h_dims = [l["recurrent_kernel"].shape[0] for l in layers]
     n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    if remat and tp_axis is not None:
+        raise NotImplementedError(
+            "sp_remat supports the sp and dp×sp meshes only: under tp the "
+            "chunk scan all_gathers the hidden slices per timestep "
+            "(_local_chunk_scan_tp) and is not time-blocked, so remat "
+            "would silently keep the hoisted gate buffer it exists to "
+            "eliminate — refuse instead of degrading")
     if tp_axis is not None:
         if not manual:
             raise ValueError("tp_axis requires manual mode (an enclosing "
@@ -231,11 +287,21 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
         # chunk (padded-gate layout when the pallas kernels run it).
         # Deeper layers' projections run per superstep — their inputs
         # only exist once the previous layer's chunk has run.
-        g0 = 4 * wid[0]
-        xz = (x_local.reshape(b * wl, f) @ lay[0]["kernel"]
-              + lay[0]["bias"]).reshape(b, wl, g0)
-        xz = jnp.swapaxes(xz, 0, 1)                     # (Wl, B, 4Hp0)
-        xz_mb = xz.reshape(wl, m, bm, g0)               # microbatch split
+        # EXCEPT under remat: the hoisted 4H-wide gate buffer would live
+        # the whole backward as a checkpoint input (11.5 GiB at
+        # W=37 632); the remat path feeds RAW features through and
+        # projects inside each checkpointed time block
+        # (_local_chunk_scan_remat).
+        no_hoist = remat and not use_kernel and tp_axis is None
+        if no_hoist:
+            xz = jnp.swapaxes(x_local, 0, 1)            # (Wl, B, F) raw
+            xz_mb = xz.reshape(wl, m, bm, f)
+        else:
+            g0 = 4 * wid[0]
+            xz = (x_local.reshape(b * wl, f) @ lay[0]["kernel"]
+                  + lay[0]["bias"]).reshape(b, wl, g0)
+            xz = jnp.swapaxes(xz, 0, 1)                 # (Wl, B, 4Hp0)
+            xz_mb = xz.reshape(wl, m, bm, g0)           # microbatch split
 
         # Cast the loop state to the variance the loop body will produce:
         # the pre-projected chunk carries the true vma ({sp} standalone,
@@ -257,13 +323,23 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                  else l["recurrent_kernel"]) for l in lay]
 
         def run_chunk(i, xz_s, h0, c0):
-            """((h_fin, c_fin), h_seq) for one (Wl, Bm, 4Hp_i) chunk."""
+            """((h_fin, c_fin), h_seq) for one chunk: (Wl, Bm, 4Hp_i)
+            pre-projected gates, or the RAW (Wl, Bm, F/H) layer input in
+            remat mode (projection happens inside the time blocks)."""
             if use_kernel:
                 h_seq, c_f = lstm_seq_carry(xz_s, recs[i], h0, c0, act_name)
                 return (h_seq[-1], c_f), h_seq
             if tp_axis is not None:
                 return _local_chunk_scan_tp(xz_s, (h0, c0), recs[i],
                                             act, rec_act, tp_axis)
+            if remat:
+                # time-blocked remat inside the chunk: without it the
+                # superstep-level checkpoint still recomputes (and thus
+                # transiently stores) the WHOLE chunk's residuals in each
+                # backward — degenerate at sp=1 where Wl = W.
+                return _local_chunk_scan_remat(
+                    xz_s, lay[i]["kernel"], lay[i]["bias"], (h0, c0),
+                    recs[i], act, rec_act)
             return _local_chunk_scan(xz_s, (h0, c0), recs[i], act, rec_act)
 
         # Scan-then-gather: every superstep emits its chunk's last-layer
@@ -299,9 +375,13 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                         y = seq[..., :h_dims[i - 1]]
                     if inter_fns[i - 1] is not None:
                         y = inter_fns[i - 1](inter_params[i - 1], y)
-                    gi = 4 * wid[i]
-                    seq = (y.reshape(wl * bm, h_dims[i - 1]) @ lay[i]["kernel"]
-                           + lay[i]["bias"]).reshape(wl, bm, gi)
+                    if no_hoist:
+                        seq = y          # raw input; blocks project it
+                    else:
+                        gi = 4 * wid[i]
+                        seq = (y.reshape(wl * bm, h_dims[i - 1])
+                               @ lay[i]["kernel"]
+                               + lay[i]["bias"]).reshape(wl, bm, gi)
                 h_in, c_in = carry[i]
                 # Device 0 always starts microbatches from the zero carry.
                 h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
@@ -323,7 +403,16 @@ def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
                                   lax.ppermute(c_f, axis_name, perm=fwd)))
             return tuple(new_carry), seq
 
-        _, ys = lax.scan(superstep, carry_reg,
+        # remat: store only the superstep carries + emitted chunks and
+        # re-run each body (projection, chunk scan, ppermute) inside the
+        # backward — the scan-level residuals drop from ~16 (Wl, Bm, 4Hp)
+        # buffers per GP-grad layer (the chip OOM dump's census) to the
+        # carry chain, the same strategy the pallas kernels' adjoints use
+        # natively.  The recomputed ppermutes re-run as collectives in
+        # the backward; gradient values are unchanged (pinned vs the
+        # plain step in tests/test_sequence.py).
+        body = jax.checkpoint(superstep) if remat else superstep
+        _, ys = lax.scan(body, carry_reg,
                          jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp[-1])
         out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp[-1])
         # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
@@ -383,7 +472,8 @@ def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
              recurrent_activation: str = "sigmoid",
              backend: str = "xla",
              manual: bool = False,
-             tp_axis: Optional[str] = None) -> jnp.ndarray:
+             tp_axis: Optional[str] = None,
+             remat: bool = False) -> jnp.ndarray:
     """Two stacked LSTMs fused into ONE pipeline pass (optionally with a
     per-timestep ``inter = (fn, params)`` transform between them, applied
     as ``fn(params, y)``) — the sp analogue of the single-device fused
@@ -396,7 +486,8 @@ def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
                         axis_name=axis_name, microbatches=microbatches,
                         activation=activation,
                         recurrent_activation=recurrent_activation,
-                        backend=backend, manual=manual, tp_axis=tp_axis)
+                        backend=backend, manual=manual, tp_axis=tp_axis,
+                        remat=remat)
 
 
 def sp_microbatch_plan(batch: int, n_dev: int, window: int = 168,
@@ -511,13 +602,17 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     # real TPU, xla elsewhere; anything else raises.
     from hfrep_tpu.train.steps import resolve_lstm_backend
     backend = resolve_lstm_backend(tcfg.lstm_backend)
+    # TrainConfig.sp_remat: superstep rematerialization for long-window
+    # runs near the HBM wall (config.py; only meaningful on the scan
+    # backend — the pallas kernels' adjoints already recompute).
+    remat = tcfg.sp_remat
     g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=axis_name,
                                        activation="sigmoid", slope=slope,
                                        microbatches=microbatches,
-                                       backend=backend)
+                                       backend=backend, remat=remat)
     d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name,
                                      microbatches=microbatches,
-                                     backend=backend)
+                                     backend=backend, remat=remat)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
     return _jit_replicated_out(step, mesh) if jit else step
 
@@ -607,7 +702,8 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
               microbatches: Optional[int] = None,
               backend: str = "xla",
               manual: bool = False,
-              tp_axis: Optional[str] = None) -> jnp.ndarray:
+              tp_axis: Optional[str] = None,
+              remat: bool = False) -> jnp.ndarray:
     """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
     :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
     window axis sharded — (B, W, F) → (B, 1) scores.
@@ -636,7 +732,8 @@ def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
     # both recurrences in ONE fused pipeline pass (see sp_lstm2)
     h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
                   axis_name=axis_name, microbatches=microbatches,
-                  backend=backend, manual=manual, tp_axis=tp_axis)
+                  backend=backend, manual=manual, tp_axis=tp_axis,
+                  remat=remat)
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     w = x.shape[1]
@@ -670,7 +767,8 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 microbatches: Optional[int] = None,
                 backend: str = "xla",
                 manual: bool = False,
-                tp_axis: Optional[str] = None) -> jnp.ndarray:
+                tp_axis: Optional[str] = None,
+                remat: bool = False) -> jnp.ndarray:
     """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
     Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
     window axis sharded over ``axis_name`` — long-window synthesis
@@ -708,7 +806,8 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                             g_params["KerasLayerNorm_0"]),
                      axis_name=axis_name, microbatches=microbatches,
                      activation=activation,
-                     backend=backend, manual=True, tp_axis=tp_axis)
+                     backend=backend, manual=True, tp_axis=tp_axis,
+                     remat=remat)
         y = _sp_head_impl(g_params, x, slope, ln_eps)   # chunk-wise head
         wl = y.shape[1]
         buf = jnp.zeros((y.shape[0], wl * mesh.shape[axis_name], y.shape[2]),
@@ -726,5 +825,5 @@ def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                  inter=(lambda p, v: _sp_ln(p, v, ln_eps),
                         g_params["KerasLayerNorm_0"]),
                  axis_name=axis_name, microbatches=microbatches,
-                 activation=activation, backend=backend)
+                 activation=activation, backend=backend, remat=remat)
     return _sp_head(g_params, x, slope, ln_eps)

@@ -403,3 +403,72 @@ def test_sp_pallas_unsupported_dtype_raises():
     with pytest.raises(NotImplementedError, match="sp_lstm"):
         sp_lstm(p16["kernel"], p16["recurrent_kernel"], p16["bias"],
                 x16, _mesh(8), activation="sigmoid", backend="pallas")
+
+
+@needs_8
+@pytest.mark.parametrize("block", [None, 3])
+def test_sp_remat_matches_plain_step(block, monkeypatch):
+    """TrainConfig.sp_remat (superstep rematerialization for long-window
+    runs near the HBM wall — RESULTS.md sp capacity study) must not
+    change the trajectory: jax.checkpoint recomputes, it does not
+    reorder, so params land within f32 round-off of the plain step."""
+    import dataclasses
+
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.sequence import make_sp_train_step
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = TrainConfig(batch_size=8, n_critic=2)
+    dataset = jnp.asarray(np.random.default_rng(3).uniform(
+        0, 1, (32, 16, 5)).astype(np.float32))
+    pair = build_gan(mcfg)
+
+    if block is not None:
+        # exercise the TIME-BLOCKED path: Wl = 16/8 = 2 <= default block,
+        # so shrink the block to force _local_chunk_scan_remat's scan-of-
+        # checkpointed-blocks on a 2-device mesh (Wl = 8 > 3)
+        from hfrep_tpu.parallel import sequence as seq_mod
+        monkeypatch.setattr(seq_mod, "REMAT_BLOCK", block)
+        mesh = _mesh(2)
+    else:
+        mesh = _mesh(8)
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    r_state, r_m = make_sp_train_step(
+        pair, dataclasses.replace(tcfg, sp_remat=True), dataset, mesh)(
+        s0, jax.random.PRNGKey(1))
+    s0 = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    p_state, p_m = jax.jit(make_train_step(pair, tcfg, dataset))(
+        s0, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(r_m["d_loss"]), float(p_m["d_loss"]),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(r_state.g_params)
+                    + jax.tree_util.tree_leaves(r_state.d_params),
+                    jax.tree_util.tree_leaves(p_state.g_params)
+                    + jax.tree_util.tree_leaves(p_state.d_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@needs_8
+def test_sp_remat_refuses_tp_composition():
+    """sp_remat must refuse the 3-D dp×sp×tp mesh at BUILD time (the tp
+    chunk scan is not time-blocked — degrading silently would keep the
+    hoisted gate buffer remat exists to eliminate)."""
+    import dataclasses
+
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_train_step
+    from hfrep_tpu.parallel.mesh import make_mesh_3d
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=16, hidden=8)
+    tcfg = dataclasses.replace(TrainConfig(batch_size=8, n_critic=2),
+                               sp_remat=True)
+    dataset = jnp.zeros((32, 16, 5))
+    pair = build_gan(mcfg)
+    mesh = make_mesh_3d(2, 2, 2, devices=jax.devices()[:8])
+    with pytest.raises(NotImplementedError, match="sp_remat"):
+        make_dp_sp_tp_train_step(pair, tcfg, dataset, mesh)

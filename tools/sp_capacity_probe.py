@@ -161,20 +161,38 @@ def cmd_confirm(w: int) -> int:
     return 0
 
 
-def cmd_spcpu(w: int) -> int:
+def cmd_spcpu(w: int, microbatches: int = 8) -> int:
     """Phase C: real sp training steps at W on the 8-virtual-device mesh —
-    every window buffer genuinely sharded W/8 per device."""
+    every window buffer genuinely sharded W/8 per device.
+
+    Default M=8 (not the planner's chip recommendation of M=1): XLA's
+    CPU in-process collectives carry a hard 40 s rendezvous watchdog,
+    and on a 1-core host the 8 device threads timeshare the core — at
+    M=1 a big-W chunk scan between consecutive ppermutes blows the
+    watchdog (measured: W=24192 M=1 aborts in CollectivePermute
+    rendezvous).  More microbatches shorten each inter-collective
+    interval ~M×; the schedule stays trajectory-exact (M-independence is
+    pinned in tests/test_sequence.py)."""
     from jax.sharding import Mesh
 
     from hfrep_tpu.parallel.sequence import make_sp_train_step
 
     assert len(jax.devices()) == 8, "run with xla_force_host_platform_device_count=8"
     mcfg, tcfg, dataset, pair, state = _build(w)
+    # sp_remat: the xla-scan backend's plain residuals are ~5.4 GB per
+    # 1000 window timesteps for this step (two OOM-kills at W=24192/37632
+    # on the 125 GB host, recorded in RESULTS.md); superstep
+    # rematerialization brings the footprint to the same recompute
+    # strategy the chip kernels use.
+    import dataclasses
+    tcfg = dataclasses.replace(tcfg, sp_remat=True)
     mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("sp",))
-    step = make_sp_train_step(pair, tcfg, dataset, mesh, microbatches=1)
+    step = make_sp_train_step(pair, tcfg, dataset, mesh,
+                              microbatches=microbatches)
     state, metrics = step(state, jax.random.PRNGKey(4))
     d = float(jax.device_get(metrics["d_loss"]))
-    print(json.dumps({"w": w, "sp_devices": 8, "ran": True, "d_loss": d,
+    print(json.dumps({"w": w, "sp_devices": 8, "microbatches": microbatches,
+                      "ran": True, "d_loss": d,
                       "per_device_window": w // 8}))
     return 0
 
@@ -186,6 +204,7 @@ if __name__ == "__main__":
     if cmd == "confirm":
         raise SystemExit(cmd_confirm(int(sys.argv[2])))
     if cmd == "spcpu":
-        raise SystemExit(cmd_spcpu(int(sys.argv[2])))
+        m = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+        raise SystemExit(cmd_spcpu(int(sys.argv[2]), m))
     print(__doc__)
     raise SystemExit(2)
