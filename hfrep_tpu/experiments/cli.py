@@ -176,6 +176,13 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
     from hfrep_tpu.train.trainer import GanTrainer
     from hfrep_tpu.utils.logging import MetricLogger
 
+    # Flag validation BEFORE mesh construction: --sp-remat's gating must
+    # not depend on device availability (a <8-chip host would otherwise
+    # surface make_mesh_3d's count error instead of the flag error).
+    if sp_remat and not (sp_mesh or dp_sp):
+        raise SystemExit("--sp-remat requires --sp-mesh or --dp-sp "
+                         "(the tp-composed chunk scan is not "
+                         "time-blocked; dp×sp×tp refuses)")
     # Mesh construction first: a typo'd --dp-sp or too-few-devices error
     # must not pay the full panel load + window build before surfacing.
     device_mesh = None
@@ -230,10 +237,7 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
             cfg, train=dataclasses.replace(cfg.train,
                                            sp_microbatches=sp_microbatches))
     if sp_remat:
-        if not (sp_mesh or dp_sp):
-            raise SystemExit("--sp-remat requires --sp-mesh or --dp-sp "
-                             "(the tp-composed chunk scan is not "
-                             "time-blocked; dp×sp×tp refuses)")
+        # gated above, before any mesh/device work
         cfg = dataclasses.replace(
             cfg, train=dataclasses.replace(cfg.train, sp_remat=True))
     panel = load_panel(cleaned_dir)

@@ -411,6 +411,14 @@ class TestCli:
         with pytest.raises(SystemExit, match="window-sharded"):
             main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
                   "--quiet", "--sp-microbatches", "1"])
+        # --sp-remat: sp/dp-sp only (the tp-composed chunk scan is not
+        # time-blocked, so neither bare nor 3-D launches may take it)
+        with pytest.raises(SystemExit, match="sp-mesh or --dp-sp"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--sp-remat"])
+        with pytest.raises(SystemExit, match="sp-mesh or --dp-sp"):
+            main(["train-gan", "--preset", "mtss_wgan_gp", "--epochs", "1",
+                  "--quiet", "--dp-sp-tp", "2x2x2", "--sp-remat"])
 
     def test_train_gan_resume_completes_schedule(self, tmp_path, capsys):
         """--resume must finish the configured schedule, not retrain the
