@@ -209,10 +209,13 @@ class TestMeshTrainer:
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                        rtol=1e-3, atol=1e-4)
 
-        bad = GanTrainer(self._cfg(sp_microbatches=3), dataset,
-                         mesh=self._mesh("sp"))      # batch 8 % 3 != 0
-        with pytest.raises(ValueError, match="not divisible by microbatches"):
-            bad.train(epochs=2)
+        # build-time refusal (ADVICE r4 item 1's mirror check in
+        # make_sp_train_step): an indivisible M now fails at trainer
+        # CONSTRUCTION — before any training — not at the first call
+        with pytest.raises(ValueError,
+                           match="not divisible by sp_microbatches"):
+            GanTrainer(self._cfg(sp_microbatches=3), dataset,
+                       mesh=self._mesh("sp"))        # batch 8 % 3 != 0
 
     @needs_8
     @pytest.mark.slow
