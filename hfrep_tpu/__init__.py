@@ -20,6 +20,8 @@ whole-sweep autoencoder training (all 21 latent dims in one batched
 program instead of 21 serial Keras fits).
 """
 
+from __future__ import annotations
+
 __version__ = "0.5.0"
 
 from hfrep_tpu import config  # noqa: F401

@@ -1,5 +1,7 @@
 """``python -m hfrep_tpu`` entry point."""
 
+from __future__ import annotations
+
 import sys
 
 from hfrep_tpu.experiments.cli import main

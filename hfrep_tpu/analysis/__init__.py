@@ -1,0 +1,34 @@
+"""hfrep_tpu.analysis — JAX-aware static lint & shape-contract checking.
+
+A pure-AST analyzer (no jax import, no tracing) for the silent-failure
+bug classes that TPU JAX code grows: host ops on tracers inside jitted
+functions, PRNG key reuse, collective/mesh axis-name drift, donated
+buffers read after donation, Python-side mutation of traced pytrees,
+and shape/dtype contract violations.  See ``hfrep_tpu/analysis/README.md``
+for the rule catalogue and ``python -m hfrep_tpu.analysis check --help``
+for the CLI.
+
+The package is import-light by design: everything here runs on a bare
+CPython, so the checker can gate CI before any accelerator runtime is
+even installed.
+"""
+
+from __future__ import annotations
+
+from hfrep_tpu.analysis.engine import (  # noqa: F401
+    AnalysisError,
+    FileContext,
+    Finding,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from hfrep_tpu.analysis.contracts import (  # noqa: F401
+    ContractError,
+    contract,
+    parse_contract_spec,
+    parse_shape_spec,
+)
+from hfrep_tpu.analysis.rules import ALL_RULES, RULES_BY_ID  # noqa: F401

@@ -1,0 +1,9 @@
+"""``python -m hfrep_tpu.analysis`` entry point."""
+
+from __future__ import annotations
+
+import sys
+
+from hfrep_tpu.analysis.cli import main
+
+sys.exit(main())

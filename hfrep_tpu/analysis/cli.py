@@ -1,0 +1,161 @@
+"""CLI: ``python -m hfrep_tpu.analysis check hfrep_tpu/ tools/ tests/``.
+
+Exit codes: 0 — clean (every finding fixed, suppressed, or baselined);
+1 — non-baselined findings; 2 — usage or analyzer error.  ``--format
+json`` emits a machine-readable report for CI annotation;
+``--write-baseline`` snapshots the current findings so existing debt can
+be burned down incrementally without blocking the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+from typing import List, Optional
+
+from hfrep_tpu.analysis.engine import (
+    AnalysisError, Finding, analyze_paths, apply_baseline, load_baseline,
+    write_baseline,
+)
+from hfrep_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+#: the repo's checked-in debt ledger, used when ``--baseline`` is absent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m hfrep_tpu.analysis",
+        description="JAX-aware static lint & shape-contract checker")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    check = sub.add_parser("check", help="analyze files/directories")
+    check.add_argument("paths", nargs="+", help=".py files or directories")
+    check.add_argument("--format", choices=("human", "json"), default="human")
+    check.add_argument("--select", default=None,
+                       help="comma-separated rule ids (default: all)")
+    check.add_argument("--baseline", default=None,
+                       help=f"baseline file (default: {DEFAULT_BASELINE})")
+    check.add_argument("--no-baseline", action="store_true",
+                       help="ignore any baseline file")
+    check.add_argument("--write-baseline", action="store_true",
+                       help="snapshot current findings into the baseline "
+                            "file and exit 0")
+    check.add_argument("--known-axes", default=None,
+                       help="comma-separated mesh axis names to trust in "
+                            "addition to the declared ones (JAX003)")
+
+    sub.add_parser("rules", help="list rule ids and descriptions")
+    return p
+
+
+def _select_rules(spec: Optional[str]):
+    if spec is None:
+        return list(ALL_RULES)
+    rules = []
+    for rid in (s.strip().upper() for s in spec.split(",") if s.strip()):
+        if rid not in RULES_BY_ID:
+            raise AnalysisError(
+                f"unknown rule id {rid!r}; known: "
+                f"{', '.join(sorted(RULES_BY_ID))}")
+        rules.append(RULES_BY_ID[rid])
+    return rules
+
+
+def _report_human(new: List[Finding], baselined: List[Finding],
+                  stale: Counter, out) -> None:
+    for f in new:
+        print(f.render(), file=out)
+    counts = Counter(f.rule for f in new)
+    if new:
+        per_rule = ", ".join(f"{r}×{n}" for r, n in sorted(counts.items()))
+        print(f"\n{len(new)} finding(s) [{per_rule}]"
+              f" ({len(baselined)} baselined)", file=out)
+    else:
+        print(f"clean: 0 findings ({len(baselined)} baselined)", file=out)
+    if stale:
+        print(f"note: {sum(stale.values())} stale baseline entr"
+              f"{'y' if sum(stale.values()) == 1 else 'ies'} (fixed or "
+              f"moved — prune with --write-baseline):", file=out)
+        for fp in sorted(stale):
+            print(f"  {fp}", file=out)
+
+
+def _report_json(new: List[Finding], baselined: List[Finding],
+                 stale: Counter, out) -> None:
+    payload = {
+        "version": 1,
+        "findings": [f.to_dict() for f in new],
+        "counts": dict(Counter(f.rule for f in new)),
+        "baselined": len(baselined),
+        "stale_baseline": sorted(stale.elements()),
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.command == "rules":
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.name:22s} {r.description}")
+        return 0
+
+    try:
+        rules = _select_rules(args.select)
+        if args.select and args.write_baseline:
+            # a partial-rule snapshot would silently drop every other
+            # rule's entries (and their justifications) from the ledger
+            raise AnalysisError(
+                "--write-baseline requires a full-rule run; drop --select")
+        axes = (set(s.strip() for s in args.known_axes.split(",") if s.strip())
+                if args.known_axes else None)
+        findings = analyze_paths(args.paths, rules=rules, known_axes=axes)
+
+        baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+        if args.write_baseline:
+            # carry forward justifications for entries that still match
+            old = {}
+            if baseline_path.exists():
+                try:
+                    data = json.loads(
+                        baseline_path.read_text(encoding="utf-8"))
+                except (OSError, json.JSONDecodeError) as e:
+                    raise AnalysisError(
+                        f"cannot re-read baseline {baseline_path}: {e}")
+                for e in data.get("entries", []):
+                    if isinstance(e, dict) and "fingerprint" in e:
+                        old.setdefault(e["fingerprint"], e.get("justification"))
+            n = write_baseline(findings, baseline_path,
+                               justifications={k: v for k, v in old.items()
+                                               if v})
+            print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+                  f"{baseline_path}")
+            return 0
+
+        baseline = Counter()
+        if not args.no_baseline and baseline_path.exists():
+            baseline = load_baseline(baseline_path)
+            if args.select:
+                # only the selected rules ran: other rules' entries are
+                # not stale, they just weren't checked this run
+                selected = {r.id for r in rules}
+                baseline = Counter({
+                    fp: n for fp, n in baseline.items()
+                    if fp.split("::", 1)[0] in selected})
+        new, matched, stale = apply_baseline(findings, baseline)
+    except AnalysisError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    report = _report_json if args.format == "json" else _report_human
+    report(new, matched, stale, sys.stdout)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":          # pragma: no cover
+    sys.exit(main())

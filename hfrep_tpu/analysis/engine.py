@@ -1,0 +1,292 @@
+"""Rule engine: file contexts, findings, ``# noqa`` suppression, baselines.
+
+The engine is deliberately flake8-shaped — parse once per file, hand the
+tree to every rule, post-filter by per-line suppressions — because that
+shape is what lets new JAX rules be ~50-line visitors instead of
+frameworks.  Two extensions matter here:
+
+* a **project pre-pass** (:func:`analyze_paths`) that collects mesh axis
+  declarations across *all* files before any rule runs, so the
+  axis-consistency rule can cross-check a ``lax.psum(x, 'dp')`` call in
+  ``train/steps.py`` against the axes declared in ``parallel/mesh.py``;
+* a **baseline file** keyed by content fingerprints (rule + path +
+  normalized source line, with multiplicity) so pre-existing violations
+  can be burned down incrementally without blocking CI on day one —
+  line numbers are deliberately *not* part of the fingerprint, so
+  unrelated edits above a baselined site don't resurrect it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: Repo root assumed two levels above this package (``<root>/hfrep_tpu/analysis``);
+#: fingerprint paths are made relative to it so baselines are CWD-independent.
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
+    re.IGNORECASE,
+)
+
+
+class AnalysisError(Exception):
+    """Unrecoverable analyzer failure (bad baseline file, unknown rule id)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation at one source location."""
+
+    rule: str            # "JAX001" … "JAX006" (or "JAX000" for parse errors)
+    path: str            # posix path, relative to the repo root when under it
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+    snippet: str = ""    # stripped source line, used in the fingerprint
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: where-independent of
+        line numbers, so edits elsewhere in the file don't churn it."""
+        return f"{self.rule}::{self.path}::{self.snippet}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+def _normalize_path(path) -> str:
+    p = Path(path).resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+class FileContext:
+    """Everything a rule needs about one file: source, AST, suppressions,
+    plus the project-wide ``known_axes`` set collected by the pre-pass."""
+
+    def __init__(self, path, source: str,
+                 known_axes: Optional[Set[str]] = None,
+                 relpath: Optional[str] = None):
+        self.path = str(path)
+        self.relpath = relpath if relpath is not None else _normalize_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self.known_axes: Set[str] = set(known_axes or ())
+        #: line -> comment text (tokenizer-accurate, so ``# noqa`` or
+        #: ``# shape:`` *inside a docstring* never counts)
+        self.comments: Dict[int, str] = self._scan_comments()
+        self._noqa: Dict[int, Optional[Set[str]]] = self._scan_noqa()
+
+    def _scan_comments(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass                    # partial map is fine; ast.parse gates worse
+        return out
+
+    def _scan_noqa(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> None (bare ``# noqa``: suppress all) or a code set."""
+        out: Dict[int, Optional[Set[str]]] = {}
+        for i, text in self.comments.items():
+            m = _NOQA_RE.search(text)
+            if not m:
+                continue
+            codes = m.group("codes")
+            out[i] = ({c.strip().upper() for c in codes.split(",")}
+                      if codes else None)
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        codes = self._noqa.get(finding.line, False)
+        if codes is False:
+            return False
+        return codes is None or finding.rule in codes
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, snippet=snippet)
+
+
+# --------------------------------------------------------------- running
+def _iter_py_files(paths: Sequence) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+        elif p.exists():
+            # an explicitly named non-.py file would be silently skipped —
+            # "clean" on an unanalyzed target is worse than an error
+            raise AnalysisError(f"not a Python file: {p}")
+        else:
+            raise AnalysisError(f"no such path: {p}")
+    # de-dup while keeping order
+    seen, out = set(), []
+    for f in files:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            out.append(f)
+    return out
+
+
+def _syntax_finding(e: SyntaxError, relpath: str) -> Finding:
+    return Finding(rule="JAX000", path=relpath, line=e.lineno or 1,
+                   col=(e.offset or 1) - 1,
+                   message=f"syntax error: {e.msg}",
+                   snippet=(e.text or "").strip())
+
+
+def _run_rules(ctx: "FileContext", rules: Sequence) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    findings = [f for f in findings if not ctx.suppressed(f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence] = None,
+                   known_axes: Optional[Set[str]] = None,
+                   relpath: Optional[str] = None) -> List[Finding]:
+    """Run ``rules`` (default: all) over one source blob.  Returns findings
+    already filtered by ``# noqa`` suppressions.  A syntax error yields a
+    single JAX000 finding rather than raising, so one broken file can't
+    take down a whole-tree run."""
+    from hfrep_tpu.analysis.rules import ALL_RULES
+
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    try:
+        ctx = FileContext(path, source, known_axes=known_axes, relpath=relpath)
+    except SyntaxError as e:
+        rel = relpath if relpath is not None else _normalize_path(path)
+        return [_syntax_finding(e, rel)]
+    return _run_rules(ctx, rules)
+
+
+def analyze_paths(paths: Sequence, rules: Optional[Sequence] = None,
+                  known_axes: Optional[Set[str]] = None) -> List[Finding]:
+    """Two-pass whole-project run: every file is parsed ONCE into a
+    FileContext, mesh-axis declarations are collected across all of them,
+    then the rules run with the union in context — so a collective in
+    ``train/steps.py`` checks against the axes ``parallel/mesh.py``
+    declares."""
+    from hfrep_tpu.analysis.rules import ALL_RULES
+    from hfrep_tpu.analysis.rules.jax_axes import collect_declared_axes
+
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    findings: List[Finding] = []
+    ctxs: List[FileContext] = []
+    axes: Set[str] = set(known_axes or ())
+    for f in _iter_py_files(paths):
+        try:
+            text = f.read_text(encoding="utf-8")
+        except OSError as e:
+            raise AnalysisError(f"cannot read {f}: {e}")
+        try:
+            ctx = FileContext(f, text)
+        except SyntaxError as e:
+            findings.append(_syntax_finding(e, _normalize_path(f)))
+            continue
+        ctxs.append(ctx)
+        axes |= collect_declared_axes(ctx.tree)
+    for ctx in ctxs:
+        ctx.known_axes = axes
+        findings.extend(_run_rules(ctx, rules))
+    return findings
+
+
+# -------------------------------------------------------------- baseline
+def load_baseline(path) -> Counter:
+    """Baseline file -> fingerprint multiset.  Format::
+
+        {"version": 1,
+         "entries": [{"fingerprint": "...", "justification": "..."}, ...]}
+
+    Each entry absorbs exactly one matching finding; if the code grows a
+    second identical violation on the same path it is *not* silently
+    covered.
+    """
+    p = Path(path)
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except OSError as e:
+        raise AnalysisError(f"cannot read baseline {p}: {e}")
+    except json.JSONDecodeError as e:
+        raise AnalysisError(f"baseline {p} is not valid JSON: {e}")
+    if not isinstance(data, dict) or "entries" not in data:
+        raise AnalysisError(f"baseline {p}: expected {{'entries': [...]}}")
+    fps = Counter()
+    for entry in data["entries"]:
+        if not isinstance(entry, dict) or "fingerprint" not in entry:
+            raise AnalysisError(f"baseline {p}: malformed entry {entry!r}")
+        fps[entry["fingerprint"]] += 1
+    return fps
+
+
+def apply_baseline(findings: Iterable[Finding],
+                   baseline: Counter) -> Tuple[List[Finding], List[Finding], Counter]:
+    """Split findings into (new, baselined); also return the unconsumed
+    baseline entries (stale — the violation was fixed or moved)."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        if remaining[f.fingerprint] > 0:
+            remaining[f.fingerprint] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = Counter({fp: n for fp, n in remaining.items() if n > 0})
+    return new, matched, stale
+
+
+def write_baseline(findings: Iterable[Finding], path,
+                   justifications: Optional[Dict[str, str]] = None) -> int:
+    """Serialize findings as a baseline.  ``justifications`` maps
+    fingerprints to one-line reasons; unknown fingerprints get a TODO so
+    review pressure is visible in the diff."""
+    justifications = justifications or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,          # informational only; not matched on
+            "justification": justifications.get(
+                f.fingerprint, "TODO: justify or fix"),
+        })
+    payload = {"version": 1, "entries": entries}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n",
+                          encoding="utf-8")
+    return len(entries)

@@ -1,0 +1,23 @@
+"""Rule registry.  Rule IDs are stable API: baselines, ``# noqa:`` codes
+and CI configuration all key on them, so new rules append, never renumber."""
+
+from __future__ import annotations
+
+from hfrep_tpu.analysis.rules.base import Rule  # noqa: F401
+from hfrep_tpu.analysis.rules.jax_host import HostOpsInJitRule
+from hfrep_tpu.analysis.rules.jax_keys import KeyReuseRule
+from hfrep_tpu.analysis.rules.jax_axes import AxisConsistencyRule
+from hfrep_tpu.analysis.rules.jax_donation import DonationReuseRule
+from hfrep_tpu.analysis.rules.py_mutation import MutationRule
+from hfrep_tpu.analysis.rules.shape_contracts import ShapeContractRule
+
+ALL_RULES = (
+    HostOpsInJitRule(),
+    KeyReuseRule(),
+    AxisConsistencyRule(),
+    DonationReuseRule(),
+    MutationRule(),
+    ShapeContractRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

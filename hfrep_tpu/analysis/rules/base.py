@@ -1,0 +1,230 @@
+"""Rule base class + shared AST helpers (dotted names, alias tracking).
+
+Every rule is a stateless object with an ``id`` and a
+``check(ctx) -> list[Finding]``; the helpers here answer the questions
+all the JAX rules keep asking: "what dotted name is this expression?",
+"what do `np` / `jax.random` resolve to in this file?", "is this call a
+jit/shard_map wrapper?".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+
+
+class Rule:
+    id: str = "JAX000"
+    name: str = "base"
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.random.split`` -> "jax.random.split"; None for non-name exprs."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.AST, module: str) -> Set[str]:
+    """Dotted prefixes that denote ``module`` in this file: ``import numpy
+    as np`` -> {"np"}, ``import numpy`` -> {"numpy"}, ``from jax import
+    numpy as jnp`` (module="jax.numpy") -> {"jnp"}."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module:
+                    names.add(a.asname if a.asname else a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if f"{node.module}.{a.name}" == module:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def from_imports(tree: ast.AST, module: str) -> Dict[str, str]:
+    """``from <module> import a as b`` -> {"b": "a"}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            for a in node.names:
+                out[a.asname or a.name] = a.name
+    return out
+
+
+_JIT_TAILS = ("jit", "pmap", "shard_map")
+
+
+def is_jit_reference(node: ast.AST) -> bool:
+    """True for a name expression denoting jit/pmap/shard_map."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    return name.split(".")[-1] in _JIT_TAILS
+
+
+def jit_call_info(call: ast.Call) -> Optional[ast.Call]:
+    """If ``call`` is ``jit(...)``/``shard_map(...)`` or
+    ``partial(jax.jit, ...)``, return the call carrying the jit kwargs."""
+    if is_jit_reference(call.func):
+        return call
+    fname = dotted_name(call.func)
+    if fname and fname.split(".")[-1] == "partial" and call.args:
+        if is_jit_reference(call.args[0]):
+            return call
+    return None
+
+
+def decorator_jit_call(dec: ast.AST) -> Optional[ast.Call]:
+    """jit-ish decorator -> the Call node carrying kwargs (or a synthetic
+    marker Call for a bare ``@jax.jit``)."""
+    if isinstance(dec, ast.Call):
+        return jit_call_info(dec)
+    if is_jit_reference(dec):
+        # bare @jax.jit: synthesize an empty call so callers can treat
+        # both shapes uniformly
+        fake = ast.Call(func=dec, args=[], keywords=[])
+        ast.copy_location(fake, dec)
+        return fake
+    return None
+
+
+def literal_int_tuple(node: ast.AST) -> Optional[Tuple[object, ...]]:
+    """Literal ``(4, 8, n)`` -> (4, 8, "n"); names become symbolic strs,
+    anything else (calls, subscripts) becomes "_" (unknown).  Returns
+    None when the node is not a tuple/list display at all."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    dims: List[object] = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+            dims.append(elt.value)
+        elif isinstance(elt, ast.Name):
+            dims.append(elt.id)
+        elif isinstance(elt, ast.Starred):
+            return None             # (*dims, 4): rank unknown
+        else:
+            dims.append("_")
+    return tuple(dims)
+
+
+def walk_scopes(tree: ast.AST) -> Iterable[ast.AST]:
+    """Yield the module and every function/lambda node (each a scope)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield node
+
+
+def scope_body(scope: ast.AST) -> List[ast.stmt]:
+    if isinstance(scope, ast.Lambda):
+        ret = ast.Return(value=scope.body)
+        ast.copy_location(ret, scope.body)
+        return [ret]
+    return list(scope.body)
+
+
+def param_names(scope: ast.AST) -> Set[str]:
+    """Positional/keyword/vararg names of a function scope, minus
+    self/cls."""
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return set()
+    a = scope.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def direct_nodes(scope: ast.AST) -> List[ast.AST]:
+    """All AST nodes belonging to ``scope`` itself — traversal stops at
+    nested function/lambda boundaries (their bodies are their own
+    scopes).  The nested def node itself is included (for decorators),
+    its body is not."""
+    out: List[ast.AST] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            out.append(child)
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                walk(child)
+
+    walk(scope)
+    return out
+
+
+def local_bound_names(scope: ast.AST) -> Set[str]:
+    """Names bound by ``scope``'s own statements (assignments, loop and
+    with targets, comprehension variables) — these shadow any same-named
+    enclosing-scope parameter."""
+    bound: Set[str] = set()
+    for node in direct_nodes(scope):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+def jit_wrapped_names(tree: ast.AST) -> Set[str]:
+    """Function names passed as the wrapped callable to jit/pmap/shard_map
+    (directly or through ``functools.partial``)."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        call = jit_call_info(node)
+        if call is None:
+            continue
+        args = call.args
+        fname = dotted_name(call.func)
+        if fname and fname.split(".")[-1] == "partial":
+            args = call.args[1:]    # partial(jax.jit, fn, …)
+        for a in list(args[:1]) + [kw.value for kw in call.keywords
+                                   if kw.arg in ("f", "fun", "func")]:
+            if isinstance(a, ast.Name):
+                wrapped.add(a.id)
+    return wrapped
+
+
+def jitted_defs(tree: ast.AST) -> List[ast.AST]:
+    """Every function def that is jit/pmap/shard_map-compiled: decorated
+    with one, or referenced by name as the wrapped callable."""
+    wrapped = jit_wrapped_names(tree)
+    defs: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in wrapped or any(decorator_jit_call(d) is not None
+                                       for d in node.decorator_list):
+            defs.append(node)
+    return defs
+
+
+def tracer_scopes(fn: ast.AST):
+    """Yield ``(scope, tracer_names)`` for a jitted def and every function
+    nested in it (nested functions trace too).  A scope's tracers are its
+    own parameters plus the enclosing scopes' — minus any name the scope
+    itself binds locally, which shadows the tracer (e.g. a static
+    ``for i in range(n)`` loop variable over a nested fn's ``i`` param)."""
+
+    def rec(scope: ast.AST, inherited: Set[str]):
+        tracers = (inherited - local_bound_names(scope)) | param_names(scope)
+        yield scope, tracers
+        for node in direct_nodes(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                yield from rec(node, tracers)
+
+    yield from rec(fn, set())

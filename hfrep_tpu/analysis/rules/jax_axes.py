@@ -1,0 +1,131 @@
+"""JAX003 — collective axis names must match declared mesh axes.
+
+Failure mode: ``lax.psum(x, 'db')`` against a mesh declared ``('dp',)``
+is a *runtime* NameError on TPU — but only on the code path that
+actually executes the collective, which for rarely-taken branches
+(recovery paths, eval-only reductions) can be weeks after the typo
+landed.  Cross-checking every literal axis string against the axes the
+project declares (``parallel/mesh.py`` Mesh constructions, ``MeshConfig``
+defaults, ``axis_name=``/``axis_names=`` keywords and parameter
+defaults) turns that into a static error.
+
+The engine's project pre-pass (:func:`hfrep_tpu.analysis.engine.analyze_paths`)
+unions :func:`collect_declared_axes` over every analyzed file into
+``ctx.known_axes``; single-file runs can inject the set explicitly.
+When no axes are known at all the rule stays silent rather than flag
+every collective in a fresh checkout.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import Rule, dotted_name
+
+#: collectives whose 2nd positional / ``axis_name=`` argument names a mesh axis
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute", "pshuffle",
+    "all_to_all", "axis_index", "axis_size", "psum_scatter", "pbroadcast",
+}
+_AXIS_PARAM_NAMES = {"axis_name", "axis_names", "batch_axis", "sp_axis",
+                     "tp_axis", "pp_axis", "dp_axis", "mesh_axis"}
+
+
+def _axis_strings(node: ast.AST) -> Set[str]:
+    """String constants in a literal (string or tuple/list of strings)."""
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def collect_declared_axes(tree: ast.AST) -> Set[str]:
+    """Axis names this file *declares* (as opposed to *uses*)."""
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            tail = fname.split(".")[-1] if fname else ""
+            if tail == "Mesh":
+                # Mesh(devices, ('dp', 'sp')) — names are the 2nd arg
+                for arg in node.args[1:2]:
+                    axes |= _axis_strings(arg)
+            # only mesh/SPMD *constructors* declare axes through call
+            # keywords; an `axis_name='db'` kwarg on an ordinary helper
+            # call is a use — counting it would let a typo self-whitelist
+            # project-wide
+            if tail in ("shard_map", "pmap", "xmap") or tail.startswith(
+                    ("make_mesh", "Mesh")):
+                for kw in node.keywords:
+                    if kw.arg in _AXIS_PARAM_NAMES:
+                        axes |= _axis_strings(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = [*a.posonlyargs, *a.args]
+            defaults = list(a.defaults)
+            # defaults align right: pad on the left
+            defaults = [None] * (len(params) - len(defaults)) + defaults
+            for p, d in zip(params, defaults):
+                if d is not None and p.arg in _AXIS_PARAM_NAMES:
+                    axes |= _axis_strings(d)
+            for p, d in zip(a.kwonlyargs, a.kw_defaults):
+                if d is not None and p.arg in _AXIS_PARAM_NAMES:
+                    axes |= _axis_strings(d)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in _AXIS_PARAM_NAMES:
+                    axes |= _axis_strings(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if (isinstance(node.target, ast.Name)
+                    and node.target.id in _AXIS_PARAM_NAMES):
+                axes |= _axis_strings(node.value)
+    return axes
+
+
+class AxisConsistencyRule(Rule):
+    id = "JAX003"
+    name = "axis-name-consistency"
+    description = ("literal axis names at psum/pmean/all_gather/… call "
+                   "sites must be declared mesh axes")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        known = set(ctx.known_axes) | collect_declared_axes(ctx.tree)
+        if not known:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            tail = fname.split(".")[-1] if fname else ""
+            if tail not in _COLLECTIVES:
+                continue
+            axis_arg = self._axis_argument(node, tail)
+            if axis_arg is None:
+                continue
+            for axis in sorted(_axis_strings(axis_arg)):
+                if axis not in known:
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"collective `{tail}` over undeclared axis "
+                        f"{axis!r}; declared axes: "
+                        f"{', '.join(sorted(known))}"))
+        return findings
+
+    def _axis_argument(self, call: ast.Call, tail: str) -> Optional[ast.AST]:
+        # NOT `axis=`: on all_gather/all_to_all that kwarg is the
+        # concatenation *dimension*, never the mesh axis
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        # positional: axis_index/axis_size take it 1st, the rest 2nd
+        pos = 0 if tail in ("axis_index", "axis_size") else 1
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None

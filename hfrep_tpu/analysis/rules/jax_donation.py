@@ -1,0 +1,167 @@
+"""JAX004 — donated buffer read after donation.
+
+Failure mode: ``jax.jit(step, donate_argnums=(0,))`` lets XLA alias the
+input buffer into the output; touching the Python-side array afterwards
+reads freed/aliased device memory and raises
+``RuntimeError: Array has been deleted`` — but only when the runtime
+actually re-used the buffer, so CPU test runs pass and the TPU job dies.
+The sanctioned pattern rebinds in one statement, ``state = step(state,
+key)``; this rule flags any *later* load of a name that was passed in a
+donated position and never rebound.
+
+Scope model: donors (jit-wrapped callables with a literal
+``donate_argnums``) are collected module-wide — both ``name = jax.jit(f,
+donate_argnums=…)`` bindings and ``@partial(jax.jit, donate_argnums=…)``
+decorated defs — then each function body is linearly scanned.  The scan
+is straight-line only (no fixed-point over loop back-edges): a donation
+and use in sequence is caught, exotic re-entrant flows are not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import (
+    Rule, dotted_name, decorator_jit_call, jit_call_info, scope_body,
+    walk_scopes,
+)
+
+
+def _literal_argnums(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def collect_donors(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positions, for every jit wrapper visible by name."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = jit_call_info(node.value)
+            nums = _donate_kw(call) if call is not None else None
+            if nums:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = nums
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = decorator_jit_call(dec)
+                nums = _donate_kw(call) if call is not None else None
+                if nums:
+                    donors[node.name] = nums
+    return donors
+
+
+def _donate_kw(call: Optional[ast.Call]) -> Optional[Tuple[int, ...]]:
+    if call is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            if kw.arg == "donate_argnames":
+                return None         # name-keyed donation: not tracked
+            return _literal_argnums(kw.value)
+    return None
+
+
+class DonationReuseRule(Rule):
+    id = "JAX004"
+    name = "use-after-donation"
+    description = ("a name passed in a donate_argnums position is read "
+                   "again without being rebound")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        donors = collect_donors(ctx.tree)
+        if not donors:
+            return []
+        findings: List[Finding] = []
+        for scope in walk_scopes(ctx.tree):
+            findings.extend(self._scan_scope(ctx, scope, donors))
+        return findings
+
+    def _scan_scope(self, ctx: FileContext, scope: ast.AST,
+                    donors: Dict[str, Tuple[int, ...]]) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def scan_expr_uses(node: ast.AST, donated: Dict[str, int]) -> None:
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in donated):
+                    findings.append(ctx.finding(
+                        self.id, sub,
+                        f"{sub.id!r} was donated on line {donated[sub.id]} "
+                        f"(donate_argnums) and read again; its buffer may "
+                        f"already be aliased — rebind the result instead"))
+
+        def record_donations(node: ast.AST, donated: Dict[str, int]) -> None:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = dotted_name(sub.func)
+                if callee not in donors:
+                    continue
+                for pos in donors[callee]:
+                    if pos < len(sub.args) and isinstance(sub.args[pos], ast.Name):
+                        donated[sub.args[pos].id] = sub.lineno
+
+        def clear_bound(target: ast.AST, donated: Dict[str, int]) -> None:
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name) and isinstance(
+                        getattr(sub, "ctx", None), ast.Store):
+                    donated.pop(sub.id, None)
+
+        def visit(stmts, donated: Dict[str, int]) -> None:
+            """Source-order linear scan: each statement's own expressions
+            are processed exactly once (use-check, then donation-record,
+            then rebind).  ``if``/``else`` branches are mutually
+            exclusive, so each scans a fork of the state and the join is
+            the union of the forks (donated on either path ⇒ unsafe
+            after); other compound bodies keep the straight-line
+            approximation documented in the module docstring."""
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue        # separate scope
+                headers = [c for c in ast.iter_child_nodes(stmt)
+                           if isinstance(c, (ast.expr, ast.withitem))]
+                for h in headers:
+                    scan_expr_uses(h, donated)
+                for h in headers:
+                    record_donations(h, donated)
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        clear_bound(t, donated)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    clear_bound(stmt.target, donated)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    clear_bound(stmt.target, donated)
+                if isinstance(stmt, ast.If):
+                    body_d, else_d = dict(donated), dict(donated)
+                    visit(stmt.body, body_d)
+                    visit(stmt.orelse, else_d)
+                    donated.clear()
+                    donated.update(else_d)
+                    donated.update(body_d)
+                    continue
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        visit(sub, donated)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    visit(handler.body, donated)
+
+        visit(scope_body(scope), {})
+        return findings
+
+    # kept separate so tests can exercise it directly
+    collect_donors = staticmethod(collect_donors)

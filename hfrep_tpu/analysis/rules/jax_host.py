@@ -1,0 +1,145 @@
+"""JAX001 — host numpy / Python control flow on tracers inside jitted code.
+
+Failure mode on TPU: inside a ``@jax.jit`` / ``shard_map`` function a
+host ``np.*`` call silently pulls the tracer to the host
+(``ConcretizationTypeError`` at best, a wrong constant baked into the
+compiled program at worst), and a Python ``if``/``for`` on a traced
+value either raises ``TracerBoolConversionError`` or — when the value
+happens to be concrete at trace time — freezes one branch into the
+compiled program for *all* future inputs.
+
+Detection is deliberately conservative to stay useful as a CI gate:
+
+* a function counts as *jitted* when a jit/pmap/shard_map decorator is
+  attached, or its name is passed (possibly through
+  ``functools.partial``) as the first argument to jit/pmap/shard_map
+  anywhere in the file;
+* only **parameter** names are treated as tracers, resolved per scope
+  with proper shadowing (a static ``for i in range(n)`` loop variable
+  shadows a same-named nested-function parameter, and vice versa);
+  locals derived from params are not chased — too many static locals
+  (``axis_size``, shapes) would drown the signal;
+* ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size``, ``len(x)``,
+  ``isinstance(x, …)`` and ``x is None`` tests are *static* at trace
+  time and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import (
+    Rule, direct_nodes, dotted_name, import_aliases, jitted_defs,
+    tracer_scopes,
+)
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "range"}
+
+
+def _tracer_loads(test: ast.AST, tracers: Set[str]) -> List[ast.Name]:
+    """Name loads of tracer params in a test expr, minus static contexts."""
+    hits: List[ast.Name] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return              # x.shape[...] etc: static under trace
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname and fname.split(".")[-1] in _STATIC_CALLS:
+                return              # len(x), isinstance(x, T): static
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None`: a static identity test
+            if (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.Is, ast.IsNot))):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            return
+        if isinstance(node, ast.Name) and node.id in tracers:
+            hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hits
+
+
+class HostOpsInJitRule(Rule):
+    id = "JAX001"
+    name = "host-ops-in-jit"
+    description = ("host numpy calls and Python if/for/while on traced "
+                   "values inside jit/pmap/shard_map functions")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        np_roots = import_aliases(ctx.tree, "numpy")
+        seen_scopes: Set[int] = set()
+        for fn in jitted_defs(ctx.tree):
+            for scope, tracers in tracer_scopes(fn):
+                # a jitted def nested in another jitted def would be
+                # visited twice; report each scope once
+                if id(scope) in seen_scopes:
+                    continue
+                seen_scopes.add(id(scope))
+                findings.extend(self._check_scope(
+                    ctx, scope, getattr(fn, "name", "<fn>"), tracers,
+                    np_roots))
+        return findings
+
+    def _check_scope(self, ctx: FileContext, scope: ast.AST,
+                     jit_name: str, tracers: Set[str],
+                     np_roots: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in direct_nodes(scope):
+            if isinstance(node, (ast.If, ast.While)):
+                for hit in _tracer_loads(node.test, tracers):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"Python `{kind}` on traced value {hit.id!r} inside "
+                        f"jitted `{jit_name}`; use lax.cond/jnp.where or "
+                        f"mark it static"))
+            elif isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Name) and it.id in tracers:
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"Python `for` over traced value {it.id!r} inside "
+                        f"jitted `{jit_name}`; use lax.scan/fori_loop"))
+                elif (isinstance(it, ast.Attribute)
+                      and isinstance(it.value, ast.Name)
+                      and it.value.id in tracers
+                      and it.attr not in _STATIC_ATTRS):
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"Python `for` over traced value "
+                        f"`{it.value.id}.{it.attr}` inside jitted "
+                        f"`{jit_name}`; use lax.scan/fori_loop"))
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if not fname or "." not in fname:
+                    continue
+                root = fname.split(".")[0]
+                if root not in np_roots:
+                    continue
+                arg_nodes = list(node.args) + [kw.value for kw in node.keywords]
+                touched = sorted({
+                    n.id for a in arg_nodes for n in ast.walk(a)
+                    if isinstance(n, ast.Name) and n.id in tracers})
+                if touched:
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"host numpy call `{fname}` on traced value(s) "
+                        f"{', '.join(touched)} inside jitted "
+                        f"`{jit_name}`; use jnp"))
+        return findings

@@ -1,0 +1,262 @@
+"""JAX002 — ``jax.random`` key reuse.
+
+Failure mode: passing one PRNG key to two primitives makes their outputs
+perfectly correlated — samples that should be independent share a seed.
+Nothing crashes; the GAN just trains on statistically broken noise (the
+classic variant: reusing the init key as the first epoch key, which
+pins epoch 0's batch selection to the parameter init).
+
+Model: a per-scope linear scan over statements.  Any name passed as the
+first argument to a consuming ``jax.random`` primitive (samplers *and*
+``split`` — using a key after splitting it is the textbook bug) is
+tracked; a second consumption without an intervening rebind is a
+finding.  ``fold_in`` is a *derivation* (it mixes extra data in) and
+does not consume.  Control flow:
+
+* ``if``/``else`` branches fork the state and are merged afterwards, so
+  a key consumed once per exclusive branch is not flagged;
+* a consumption inside a ``for``/``while``/comprehension of a key that
+  is never reassigned in that loop body is flagged even on first use —
+  every iteration would draw the same randomness (the sanctioned
+  patterns rebind per iteration, ``key, sub = split(key)``, or derive
+  per iteration, ``fold_in(key, i)``).
+
+Dotted targets (``self.key``) are tracked like plain names so the
+trainer's ``self.key, sub = jax.random.split(self.key)`` idiom checks
+out.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import (
+    Rule, dotted_name, from_imports, import_aliases, scope_body, walk_scopes,
+)
+
+#: jax.random callables whose first argument is a key they consume.
+_CONSUMERS = {
+    "split", "normal", "uniform", "randint", "permutation", "bernoulli",
+    "categorical", "choice", "gumbel", "truncated_normal", "beta", "gamma",
+    "dirichlet", "exponential", "laplace", "logistic", "multivariate_normal",
+    "poisson", "rademacher", "t", "shuffle", "orthogonal", "ball", "cauchy",
+    "maxwell", "bits", "binomial", "loggamma", "pareto", "rayleigh",
+    "triangular", "weibull_min",
+}
+#: derive-don't-consume: safe to call repeatedly on the same key with
+#: different data.
+_DERIVERS = {"fold_in", "key_data", "wrap_key_data", "key_impl", "clone"}
+
+
+class _KeyState:
+    """consumed: name -> line of first consumption; assigned_depth: name ->
+    loop depth of the most recent (re)bind."""
+
+    def __init__(self) -> None:
+        self.consumed: Dict[str, int] = {}
+        self.assigned_depth: Dict[str, int] = {}
+        self.loop_rebound: Set[str] = set()
+
+    def fork(self) -> "_KeyState":
+        s = _KeyState()
+        s.consumed = dict(self.consumed)
+        s.assigned_depth = dict(self.assigned_depth)
+        s.loop_rebound = set(self.loop_rebound)
+        return s
+
+    def merge(self, *branches: "_KeyState") -> None:
+        """Join control-flow branches.  Each branch *started* as a fork of
+        this state, so the union of the branches' consumed maps is the
+        post-join truth: a key rebound on every path appears in no
+        branch and is correctly cleared; a key still stale on any one
+        path survives (earliest consumption line wins)."""
+        merged: Dict[str, int] = {}
+        for b in branches:
+            for k, line in b.consumed.items():
+                merged.setdefault(k, line)
+        self.consumed = merged
+        for b in branches:
+            self.assigned_depth.update(b.assigned_depth)
+
+
+class KeyReuseRule(Rule):
+    id = "JAX002"
+    name = "prng-key-reuse"
+    description = ("a jax.random key consumed twice (or consumed inside a "
+                   "loop) without split/fold_in")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        self._random_names = self._resolve_random_names(ctx.tree)
+        findings: List[Finding] = []
+        for scope in walk_scopes(ctx.tree):
+            findings.extend(self._check_scope(ctx, scope))
+        return findings
+
+    # ------------------------------------------------------------ naming
+    def _resolve_random_names(self, tree: ast.AST) -> Dict[str, str]:
+        """local callable name -> jax.random fn name, for every way the
+        module can be spelled (jax.random.X, jr.X, random.X, bare X)."""
+        names: Dict[str, str] = {}
+        prefixes = import_aliases(tree, "jax.random") | {"jax.random"}
+        for alias in import_aliases(tree, "jax"):
+            prefixes.add(f"{alias}.random")
+        for local, orig in from_imports(tree, "jax.random").items():
+            names[local] = orig
+        self._random_prefixes = prefixes
+        return names
+
+    def _random_fn(self, call: ast.Call) -> Optional[str]:
+        fname = dotted_name(call.func)
+        if fname is None:
+            return None
+        if fname in self._random_names:         # bare from-import
+            return self._random_names[fname]
+        head, _, tail = fname.rpartition(".")
+        if head in self._random_prefixes:
+            return tail
+        return None
+
+    # ------------------------------------------------------------- scan
+    def _check_scope(self, ctx: FileContext, scope: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        state = _KeyState()
+        self._visit_block(ctx, scope_body(scope), state, 0, findings)
+        return findings
+
+    def _visit_block(self, ctx, stmts, state: _KeyState, depth: int,
+                     findings: List[Finding]) -> None:
+        for stmt in stmts:
+            self._visit_stmt(ctx, stmt, state, depth, findings)
+
+    def _visit_stmt(self, ctx, stmt: ast.stmt, state: _KeyState, depth: int,
+                    findings: List[Finding]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                  # nested scopes are walked separately
+        if isinstance(stmt, ast.If):
+            self._visit_exprs(ctx, stmt.test, state, depth, findings)
+            body_s, else_s = state.fork(), state.fork()
+            self._visit_block(ctx, stmt.body, body_s, depth, findings)
+            self._visit_block(ctx, stmt.orelse, else_s, depth, findings)
+            state.merge(body_s, else_s)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._visit_exprs(ctx, stmt.iter, state, depth, findings)
+                self._bind_target(stmt.target, state, depth + 1)
+            else:
+                self._visit_exprs(ctx, stmt.test, state, depth, findings)
+            loop_state = state.fork()
+            loop_state.loop_rebound |= self._assigned_names(stmt.body)
+            self._visit_block(ctx, stmt.body, loop_state, depth + 1, findings)
+            self._visit_block(ctx, stmt.orelse, loop_state, depth, findings)
+            state.merge(loop_state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._visit_exprs(ctx, item.context_expr, state, depth, findings)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, state, depth)
+            self._visit_block(ctx, stmt.body, state, depth, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            self._visit_block(ctx, stmt.body, state, depth, findings)
+            for h in stmt.handlers:
+                self._visit_block(ctx, h.body, state, depth, findings)
+            self._visit_block(ctx, stmt.orelse, state, depth, findings)
+            self._visit_block(ctx, stmt.finalbody, state, depth, findings)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._visit_exprs(ctx, stmt.value, state, depth, findings)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._bind_target(t, state, depth)
+            return
+        # generic statement: just walk its expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._visit_exprs(ctx, child, state, depth, findings)
+
+    def _assigned_names(self, stmts) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                        getattr(node, "ctx", None), ast.Store):
+                    d = dotted_name(node)
+                    if d:
+                        out.add(d)
+        return out
+
+    def _bind_target(self, target: ast.AST, state: _KeyState, depth: int) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, state, depth)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind_target(target.value, state, depth)
+            return
+        d = dotted_name(target)
+        if d:
+            state.consumed.pop(d, None)
+            state.assigned_depth[d] = depth
+
+    # ------------------------------------------------------- expressions
+    def _visit_exprs(self, ctx, expr: ast.AST, state: _KeyState, depth: int,
+                     findings: List[Finding]) -> None:
+        """Single-visit recursive walk; entering a comprehension bumps the
+        loop depth (its body repeats per item), entering a lambda stops
+        (lambdas are separate scopes, walked on their own)."""
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for i, gen in enumerate(expr.generators):
+                # the first iterable evaluates once, in the enclosing scope
+                self._visit_exprs(ctx, gen.iter, state,
+                                  depth if i == 0 else depth + 1, findings)
+                # the target rebinds per item — `[normal(k) for k in
+                # split(key, n)]` consumes a FRESH k each iteration
+                self._bind_target(gen.target, state, depth + 1)
+                for cond in gen.ifs:
+                    self._visit_exprs(ctx, cond, state, depth + 1, findings)
+            elts = ([expr.key, expr.value] if isinstance(expr, ast.DictComp)
+                    else [expr.elt])
+            for e in elts:
+                self._visit_exprs(ctx, e, state, depth + 1, findings)
+            return
+        if isinstance(expr, ast.Call):
+            self._handle_call(ctx, expr, state, depth, findings)
+        for child in ast.iter_child_nodes(expr):
+            self._visit_exprs(ctx, child, state, depth, findings)
+
+    def _handle_call(self, ctx, call: ast.Call, state: _KeyState, depth: int,
+                     findings: List[Finding]) -> None:
+        fn = self._random_fn(call)
+        if fn is None or fn in _DERIVERS or fn not in _CONSUMERS:
+            return
+        key_arg = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+        name = dotted_name(key_arg) if key_arg is not None else None
+        if name is None:
+            return                  # derived expr (fold_in(...), keys[i], …)
+        prev = state.consumed.get(name)
+        if prev is not None:
+            findings.append(ctx.finding(
+                self.id, call,
+                f"key {name!r} reused by jax.random.{fn} (already consumed "
+                f"on line {prev}); split it first"))
+        else:
+            bind_depth = state.assigned_depth.get(name, 0)
+            rebound = getattr(state, "loop_rebound", set())
+            if depth > bind_depth and name not in rebound:
+                findings.append(ctx.finding(
+                    self.id, call,
+                    f"key {name!r} consumed by jax.random.{fn} inside a "
+                    f"loop without per-iteration split/fold_in"))
+        state.consumed[name] = call.lineno

@@ -1,0 +1,139 @@
+"""JAX005 — mutable default arguments & in-place mutation of arg pytrees.
+
+Two related impurity classes, one rule:
+
+* **Mutable defaults** (``def f(x, acc=[])``): the default is evaluated
+  once at import; state leaks across calls.  In a JAX codebase this is
+  doubly poisonous because a cached default list/dict can end up baked
+  into a traced closure on first call and silently shared by every
+  later trace.  Checked on *every* function.
+
+* **In-place mutation of parameters** (``params['w'] = …``,
+  ``batch.update(…)``): a jitted function must be pure — mutation
+  happens once at trace time, the compiled program replays the traced
+  *values*, and the Python-side object silently diverges from what the
+  program computes on every later call.  Checked only on functions that
+  are actually jit/pmap/shard_map-compiled (host-side accumulators and
+  pallas kernel ``ref[...] =`` stores are sanctioned idioms, not bugs);
+  ``self``/``cls`` are exempt, as are names rebound before the mutation
+  (``x = dict(x)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import (
+    Rule, direct_nodes, jitted_defs, tracer_scopes,
+)
+
+_MUTABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set)
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "Counter", "deque"}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "pop",
+             "popitem", "clear", "remove", "sort", "reverse", "add",
+             "discard", "appendleft", "extendleft"}
+
+
+class MutationRule(Rule):
+    id = "JAX005"
+    name = "arg-mutation"
+    description = ("mutable default arguments (any function) and in-place "
+                   "mutation of arguments inside jitted functions")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_defaults(ctx, node))
+        seen: Set[int] = set()
+        for fn in jitted_defs(ctx.tree):
+            for scope, tracers in tracer_scopes(fn):
+                if id(scope) in seen:
+                    continue
+                seen.add(id(scope))
+                findings.extend(self._check_mutations(
+                    ctx, scope, getattr(fn, "name", "<fn>"), tracers))
+        return findings
+
+    # ---------------------------------------------------------- defaults
+    def _check_defaults(self, ctx: FileContext, fn) -> List[Finding]:
+        findings: List[Finding] = []
+        a = fn.args
+        for default in [*a.defaults, *[d for d in a.kw_defaults if d]]:
+            bad = isinstance(default, _MUTABLE_DISPLAYS)
+            if (not bad and isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in _MUTABLE_CALLS):
+                bad = True
+            if bad:
+                findings.append(ctx.finding(
+                    self.id, default,
+                    f"mutable default argument in `{fn.name}`; default "
+                    f"to None and construct inside the body"))
+        return findings
+
+    # --------------------------------------------------------- mutations
+    def _check_mutations(self, ctx: FileContext, scope: ast.AST,
+                         jit_name: str, tracers: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        if not tracers:
+            return findings
+        rebound: Set[str] = set()
+
+        def param_root(node: ast.AST) -> str:
+            """name of the tracer a subscript/attribute chain hangs off,
+            or '' when the root is not an un-rebound tracer param."""
+            while isinstance(node, (ast.Subscript, ast.Attribute)):
+                node = node.value
+            if (isinstance(node, ast.Name) and node.id in tracers
+                    and node.id not in rebound):
+                return node.id
+            return ""
+
+        for node in direct_nodes(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        rebound.add(t.id)       # x = dict(x): later edits fine
+                    else:
+                        root = param_root(t)
+                        if root:
+                            findings.append(ctx.finding(
+                                self.id, t,
+                                f"in-place mutation of argument {root!r} "
+                                f"inside jitted `{jit_name}`; rebuild the "
+                                f"pytree instead (replace/tree_map)"))
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    rebound.add(node.target.id)  # x += 1 rebinds the name
+                else:
+                    root = param_root(node.target)
+                    if root:
+                        findings.append(ctx.finding(
+                            self.id, node.target,
+                            f"in-place mutation of argument {root!r} "
+                            f"inside jitted `{jit_name}`; rebuild the "
+                            f"pytree instead"))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    root = param_root(t)
+                    if root and not isinstance(t, ast.Name):
+                        findings.append(ctx.finding(
+                            self.id, t,
+                            f"`del` into argument {root!r} inside jitted "
+                            f"`{jit_name}`; rebuild the pytree instead"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in tracers
+                        and f.value.id not in rebound):
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"in-place `{f.attr}` on argument {f.value.id!r} "
+                        f"inside jitted `{jit_name}`; copy or rebuild "
+                        f"instead"))
+        return findings

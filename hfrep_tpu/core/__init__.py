@@ -1,3 +1,5 @@
+
+from __future__ import annotations
 from hfrep_tpu.core.scaler import MinMaxScaler, ScalerParams  # noqa: F401
 from hfrep_tpu.core.sampling import sample_windows  # noqa: F401
 from hfrep_tpu.core.data import Panel, load_panel, build_gan_dataset  # noqa: F401

@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from hfrep_tpu.analysis.contracts import contract
 
+
+@contract("*,(T,F)->(N,W,F)")
 def sample_windows(key: jax.Array, data: jnp.ndarray, n_sample: int, window: int) -> jnp.ndarray:
     """Draw (n_sample, window, F) random contiguous windows from (T, F) data."""
     t, f = data.shape

@@ -16,6 +16,8 @@ SURVEY §3.3-3.4); here each flow is a config-driven, reproducible program:
 ``python -m hfrep_tpu <subcommand>`` dispatches to these.
 """
 
+from __future__ import annotations
+
 __all__ = [
     "AugmentedData", "augment_training_set", "sample_generator",
     "SweepResult", "run_sweep",

@@ -1,2 +1,4 @@
+
+from __future__ import annotations
 from hfrep_tpu.metrics.gan_eval import GanEval  # noqa: F401
 from hfrep_tpu.metrics.gaussian_nb import GaussianNBParams, fit_gaussian_nb, predict_log_proba, predict_proba  # noqa: F401

@@ -1,3 +1,5 @@
+
+from __future__ import annotations
 from hfrep_tpu.models.generators import DenseGenerator, LSTMGenerator  # noqa: F401
 from hfrep_tpu.models.discriminators import (  # noqa: F401
     DenseDiscriminator, DenseCritic, DenseFlatCritic,

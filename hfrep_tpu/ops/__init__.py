@@ -1,3 +1,5 @@
+
+from __future__ import annotations
 from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu  # noqa: F401
 from hfrep_tpu.ops.lstm import KerasLSTM  # noqa: F401
 from hfrep_tpu.ops.rolling import rolling_ols_beta  # noqa: F401

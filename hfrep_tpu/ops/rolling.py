@@ -13,7 +13,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from hfrep_tpu.analysis.contracts import contract
 
+
+@contract("(T,F)->(N,W,F)")
 def _window_stack(x: jnp.ndarray, window: int) -> jnp.ndarray:
     """(T, F) → (T - window + 1, window, F) sliding windows."""
     t, f = x.shape
@@ -21,6 +24,7 @@ def _window_stack(x: jnp.ndarray, window: int) -> jnp.ndarray:
     return jax.vmap(lambda s: lax.dynamic_slice(x, (s, 0), (window, f)))(starts)
 
 
+@contract("(T,S),(T,K)->(N,K,S)")
 def rolling_ols_beta(y: jnp.ndarray, x: jnp.ndarray, window: int) -> jnp.ndarray:
     """Rolling no-intercept OLS betas for every window start.
 
@@ -40,6 +44,7 @@ def rolling_ols_beta(y: jnp.ndarray, x: jnp.ndarray, window: int) -> jnp.ndarray
     return jax.vmap(lambda a, b: jnp.linalg.pinv(a) @ b)(xtx, xty)
 
 
+@contract("(T,S),(T,K)->(_,S)")
 def ols_beta(y: jnp.ndarray, x: jnp.ndarray, add_constant: bool = False) -> jnp.ndarray:
     """Single OLS fit via pinv; with ``add_constant`` the intercept is
     column 0, matching ``sm.add_constant`` (``autoencoder_v4.ipynb`` cell
@@ -49,7 +54,8 @@ def ols_beta(y: jnp.ndarray, x: jnp.ndarray, add_constant: bool = False) -> jnp.
     return jnp.linalg.pinv(x.T @ x) @ (x.T @ y)
 
 
-def expanding_minmax_scale(x: jnp.ndarray) -> jnp.ndarray:
+@contract("(T,F)->(T,F),(T,F)")
+def expanding_minmax_scale(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """For each prefix length i, MinMax params fit on ``x[:i]``.
 
     Vectorizes the reference's per-step ``MinMaxScaler().fit_transform

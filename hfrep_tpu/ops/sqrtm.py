@@ -18,7 +18,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from hfrep_tpu.analysis.contracts import contract
 
+
+@contract("(F,F),(F,F)->()")
 def sqrtm_product_trace(sigma1: jnp.ndarray, sigma2: jnp.ndarray) -> jnp.ndarray:
     """trace(sqrtm(sigma1 @ sigma2)) for symmetric PSD inputs."""
     # Jitter for Cholesky on rank-deficient sample covariances.

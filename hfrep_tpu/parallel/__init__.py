@@ -1,3 +1,5 @@
+
+from __future__ import annotations
 from hfrep_tpu.parallel.mesh import (  # noqa: F401
     initialize_distributed,
     make_mesh,

@@ -1,2 +1,4 @@
+
+from __future__ import annotations
 from hfrep_tpu.replication.engine import AEResult, ReplicationEngine, train_autoencoder  # noqa: F401
 from hfrep_tpu.replication import perf_stats, spanning  # noqa: F401

@@ -1,3 +1,5 @@
+
+from __future__ import annotations
 from hfrep_tpu.train.states import GanState, init_gan_state  # noqa: F401
 from hfrep_tpu.train.steps import make_train_step, make_multi_step  # noqa: F401
 from hfrep_tpu.train.trainer import GanTrainer  # noqa: F401

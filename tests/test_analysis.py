@@ -1,0 +1,841 @@
+"""Unit tests for hfrep_tpu.analysis — pure AST, no JAX device work.
+
+Each rule gets positive fixtures (the bug class it exists for), negative
+fixtures (the sanctioned idioms it must NOT flag — these encode the
+false-positive lessons from running the analyzer over this very repo),
+a ``# noqa`` suppression check, and the engine gets noqa/baseline/CLI
+coverage.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from hfrep_tpu.analysis import (
+    ContractError, analyze_source, apply_baseline, contract, load_baseline,
+    parse_contract_spec, parse_shape_spec, write_baseline,
+)
+from hfrep_tpu.analysis.cli import main as cli_main
+from hfrep_tpu.analysis.rules import RULES_BY_ID
+from hfrep_tpu.analysis.rules.jax_axes import collect_declared_axes
+import ast
+
+
+def run(src, rule=None, axes=None):
+    rules = [RULES_BY_ID[rule]] if rule else None
+    return analyze_source(textwrap.dedent(src), path="snippet.py",
+                          rules=rules, known_axes=axes)
+
+
+def codes(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------ JAX001
+class TestHostOpsInJit:
+    def test_positive_host_if_on_tracer(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """, rule="JAX001")
+        assert codes(fs) == ["JAX001"]
+        assert "if" in fs[0].message
+
+    def test_positive_numpy_call_on_tracer(self):
+        fs = run("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return np.asarray(x).sum()
+            """, rule="JAX001")
+        assert codes(fs) == ["JAX001"]
+        assert "np.asarray" in fs[0].message
+
+    def test_positive_for_over_tracer_in_wrapped_fn(self):
+        # jit applied by name, not decorator — the repo's dominant form
+        fs = run("""
+            import jax
+            def step(batch):
+                total = 0
+                for row in batch:
+                    total = total + row
+                return total
+            fast_step = jax.jit(step, donate_argnums=(0,))
+            """, rule="JAX001")
+        assert codes(fs) == ["JAX001"]
+        assert "for" in fs[0].message
+
+    def test_negative_static_shape_and_none_tests(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(x, w=None):
+                if x.shape[0] > 2:
+                    x = x[:2]
+                if w is None:
+                    return x
+                if len(x) > 3 and isinstance(w, float):
+                    return x * w
+                return x + w
+            """, rule="JAX001")
+        assert fs == []
+
+    def test_negative_unjitted_function(self):
+        fs = run("""
+            import numpy as np
+            def host(x):
+                if x > 0:
+                    return np.asarray(x)
+                return x
+            """, rule="JAX001")
+        assert fs == []
+
+    def test_negative_static_loop_var_shadows_nested_param(self):
+        # regression: parallel/sequence.py superstep's `for i in range(n)`
+        # where a sibling nested fn also has a param named `i`
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(x):
+                def run_chunk(i, seq):
+                    return seq * i
+                out = x
+                for i in range(3):
+                    if i > 0:
+                        out = run_chunk(i, out)
+                return out
+            """, rule="JAX001")
+        assert fs == []
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:  # noqa: JAX001
+                    return x
+                return -x
+            """, rule="JAX001")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ JAX002
+class TestKeyReuse:
+    def test_positive_same_key_two_draws(self):
+        fs = run("""
+            import jax
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+        assert "reused" in fs[0].message
+
+    def test_positive_use_after_split(self):
+        fs = run("""
+            import jax
+            def f(key):
+                keys = jax.random.split(key, 4)
+                z = jax.random.normal(key, (3,))
+                return keys, z
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+
+    def test_positive_consumed_in_loop(self):
+        fs = run("""
+            import jax
+            def f(key):
+                out = []
+                for i in range(4):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+        assert "loop" in fs[0].message
+
+    def test_positive_consumed_in_comprehension(self):
+        fs = run("""
+            import jax
+            def f(key):
+                return [jax.random.normal(key, (3,)) for _ in range(4)]
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+
+    def test_negative_comprehension_over_split_keys(self):
+        # regression: the idiomatic fan-out — each k is fresh per item
+        fs = run("""
+            import jax
+            def f(key, n):
+                return [jax.random.normal(k, (4,))
+                        for k in jax.random.split(key, n)]
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_negative_split_and_rebind(self):
+        fs = run("""
+            import jax
+            def f(key):
+                key, sub = jax.random.split(key)
+                a = jax.random.normal(sub, (3,))
+                keys = jax.random.split(key, 8)
+                return a, keys
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_negative_fold_in_derivation_in_loop(self):
+        # the repo's sanctioned per-step pattern (train/steps.py)
+        fs = run("""
+            import jax
+            def f(key, n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.normal(jax.random.fold_in(key, i), ()))
+                return out
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_negative_rebind_inside_loop(self):
+        # trainer.py idiom: self.key, sub = split(self.key) each epoch
+        fs = run("""
+            import jax
+            class T:
+                def fit(self, n):
+                    for _ in range(n):
+                        self.key, sub = jax.random.split(self.key)
+                        self.draw(sub)
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_negative_rebind_on_every_branch_clears_consumption(self):
+        # regression: a key consumed once and then rebound on BOTH
+        # branches of an if/else is fresh afterwards
+        fs = run("""
+            import jax
+            def f(key, cond):
+                x = jax.random.normal(key, ())
+                if cond:
+                    key = jax.random.PRNGKey(1)
+                else:
+                    key = jax.random.PRNGKey(2)
+                return x + jax.random.normal(key, ())
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_positive_rebind_on_one_branch_only_still_flags(self):
+        fs = run("""
+            import jax
+            def f(key, cond):
+                x = jax.random.normal(key, ())
+                if cond:
+                    key = jax.random.PRNGKey(1)
+                return x + jax.random.normal(key, ())
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+
+    def test_negative_exclusive_branches(self):
+        fs = run("""
+            import jax
+            def f(key, flag):
+                if flag:
+                    return jax.random.normal(key, ())
+                else:
+                    return jax.random.uniform(key, ())
+            """, rule="JAX002")
+        assert fs == []
+
+    def test_import_alias_forms(self):
+        fs = run("""
+            import jax.random as jr
+            from jax.random import normal
+            def f(key):
+                a = jr.uniform(key, ())
+                b = normal(key, ())
+                return a + b
+            """, rule="JAX002")
+        assert codes(fs) == ["JAX002"]
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            import jax
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))  # noqa: JAX002
+                return a + b
+            """, rule="JAX002")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ JAX003
+class TestAxisConsistency:
+    def test_positive_undeclared_axis(self):
+        fs = run("""
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'dq')
+            """, rule="JAX003", axes={"dp", "sp"})
+        assert codes(fs) == ["JAX003"]
+        assert "'dq'" in fs[0].message
+
+    def test_positive_axis_kwarg_and_tuple(self):
+        fs = run("""
+            from jax import lax
+            def f(x):
+                return lax.pmean(x, axis_name=('dp', 'xx'))
+            """, rule="JAX003", axes={"dp"})
+        assert codes(fs) == ["JAX003"]
+
+    def test_negative_declared_axis(self):
+        fs = run("""
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'dp') + lax.axis_index('sp')
+            """, rule="JAX003", axes={"dp", "sp"})
+        assert fs == []
+
+    def test_positive_axis_dim_kwarg_does_not_mask_mesh_axis(self):
+        # regression: all_gather's `axis=` kwarg is the concat DIMENSION,
+        # not the mesh axis — it must not swallow a typo'd positional name
+        fs = run("""
+            from jax import lax
+            def f(x):
+                return lax.all_gather(x, 'dq', axis=0)
+            """, rule="JAX003", axes={"dp"})
+        assert codes(fs) == ["JAX003"]
+
+    def test_negative_no_known_axes_stays_silent(self):
+        fs = run("""
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'anything')
+            """, rule="JAX003")
+        assert fs == []
+
+    def test_helper_call_kwarg_does_not_self_whitelist(self):
+        # regression: axis_name= on an ordinary helper call is a USE —
+        # it must not declare the (typo'd) axis for the whole project
+        fs = run("""
+            from jax import lax
+            def build(step):
+                return wrap(step, axis_name='db')
+            def f(x):
+                return lax.psum(x, 'db')
+            """, rule="JAX003", axes={"dp"})
+        assert codes(fs) == ["JAX003"]
+
+    def test_file_local_declaration_counts(self):
+        fs = run("""
+            from jax import lax
+            from jax.sharding import Mesh
+            def make(devs):
+                return Mesh(devs, ('rows',))
+            def f(x):
+                return lax.psum(x, 'rows')
+            """, rule="JAX003", axes={"dp"})
+        assert fs == []
+
+    def test_collect_declared_axes(self):
+        tree = ast.parse(textwrap.dedent("""
+            from jax.sharding import Mesh
+            def make(devices, axis_name='dp'):
+                return Mesh(devices, ('dp', 'sp'))
+            def make3(devices):
+                return Mesh(devices.reshape(2, 2, 2), ('dp', 'sp', 'tp'))
+            axis_name = 'pp'
+            """))
+        assert collect_declared_axes(tree) == {"dp", "sp", "tp", "pp"}
+
+
+# ------------------------------------------------------------------ JAX004
+class TestUseAfterDonation:
+    def test_positive_read_after_donation(self):
+        fs = run("""
+            import jax
+            def step(state, x):
+                return state + x
+            fast = jax.jit(step, donate_argnums=(0,))
+            def train(state, xs):
+                new_state = fast(state, xs)
+                return new_state, state.mean()
+            """, rule="JAX004")
+        assert codes(fs) == ["JAX004"]
+        assert "donated" in fs[0].message
+
+    def test_positive_partial_decorated(self):
+        fs = run("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+            def train(state, xs):
+                out = step(state, xs)
+                loss = state.sum()
+                return out, loss
+            """, rule="JAX004")
+        assert codes(fs) == ["JAX004"]
+
+    def test_negative_rebind_same_statement(self):
+        fs = run("""
+            import jax
+            def step(state, x):
+                return state + x
+            fast = jax.jit(step, donate_argnums=(0,))
+            def train(state, xs):
+                for x in xs:
+                    state = fast(state, x)
+                return state
+            """, rule="JAX004")
+        assert fs == []
+
+    def test_negative_exclusive_branches(self):
+        # regression: a donation in the if-body must not poison a read on
+        # the (mutually exclusive) else path
+        fs = run("""
+            import jax
+            def step(state):
+                return state
+            fast = jax.jit(step, donate_argnums=(0,))
+            def g(state, cond):
+                if cond:
+                    out = fast(state)
+                else:
+                    out = state.copy()
+                return out
+            """, rule="JAX004")
+        assert fs == []
+
+    def test_positive_branch_donation_flags_read_after_join(self):
+        fs = run("""
+            import jax
+            def step(state):
+                return state
+            fast = jax.jit(step, donate_argnums=(0,))
+            def g(state, cond):
+                if cond:
+                    out = fast(state)
+                else:
+                    out = None
+                return out, state.mean()
+            """, rule="JAX004")
+        assert codes(fs) == ["JAX004"]
+
+    def test_noqa_suppresses(self):
+        fs = run("""
+            import jax
+            def step(state):
+                return state
+            fast = jax.jit(step, donate_argnums=(0,))
+            def g(state):
+                out = fast(state)
+                return out, state  # noqa: JAX004
+            """, rule="JAX004")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ JAX005
+class TestMutation:
+    def test_positive_mutable_default(self):
+        fs = run("""
+            def f(x, acc=[]):
+                return x
+            def g(x, cfg={}):
+                return x
+            def h(x, s=set()):
+                return x
+            """, rule="JAX005")
+        assert codes(fs) == ["JAX005"] * 3
+
+    def test_positive_param_mutation_in_jitted(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(params, x):
+                params['w'] = params['w'] + x
+                return params
+            """, rule="JAX005")
+        assert codes(fs) == ["JAX005"]
+        assert "in-place" in fs[0].message
+
+    def test_positive_mutator_method_in_jitted(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(metrics, x):
+                metrics.update(loss=x)
+                return metrics
+            """, rule="JAX005")
+        assert codes(fs) == ["JAX005"]
+
+    def test_negative_host_accumulator_not_flagged(self):
+        # un-jitted helpers may mutate their args (visitor/accumulator
+        # idiom — the analyzer itself does this)
+        fs = run("""
+            def walk(node, acc):
+                acc.append(node)
+                for c in node.children:
+                    walk(c, acc)
+            """, rule="JAX005")
+        assert fs == []
+
+    def test_negative_rebound_copy(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def f(params, x):
+                params = dict(params)
+                params['w'] = x
+                return params
+            """, rule="JAX005")
+        assert fs == []
+
+    def test_negative_self_exempt(self):
+        fs = run("""
+            import jax
+            @jax.jit
+            def method(self, x):
+                self.cache = x
+                return x
+            """, rule="JAX005")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ JAX006
+class TestShapeContracts:
+    def test_positive_rank_mismatch(self):
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros((4, 8, 3))  # shape: (B, T)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+        assert "rank mismatch" in fs[0].message
+
+    def test_positive_literal_dim_mismatch(self):
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros((4, 8))  # shape: (4, 16)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+
+    def test_positive_inconsistent_symbol(self):
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros((3, 4))  # shape: (B, B)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+        assert "symbol" in fs[0].message
+
+    def test_positive_unparseable_comment(self):
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros((3,))  # shape: (3; 4)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+
+    def test_positive_contract_arity(self):
+        fs = run("""
+            from hfrep_tpu.analysis.contracts import contract
+            @contract("(A),(B),(C)->(D)")
+            def f(x):
+                return x
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+        assert "3 input shapes" in fs[0].message
+
+    def test_negative_matching_annotation(self):
+        fs = run("""
+            import jax.numpy as jnp
+            n = 5
+            x = jnp.zeros((4, 8, 3))   # shape: (4, W, F)
+            y = jnp.ones((n, 3))       # shape: (N, F)
+            z = jnp.zeros((4, 4))      # shape: (B, B)
+            w = x.reshape(4, -1)       # shape: (B, WF)
+            """, rule="JAX006")
+        assert fs == []
+
+    def test_positive_annotation_on_continuation_line(self):
+        # regression: a `# shape:` comment on the wrapped line of a
+        # multi-line constructor must still be checked
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros(
+                (4, 8))  # shape: (B,)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+
+    def test_negative_nested_helper_return_not_checked_against_outer(self):
+        # regression: a helper closure's literal return answers the
+        # helper's (absent) contract, not the decorated outer one
+        fs = run("""
+            import jax.numpy as jnp
+            from hfrep_tpu.analysis.contracts import contract
+            @contract("(T,F)->(N,W,F)")
+            def outer(x):
+                def helper():
+                    return jnp.zeros((4, 4))
+                return stack(x, helper())
+            """, rule="JAX006")
+        assert fs == []
+
+    def test_function_form_reshape(self):
+        # regression: jnp.reshape(x, shape) must not count the array
+        # argument as a dimension
+        fs = run("""
+            import jax.numpy as jnp
+            y = jnp.reshape(x, n)        # shape: (n,)
+            z = jnp.reshape(x, (4, 2))   # shape: (B, F)
+            bad = jnp.reshape(x, (4, 2)) # shape: (B,)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+        assert "bad" in fs[0].snippet
+
+    def test_negative_trailing_prose_after_annotation(self):
+        # regression: prose (with its own parens) after the spec is fine
+        fs = run("""
+            import jax.numpy as jnp
+            x = jnp.zeros((4, 8))  # shape: (B, F) fit on x[:i] (prefix)
+            """, rule="JAX006")
+        assert fs == []
+
+    def test_negative_docstring_example_not_scanned(self):
+        fs = run('''
+            def f():
+                """Example: x = zeros((3,))  # shape: (B, T, F)"""
+                return None
+            ''', rule="JAX006")
+        assert fs == []
+
+    def test_random_normal_shape_checked(self):
+        fs = run("""
+            import jax
+            z = jax.random.normal(key, (32, 48, 35))  # shape: (B, W)
+            """, rule="JAX006")
+        assert codes(fs) == ["JAX006"]
+
+
+# ----------------------------------------------------- runtime contracts
+class TestRuntimeContract:
+    def test_spec_parsing(self):
+        assert parse_shape_spec("(B, T, F)") == ("B", "T", "F")
+        assert parse_shape_spec("()") == ()
+        assert parse_shape_spec("*") == "*"
+        ins, outs = parse_contract_spec("(T,S),(T,K)->(N,K,S)")
+        assert ins == [("T", "S"), ("T", "K")]
+        assert outs == [("N", "K", "S")]
+        with pytest.raises(ContractError):
+            parse_shape_spec("B, T")
+        with pytest.raises(ContractError):
+            parse_contract_spec("(B)")
+
+    def test_accepts_consistent_shapes(self):
+        @contract("(T,S),(T,K)->(K,S)")
+        def beta(y, x):
+            return np.zeros((x.shape[1], y.shape[1]))
+
+        out = beta(np.zeros((10, 3)), np.zeros((10, 2)))
+        assert out.shape == (2, 3)
+
+    def test_rejects_rank_mismatch(self):
+        @contract("(T,F)->(T,F)")
+        def f(x):
+            return x
+
+        with pytest.raises(ContractError, match="rank mismatch"):
+            f(np.zeros((4, 4, 4)))
+
+    def test_rejects_inconsistent_binding(self):
+        @contract("(T,S),(T,K)->(K,S)")
+        def beta(y, x):
+            return np.zeros((x.shape[1], y.shape[1]))
+
+        with pytest.raises(ContractError, match="symbol 'T'"):
+            beta(np.zeros((10, 3)), np.zeros((11, 2)))
+
+    def test_output_checked_against_input_bindings(self):
+        @contract("(T,F)->(F,F)")
+        def gram(x):
+            return np.zeros((x.shape[1] + 1, x.shape[1]))   # deliberately wrong
+
+        with pytest.raises(ContractError, match="symbol 'F'"):
+            gram(np.zeros((5, 3)))
+
+    def test_multi_output(self):
+        @contract("(T,F)->(T,F),(T,F)")
+        def minmax(x):
+            return x, x
+
+        a, b = minmax(np.zeros((4, 2)))
+        assert a.shape == (4, 2)
+
+    def test_wildcard_and_scalars_skipped(self):
+        @contract("*,(T,F)->(T,F)")
+        def sample(key, data, n=3):
+            return data
+
+        assert sample(object(), np.zeros((6, 2))).shape == (6, 2)
+
+    def test_env_kill_switch(self, monkeypatch):
+        @contract("(T,F)->(T,F)")
+        def f(x):
+            return x
+
+        monkeypatch.setenv("HFREP_CONTRACTS", "0")
+        assert f(np.zeros((1, 2, 3))).shape == (1, 2, 3)   # not enforced
+
+
+# ------------------------------------------------------- engine behavior
+class TestEngine:
+    SRC = """
+        import jax
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """
+
+    def test_bare_noqa_suppresses_everything(self):
+        fs = run(self.SRC.replace("b = jax", "b = jax", 1).replace(
+            "(3,))\n            return", "(3,))  # noqa\n            return"))
+        assert "JAX002" not in codes(fs)
+
+    def test_wrong_code_does_not_suppress(self):
+        src = self.SRC.replace("uniform(key, (3,))",
+                               "uniform(key, (3,))  # noqa: JAX001")
+        assert codes(run(src, rule="JAX002")) == ["JAX002"]
+
+    def test_syntax_error_becomes_jax000(self):
+        fs = analyze_source("def broken(:\n", path="bad.py")
+        assert codes(fs) == ["JAX000"]
+
+    def test_baseline_roundtrip(self, tmp_path):
+        findings = run(self.SRC, rule="JAX002")
+        assert len(findings) == 1
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, bl, justifications={
+            findings[0].fingerprint: "legacy site, tracked for burn-down"})
+        loaded = load_baseline(bl)
+        new, matched, stale = apply_baseline(findings, loaded)
+        assert new == [] and len(matched) == 1 and not stale
+
+    def test_baseline_does_not_cover_new_duplicate(self, tmp_path):
+        findings = run(self.SRC, rule="JAX002")
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, bl)
+        doubled = findings + findings       # a second identical violation
+        new, matched, _ = apply_baseline(doubled, load_baseline(bl))
+        assert len(matched) == 1 and len(new) == 1
+
+    def test_stale_baseline_reported(self, tmp_path):
+        findings = run(self.SRC, rule="JAX002")
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, bl)
+        new, matched, stale = apply_baseline([], load_baseline(bl))
+        assert new == [] and matched == [] and sum(stale.values()) == 1
+
+    def test_line_moves_do_not_invalidate_baseline(self, tmp_path):
+        findings = run(self.SRC, rule="JAX002")
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, bl)
+        moved = run("\n\n# moved down\n" + textwrap.dedent(self.SRC),
+                    rule="JAX002")
+        assert moved[0].line != findings[0].line
+        new, matched, _ = apply_baseline(moved, load_baseline(bl))
+        assert new == [] and len(matched) == 1
+
+
+# ------------------------------------------------------------------- CLI
+class TestCli:
+    def _write_bad(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent("""
+            import jax
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """))
+        return f
+
+    def test_exit_codes_and_baseline_flow(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        bl = tmp_path / "bl.json"
+        assert cli_main(["check", str(bad), "--baseline", str(bl)]) == 1
+        capsys.readouterr()
+        assert cli_main(["check", str(bad), "--baseline", str(bl),
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli_main(["check", str(bad), "--baseline", str(bl)]) == 0
+        out = capsys.readouterr().out
+        assert "clean" in out and "1 baselined" in out
+
+    def test_json_format(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        rc = cli_main(["check", str(bad), "--format", "json",
+                       "--no-baseline"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["counts"] == {"JAX002": 1}
+        assert payload["findings"][0]["rule"] == "JAX002"
+
+    def test_select_restricts_rules(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        rc = cli_main(["check", str(bad), "--select", "JAX001,JAX003",
+                       "--no-baseline"])
+        assert rc == 0
+
+    def test_unknown_rule_is_usage_error(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        assert cli_main(["check", str(bad), "--select", "JAX999"]) == 2
+
+    def test_select_with_write_baseline_refused(self, tmp_path, capsys):
+        # regression: a partial-rule snapshot must not wipe other rules'
+        # baseline entries
+        bad = self._write_bad(tmp_path)
+        bl = tmp_path / "bl.json"
+        cli_main(["check", str(bad), "--baseline", str(bl),
+                  "--write-baseline"])
+        capsys.readouterr()
+        assert cli_main(["check", str(bad), "--baseline", str(bl),
+                         "--select", "JAX001", "--write-baseline"]) == 2
+        assert load_baseline(bl)            # ledger untouched
+
+    def test_select_does_not_report_other_rules_entries_stale(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        bl = tmp_path / "bl.json"
+        cli_main(["check", str(bad), "--baseline", str(bl),
+                  "--write-baseline"])      # one JAX002 entry
+        capsys.readouterr()
+        assert cli_main(["check", str(bad), "--baseline", str(bl),
+                         "--select", "JAX001"]) == 0
+        assert "stale" not in capsys.readouterr().out
+
+    def test_explicit_non_py_path_errors(self, tmp_path, capsys):
+        readme = tmp_path / "notes.md"
+        readme.write_text("# not python\n")
+        assert cli_main(["check", str(readme), "--no-baseline"]) == 2
+
+    def test_corrupt_baseline_is_analyzer_error_not_traceback(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        bl = tmp_path / "bl.json"
+        bl.write_text("{not json")
+        assert cli_main(["check", str(bad), "--baseline", str(bl)]) == 2
+        assert cli_main(["check", str(bad), "--baseline", str(bl),
+                         "--write-baseline"]) == 2
+
+    def test_clean_file(self, tmp_path, capsys):
+        good = tmp_path / "ok.py"
+        good.write_text("import jax\n\n"
+                        "def f(key):\n"
+                        "    k1, k2 = jax.random.split(key)\n"
+                        "    return jax.random.normal(k1, (2,)),"
+                        " jax.random.normal(k2, (2,))\n")
+        assert cli_main(["check", str(good), "--no-baseline"]) == 0

@@ -76,9 +76,9 @@ def main():
                                                      with_cs=False))
     for (b, w, h) in [(32, 48, 100), (32, 168, 100)]:
         hp = ((h + LANE - 1) // LANE) * LANE
-        key = jax.random.PRNGKey(0)
-        xz32 = jax.random.normal(key, (w, b, 4 * hp), jnp.float32)
-        rec32 = jax.random.normal(key, (hp, 4 * hp), jnp.float32) * 0.05
+        k_xz, k_rec = jax.random.split(jax.random.PRNGKey(0))
+        xz32 = jax.random.normal(k_xz, (w, b, 4 * hp), jnp.float32)
+        rec32 = jax.random.normal(k_rec, (hp, 4 * hp), jnp.float32) * 0.05
         t32, h32 = time_fn(fwd, xz32, rec32)
         t16, h16 = time_fn(fwd, xz32.astype(jnp.bfloat16), rec32.astype(jnp.bfloat16))
         err = float(jnp.abs(h32 - h16).max())

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Static-analysis gate: JAX-aware lint + shape contracts over the whole
+# tree.  Exit 0 = clean (fixed, # noqa'd, or baselined in
+# hfrep_tpu/analysis/baseline.json); exit 1 = new violations; 2 = usage.
+#
+#   tools/check.sh              # human output
+#   tools/check.sh --format json
+#
+# Also runs inside tier-1 via tests/test_analysis_self.py, so CI fails
+# on new violations even when this script isn't invoked directly.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m hfrep_tpu.analysis check \
+    hfrep_tpu tools tests bench.py bench_extra.py "$@"
