@@ -103,6 +103,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "steady state — so the trace stays loadable and "
                         "host memory bounded even for 5000-epoch runs; "
                         "the rest of the schedule trains untraced")
+    t.add_argument("--obs-dir", default=None,
+                   help="enable the hfrep_tpu.obs telemetry layer into "
+                        "this run directory: run.json manifest + "
+                        "events.jsonl (spans, metrics, memory snapshots, "
+                        "compile counts).  Summarize or diff runs with "
+                        "`python -m hfrep_tpu.obs report DIR [DIR2]`; "
+                        "HFREP_OBS_DIR=<dir> is the env equivalent")
 
     e = sub.add_parser("eval-gan", help="score a saved sample cube")
     e.add_argument("--samples", required=True, help=".npy cube, inverse-scaled returns")
@@ -134,6 +141,9 @@ def _build_parser() -> argparse.ArgumentParser:
                         "HK+GRS spanning of each HF index vs its replication")
     s.add_argument("--ff3", default="/root/reference/data/F-F_Research_Data_Factors_daily.CSV")
     s.add_argument("--ff5", default="/root/reference/data/F-F_Research_Data_5_Factors_2x3_daily.CSV")
+    s.add_argument("--obs-dir", default=None,
+                   help="enable hfrep_tpu.obs telemetry for the sweep "
+                        "(AE training/eval spans, memory snapshots)")
 
     h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
                                          "into an inverse-scaled cube (.npy)")
@@ -254,14 +264,32 @@ def cmd_train_gan(args) -> int:
     import jax
 
     if args.coordinator:
-        # multi-host: join the pod before any device/mesh use; the mesh
-        # then spans every process's devices
+        # multi-host: join the pod before any device/mesh use — including
+        # telemetry's manifest writer, whose device inventory would
+        # otherwise initialize the local backend and make
+        # jax.distributed.initialize() refuse to run
         from hfrep_tpu.parallel.mesh import initialize_distributed
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
         if not (args.sp_mesh or args.dp_sp or args.tp_mesh is not None
                 or args.dp_tp or args.dp_sp_tp):
             args.mesh = True
+    obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
+    if obs_dir and args.coordinator and jax.process_count() > 1:
+        # one run dir per process: a shared filesystem must not interleave
+        # several processes' appends into one events.jsonl
+        obs_dir = os.path.join(obs_dir, f"proc{jax.process_index()}")
+    # session() guarantees run_end + flush on the error path; enable
+    # BEFORE trainer construction — the parallel step builders'
+    # instrument_step hook decides at build time
+    import hfrep_tpu.obs as obs_pkg
+    with obs_pkg.session(obs_dir, command="train-gan", preset=args.preset):
+        return _cmd_train_gan_impl(args)
+
+
+def _cmd_train_gan_impl(args) -> int:
+    import jax
+
     trainer, ds, panel, cfg = _make_trainer(
         args.preset, args.cleaned_dir, args.checkpoint_dir, args.mesh,
         args.quiet, nan_guard=args.nan_guard,
@@ -376,6 +404,13 @@ def cmd_eval_gan(args) -> int:
 
 
 def cmd_sweep(args) -> int:
+    import hfrep_tpu.obs as obs_pkg
+    obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session(obs_dir, command="sweep", latents=args.latents):
+        return _cmd_sweep_impl(args)
+
+
+def _cmd_sweep_impl(args) -> int:
     import jax
     from hfrep_tpu.config import AEConfig
     from hfrep_tpu.core.data import load_panel
@@ -503,9 +538,19 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", platform)
     if args.cmd != "clean":            # clean is jax-free; keep startup light
         _enable_compilation_cache()
-    return {"clean": cmd_clean, "train-gan": cmd_train_gan,
-            "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
-            "sample-h5": cmd_sample_h5}[args.cmd](args)
+        if args.cmd not in ("train-gan", "sweep"):
+            # HFREP_OBS_DIR opt-in for the commands without an --obs-dir
+            # flag; train-gan/sweep manage their own lifecycle (multi-host
+            # ordering + per-process dirs + run_end on the error path)
+            from hfrep_tpu.obs import maybe_enable_from_env
+            maybe_enable_from_env()
+    try:
+        return {"clean": cmd_clean, "train-gan": cmd_train_gan,
+                "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
+                "sample-h5": cmd_sample_h5}[args.cmd](args)
+    finally:
+        from hfrep_tpu.obs import disable
+        disable()                      # no-op unless something enabled obs
 
 
 if __name__ == "__main__":

@@ -1,0 +1,9 @@
+"""``python -m hfrep_tpu.obs`` entry point (report CLI)."""
+
+from __future__ import annotations
+
+import sys
+
+from hfrep_tpu.obs.report import main
+
+sys.exit(main())

@@ -1,0 +1,109 @@
+"""Device telemetry: memory snapshots and compile accounting.
+
+Two sources, both host-side and allocation-free on the device:
+
+* :func:`memory_snapshot` — per-device ``memory_stats()`` (TPU/GPU; CPU
+  returns nothing useful) plus a ``jax.live_arrays()`` census, emitted as
+  one ``memory`` event.  ``high_water`` is the per-snapshot max of peak
+  bytes-in-use across devices, falling back to live-array bytes where the
+  allocator exposes no stats — the report's "memory high-water" column is
+  the max over these events.
+* :func:`install_compile_listener` — ``jax.monitoring`` hooks counting
+  backend compiles (``/jax/core/compile/backend_compile_duration``, also
+  summed into a total-compile-seconds gauge) and persistent-cache
+  requests/hits.  A steady-state trainer should show the compile counter
+  flat after warmup; a growing counter is a retracing bug the event
+  stream now catches (compare ADVICE.md's recompile pitfalls).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: monitoring event suffix → counter name in the obs registry
+_COMPILE_EVENTS: Dict[str, str] = {
+    "/jax/compilation_cache/cache_hits": "compile_cache_hits",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "compile_cache_requests",
+}
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: the ONE process-global forwarding listener pair.  jax.monitoring's
+#: listener lists are process-global with no public unregister API (the
+#: helpers live in ``jax._src``), so per-(obs) registration would leak a
+#: dead callback pair on every enable/disable cycle; instead the pair is
+#: registered once and forwards to whichever sink is currently active
+#: and opted in — inert otherwise.
+_FORWARDERS: dict = {}
+
+
+def memory_snapshot(obs, **attrs) -> None:
+    """Emit one ``memory`` event describing every local device now."""
+    try:
+        import jax
+        live = jax.live_arrays()
+        live_bytes = int(sum(int(getattr(a, "nbytes", 0) or 0) for a in live))
+        devices = []
+        high = 0
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            devices.append({"id": str(d),
+                            "bytes_in_use": in_use, "peak_bytes_in_use": peak})
+            high = max(high, int(peak or in_use or 0))
+        obs._emit({"type": "memory",
+                   "live_arrays": len(live), "live_bytes": live_bytes,
+                   "high_water": max(high, live_bytes) or live_bytes,
+                   "devices": devices, **attrs})
+    except Exception:                 # telemetry must never kill the run
+        pass
+
+
+def _target():
+    """The currently active sink, iff it opted into compile accounting."""
+    from hfrep_tpu.obs import get_obs
+    obs = get_obs()
+    if getattr(obs, "_wants_compile_events", False) and obs._fh is not None:
+        return obs
+    return None
+
+
+def install_compile_listener(obs) -> None:
+    """Route jax.monitoring compile events into ``obs``'s registry.
+
+    Registers the global forwarding pair on first use; later calls (and
+    :func:`remove_compile_listener`) only flip the sink's opt-in flag, so
+    the process-global listener lists hold a constant two entries no
+    matter how many enable/disable cycles a long-lived process runs."""
+    obs._wants_compile_events = True
+    if _FORWARDERS:
+        return
+    try:
+        import jax.monitoring as monitoring
+    except Exception:
+        return
+
+    def on_event(event: str, **kw) -> None:
+        name = _COMPILE_EVENTS.get(event)
+        sink = _target()
+        if name is not None and sink is not None:
+            sink.counter(name).inc()
+
+    def on_duration(event: str, duration: float, **kw) -> None:
+        sink = _target()
+        if event == _COMPILE_DURATION_EVENT and sink is not None:
+            sink.counter("backend_compiles").inc(seconds=round(duration, 4))
+            g = sink.gauge("backend_compile_secs_total")
+            g.set(round((g.value or 0.0) + duration, 4))
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+    _FORWARDERS["event"], _FORWARDERS["duration"] = on_event, on_duration
+
+
+def remove_compile_listener(obs) -> None:
+    obs._wants_compile_events = False

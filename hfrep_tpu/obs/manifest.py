@@ -1,0 +1,138 @@
+"""Run manifests: ``<run_dir>/run.json``.
+
+One JSON document per run answering "what exactly produced these
+events?" — git SHA (+dirty flag), jax/flax versions, host and device
+inventory, and (merged in later by the trainer via ``Obs.annotate``) the
+full experiment config and mesh shape.  The report CLI reads it to label
+summaries and to recompute MFU from the model shape without re-running
+anything.
+
+Kept import-light: everything device-related is gated so the manifest
+writer works (minus the device block) even where jax is absent or slow
+to initialize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import getpass
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+MANIFEST_NAME = "run.json"
+
+#: keys :func:`write_manifest` always emits (the completeness test and
+#: the report's self-test check against this list)
+REQUIRED_KEYS = ("schema_version", "run_id", "created_unix", "created",
+                 "git", "versions", "host", "devices", "argv")
+
+
+def _git_info(cwd: Optional[str] = None) -> dict:
+    def run(*args):
+        try:
+            out = subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                                 text=True, timeout=10)
+            return out.stdout.strip() if out.returncode == 0 else None
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+
+    sha = run("rev-parse", "HEAD")
+    status = run("status", "--porcelain")
+    return {"sha": sha,
+            "dirty": bool(status) if status is not None else None,
+            "branch": run("rev-parse", "--abbrev-ref", "HEAD")}
+
+
+def _versions() -> dict:
+    v = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        try:
+            v[mod] = __import__(mod).__version__
+        except Exception:
+            v[mod] = None
+    return v
+
+
+def _devices() -> dict:
+    try:
+        import jax
+        devs = jax.local_devices()
+        return {"backend": jax.default_backend(),
+                "process_index": jax.process_index(),
+                "process_count": jax.process_count(),
+                "local_device_count": len(devs),
+                "global_device_count": jax.device_count(),
+                "device_kind": devs[0].device_kind if devs else None}
+    except Exception as e:           # manifest survives a broken backend
+        return {"error": str(e)}
+
+
+def _host() -> dict:
+    try:
+        user = getpass.getuser()
+    except Exception:
+        user = None
+    return {"hostname": platform.node(), "platform": platform.platform(),
+            "user": user, "pid": os.getpid(),
+            "cwd": os.getcwd()}
+
+
+def config_dict(cfg) -> dict:
+    """An ``ExperimentConfig`` (or any dataclass / mapping) as plain data."""
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        return dataclasses.asdict(cfg)
+    if isinstance(cfg, dict):
+        return cfg
+    return {"repr": repr(cfg)}
+
+
+def write_manifest(run_dir, extra: Optional[dict] = None,
+                   repo_root: Optional[str] = None) -> Path:
+    """Write ``run.json``; returns its path.  ``extra`` merges at top
+    level (used by :func:`hfrep_tpu.obs.enable` for caller context)."""
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    now = time.time()
+    doc = {
+        "schema_version": 1,
+        "run_id": run_dir.name,
+        "created_unix": now,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z", time.localtime(now)),
+        "git": _git_info(repo_root or os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))),
+        "versions": _versions(),
+        "host": _host(),
+        "devices": _devices(),
+        "argv": list(sys.argv),
+    }
+    if extra:
+        doc.update(extra)
+    path = run_dir / MANIFEST_NAME
+    path.write_text(json.dumps(doc, indent=2, default=str) + "\n")
+    return path
+
+
+def annotate(run_dir, fields: dict) -> None:
+    """Merge fields into an existing ``run.json`` (write one if absent —
+    annotation must not be order-coupled to :func:`write_manifest`)."""
+    path = Path(run_dir) / MANIFEST_NAME
+    try:
+        doc = json.loads(path.read_text()) if path.exists() else {}
+    except (OSError, json.JSONDecodeError):
+        doc = {}
+    doc.update(fields)
+    try:
+        path.write_text(json.dumps(doc, indent=2, default=str) + "\n")
+    except OSError:
+        pass
+
+
+def read_manifest(run_dir) -> dict:
+    path = Path(run_dir) / MANIFEST_NAME
+    return json.loads(path.read_text())

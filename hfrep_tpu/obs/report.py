@@ -1,0 +1,359 @@
+"""``python -m hfrep_tpu.obs report`` — summarize or diff run directories.
+
+Input is what the telemetry layer writes: ``run.json`` (manifest) and
+``events.jsonl`` (span / metric / memory / event stream).  The headline
+numbers mirror BASELINE.json's vocabulary so bench trajectories become
+machine-diffable:
+
+* ``steps/sec`` — steady-state rate from ``block`` spans (warmup spans,
+  which carry XLA compile time, excluded whenever steady ones exist);
+* ``p50/p95 step time`` — steps-weighted percentiles of per-epoch time
+  across block spans;
+* ``MFU`` — recomputed from the manifest's model shape via
+  :mod:`hfrep_tpu.obs.flops` (falls back to an ``mfu`` gauge if the
+  manifest lacks a config);
+* ``memory high-water`` — max over ``memory`` events;
+* compile accounting — backend compiles and total compile seconds.
+
+Diff mode takes two run dirs and prints both columns plus the ratio —
+``report A B`` answers "did this PR move steps/sec or memory?" without
+eyeballing two JSONL files.  Everything here is stdlib-only (no jax
+import), so the CLI is instant and runs in tier-1 via ``--self-test``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from hfrep_tpu.obs import EVENT_TYPES, SCHEMA_VERSION
+
+EVENTS_NAME = "events.jsonl"
+
+#: per-type required fields, beyond the common ``v``/``t``/``type``
+_REQUIRED_FIELDS = {
+    "span": ("name", "dur", "depth"),
+    "metric": ("kind", "name", "value"),
+    "memory": ("high_water",),
+    "event": ("name",),
+}
+
+
+class SchemaError(ValueError):
+    """An event line failed schema validation."""
+
+
+def parse_event(line: str, lineno: int = 0) -> Optional[dict]:
+    """Parse + validate one JSONL line; blank lines return None."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise SchemaError(f"line {lineno}: not JSON ({e})") from e
+    if not isinstance(rec, dict):
+        raise SchemaError(f"line {lineno}: event must be an object")
+    if rec.get("v") != SCHEMA_VERSION:
+        raise SchemaError(f"line {lineno}: schema version {rec.get('v')!r}, "
+                          f"expected {SCHEMA_VERSION}")
+    etype = rec.get("type")
+    if etype not in EVENT_TYPES:
+        raise SchemaError(f"line {lineno}: unknown event type {etype!r}")
+    if not isinstance(rec.get("t"), (int, float)):
+        raise SchemaError(f"line {lineno}: missing/invalid timestamp 't'")
+    for field in _REQUIRED_FIELDS[etype]:
+        if field not in rec:
+            raise SchemaError(
+                f"line {lineno}: {etype} event missing {field!r}")
+    return rec
+
+
+def load_events(run_dir, strict: bool = False) -> List[dict]:
+    """Parse + validate ``events.jsonl``.
+
+    The writer buffers (flushing every N events), so a run killed
+    mid-write — OOM kill, SIGKILL — leaves a torn final line.  Those are
+    exactly the runs whose telemetry must stay readable, so a final line
+    that is missing its newline and fails to parse is dropped with a
+    warning instead of failing the whole report.  Anything else — garbage
+    mid-file, schema drift on a complete line — still raises
+    :class:`SchemaError`; ``strict=True`` raises for the torn tail too
+    (the self-test uses it: the committed fixture must be whole).
+    """
+    path = Path(run_dir) / EVENTS_NAME
+    events = []
+    with open(path) as fh:
+        lines = fh.readlines()
+    for i, line in enumerate(lines, 1):
+        try:
+            rec = parse_event(line, i)
+        except SchemaError:
+            if not strict and i == len(lines) and not line.endswith("\n"):
+                print(f"warning: {path}: dropped torn final line {i} "
+                      "(run was likely killed mid-write)", file=sys.stderr)
+                break
+            raise
+        if rec is not None:
+            events.append(rec)
+    return events
+
+
+def _weighted_percentile(pairs: List[Tuple[float, float]], q: float) -> float:
+    """Nearest-rank percentile of (value, weight) pairs."""
+    if not pairs:
+        return float("nan")
+    pairs = sorted(pairs)
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        return float("nan")
+    acc = 0.0
+    for v, w in pairs:
+        acc += w
+        if acc >= q * total:
+            return v
+    return pairs[-1][0]
+
+
+def summarize(run_dir) -> dict:
+    """One run directory -> headline summary dict (all JSON-safe)."""
+    run_dir = Path(run_dir)
+    try:
+        from hfrep_tpu.obs.manifest import read_manifest
+        manifest = read_manifest(run_dir)
+    except (OSError, json.JSONDecodeError):
+        manifest = {}
+    events = load_events(run_dir)
+
+    counts: Dict[str, int] = {}
+    blocks: List[dict] = []
+    gauges: Dict[str, float] = {}
+    counters: Dict[str, float] = {}
+    high_water = 0
+    compile_spans = 0.0
+    for rec in events:
+        counts[rec["type"]] = counts.get(rec["type"], 0) + 1
+        if rec["type"] == "span":
+            if rec["name"] == "block" and rec.get("steps"):
+                blocks.append(rec)
+            elif str(rec["name"]).startswith("compile:"):
+                compile_spans += float(rec["dur"])
+        elif rec["type"] == "metric":
+            if rec["kind"] == "gauge":
+                gauges[rec["name"]] = rec["value"]
+            elif rec["kind"] == "counter":
+                counters[rec["name"]] = rec["value"]
+        elif rec["type"] == "memory":
+            high_water = max(high_water, int(rec.get("high_water") or 0))
+
+    steady = [b for b in blocks if not b.get("warmup")]
+    used = steady or blocks
+    steps = sum(float(b["steps"]) for b in used)
+    secs = sum(float(b["dur"]) for b in used)
+    steps_per_sec = steps / secs if secs > 0 else float("nan")
+    per_step = [(float(b["dur"]) / float(b["steps"]), float(b["steps"]))
+                for b in used if float(b["steps"]) > 0]
+    p50 = _weighted_percentile(per_step, 0.50)
+    p95 = _weighted_percentile(per_step, 0.95)
+
+    mfu_val = float("nan")
+    model = (manifest.get("config") or {}).get("model") or {}
+    train = (manifest.get("config") or {}).get("train") or {}
+    if (model.get("family") == "mtss_wgan_gp" and model.get("window")
+            and model.get("features")):
+        # the analytic FLOPs model is flagship-only (trainer.py gates its
+        # mfu gauge the same way): other families' epoch structure differs,
+        # so recomputing would print a confidently wrong number
+        from hfrep_tpu.obs import flops
+        mfu_val = flops.mfu(steps_per_sec, int(model["window"]),
+                            int(model["features"]),
+                            int(model.get("hidden") or flops.H),
+                            int(train.get("batch_size") or flops.B))
+    elif isinstance(gauges.get("mfu"), (int, float)):
+        mfu_val = float(gauges["mfu"])
+
+    return {
+        "run_dir": str(run_dir),
+        "run_id": manifest.get("run_id") or run_dir.name,
+        "git_sha": (manifest.get("git") or {}).get("sha"),
+        "backend": (manifest.get("devices") or {}).get("backend"),
+        "n_events": len(events),
+        "event_counts": counts,
+        "blocks": {"n": len(blocks), "steady": len(steady),
+                   "warmup": len(blocks) - len(steady)},
+        "steps": steps,
+        "steps_per_sec": steps_per_sec,
+        "step_time_p50_s": p50,
+        "step_time_p95_s": p95,
+        "mfu": mfu_val,
+        "memory_high_water_bytes": high_water,
+        "backend_compiles": counters.get("backend_compiles"),
+        "compile_secs": (gauges.get("backend_compile_secs_total")
+                         or compile_spans or None),
+        "gauges": gauges,
+        "counters": counters,
+    }
+
+
+# ------------------------------------------------------------- rendering
+def _fmt(v, unit="") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        if unit == "%":
+            return f"{v * 100:.2f}%"
+        if unit == "s":
+            return f"{v * 1e3:.3f} ms" if v < 1 else f"{v:.3f} s"
+        if unit == "B":
+            return _fmt_bytes(v)
+        return f"{v:.2f}"
+    if unit == "B":
+        return _fmt_bytes(v)
+    return str(v)
+
+
+def _fmt_bytes(v) -> str:
+    v = float(v)
+    for suffix in ("B", "KiB", "MiB", "GiB"):
+        if v < 1024 or suffix == "GiB":
+            return f"{v:.1f} {suffix}" if suffix != "B" else f"{int(v)} B"
+        v /= 1024
+    return f"{v:.1f} GiB"
+
+
+_ROWS = (
+    ("events", "n_events", ""),
+    ("steady blocks", None, ""),
+    ("steps", "steps", ""),
+    ("steps/sec", "steps_per_sec", ""),
+    ("p50 step time", "step_time_p50_s", "s"),
+    ("p95 step time", "step_time_p95_s", "s"),
+    ("MFU (bf16 peak)", "mfu", "%"),
+    ("memory high-water", "memory_high_water_bytes", "B"),
+    ("backend compiles", "backend_compiles", ""),
+    ("compile secs", "compile_secs", ""),
+)
+
+
+def _row_value(s: dict, key: Optional[str]):
+    if key is None:
+        return f"{s['blocks']['steady']}/{s['blocks']['n']}"
+    return s.get(key)
+
+
+def render(s: dict) -> str:
+    lines = [f"run {s['run_id']}  (backend={s['backend'] or '?'}, "
+             f"git={str(s['git_sha'])[:10]})"]
+    for label, key, unit in _ROWS:
+        v = _row_value(s, key)
+        lines.append(f"  {label:18s} {v if key is None else _fmt(v, unit)}")
+    return "\n".join(lines)
+
+
+def render_diff(a: dict, b: dict) -> str:
+    lines = [f"{'':20s} {a['run_id'][:22]:>22s} {b['run_id'][:22]:>22s} "
+             f"{'ratio':>8s}"]
+    for label, key, unit in _ROWS:
+        va, vb = _row_value(a, key), _row_value(b, key)
+        ratio = ""
+        if (key is not None and isinstance(va, (int, float))
+                and isinstance(vb, (int, float)) and not isinstance(va, bool)):
+            fa, fb = float(va), float(vb)
+            if fa and not math.isnan(fa) and not math.isnan(fb):
+                ratio = f"{fb / fa:7.2f}x"
+        sa = str(va) if key is None else _fmt(va, unit)
+        sb = str(vb) if key is None else _fmt(vb, unit)
+        lines.append(f"{label:20s} {sa:>22s} {sb:>22s} {ratio:>8s}")
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------- self-test
+def fixture_dir() -> Path:
+    """The committed fixture run directory the tier-1 gate parses."""
+    return Path(__file__).resolve().parent / "_fixture"
+
+
+def self_test() -> int:
+    """Exercise the event-schema parser + summary on the fixture run.
+
+    Returns 0 on success; prints and returns 1 on any mismatch — wired
+    into ``tools/check.sh`` so a schema drift (writer and parser
+    disagreeing) fails tier-1 before it corrupts a real run's telemetry.
+    """
+    from hfrep_tpu.obs.manifest import REQUIRED_KEYS, read_manifest
+    fx = fixture_dir()
+    try:
+        manifest = read_manifest(fx)
+        missing = [k for k in REQUIRED_KEYS if k not in manifest]
+        if missing:
+            raise SchemaError(f"fixture manifest missing keys: {missing}")
+        events = load_events(fx, strict=True)   # validates every line
+        if not events:
+            raise SchemaError("fixture events.jsonl is empty")
+        present = {e["type"] for e in events}
+        need = {"span", "metric", "memory"}
+        if not need <= present:
+            raise SchemaError(f"fixture lacks event types {need - present}")
+        s = summarize(fx)
+        for key in ("steps_per_sec", "step_time_p50_s", "step_time_p95_s",
+                    "mfu"):
+            v = s[key]
+            if not isinstance(v, float) or math.isnan(v):
+                raise SchemaError(f"fixture summary {key} = {v!r}")
+        if not s["memory_high_water_bytes"] > 0:
+            raise SchemaError("fixture summary has no memory high-water")
+    except (OSError, json.JSONDecodeError, SchemaError, KeyError) as e:
+        print(f"obs self-test FAILED: {e}", file=sys.stderr)
+        return 1
+    print(f"obs self-test OK ({s['n_events']} events, "
+          f"{s['steps_per_sec']:.1f} steps/s, mfu {s['mfu'] * 100:.2f}%)")
+    return 0
+
+
+# -------------------------------------------------------------------- CLI
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m hfrep_tpu.obs",
+        description="summarize / diff telemetry run directories")
+    sub = p.add_subparsers(dest="command", required=True)
+    r = sub.add_parser("report", help="summarize one run dir or diff two")
+    r.add_argument("run_dirs", nargs="*", help="1 run dir (summary) or "
+                                               "2 (diff: second vs first)")
+    r.add_argument("--format", choices=("human", "json"), default="human")
+    r.add_argument("--self-test", action="store_true",
+                   help="validate the committed fixture run dir (CI gate)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not 1 <= len(args.run_dirs) <= 2:
+        print("report wants 1 run dir (summary) or 2 (diff)", file=sys.stderr)
+        return 2
+    try:
+        summaries = [summarize(d) for d in args.run_dirs]
+    except (OSError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        doc = summaries[0] if len(summaries) == 1 else {
+            "base": summaries[0], "other": summaries[1]}
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    if len(summaries) == 1:
+        print(render(summaries[0]))
+    else:
+        print(render_diff(summaries[0], summaries[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,7 +149,20 @@ def make_dp_sp_train_step(pair: GanPair, tcfg: TrainConfig,
     (the dp trajectory-test pattern, composed with window sharding).
     """
     inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    return _wrap(inner, mesh, controlled_sampling, jit)
+    return _instrument(_wrap(inner, mesh, controlled_sampling, jit),
+                       "dp_sp_train_step", mesh, tcfg, jit)
+
+
+def _instrument(fn, name: str, mesh: Mesh, tcfg: TrainConfig, jit: bool):
+    """The launch paths' telemetry hook: build-time no-op (``fn``
+    returned unchanged) when obs is disabled or the caller asked for the
+    raw un-jitted step (composition builds must stay wrappable)."""
+    if not jit:
+        return fn
+    from hfrep_tpu.obs import instrument_step
+    return instrument_step(fn, name, mesh=mesh, batch=tcfg.batch_size,
+                           sp_microbatches=tcfg.sp_microbatches,
+                           sp_remat=tcfg.sp_remat)
 
 
 def make_dp_sp_multi_step(pair: GanPair, tcfg: TrainConfig,
@@ -164,4 +177,5 @@ def make_dp_sp_multi_step(pair: GanPair, tcfg: TrainConfig,
 
     step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _wrap(inner, mesh, controlled_sampling, jit)
+    return _instrument(_wrap(inner, mesh, controlled_sampling, jit),
+                       "dp_sp_multi_step", mesh, tcfg, jit)

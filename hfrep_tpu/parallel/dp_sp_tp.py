@@ -49,7 +49,7 @@ from jax.sharding import Mesh
 
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.parallel.dp_sp import _make_inner, _wrap
+from hfrep_tpu.parallel.dp_sp import _instrument, _make_inner, _wrap
 
 
 def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
@@ -69,7 +69,9 @@ def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
     """
     inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
                         tp_axis="tp")
-    return _wrap(inner, mesh, controlled_sampling, jit, tp_axis="tp")
+    return _instrument(_wrap(inner, mesh, controlled_sampling, jit,
+                             tp_axis="tp"),
+                       "dp_sp_tp_train_step", mesh, tcfg, jit)
 
 
 def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
@@ -84,4 +86,6 @@ def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
     step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
                        tp_axis="tp")
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _wrap(inner, mesh, controlled_sampling, jit, tp_axis="tp")
+    return _instrument(_wrap(inner, mesh, controlled_sampling, jit,
+                             tp_axis="tp"),
+                       "dp_sp_tp_multi_step", mesh, tcfg, jit)

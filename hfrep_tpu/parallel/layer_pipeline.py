@@ -285,4 +285,10 @@ def make_pp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     d_apply = lambda p, x: pp_critic(p, x, mesh, axis_name=axis_name,
                                      microbatches=microbatches)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
-    return _jit_replicated_out(step, mesh) if jit else step
+    if not jit:
+        return step
+    # telemetry hook — build-time no-op unless hfrep_tpu.obs is enabled
+    from hfrep_tpu.obs import instrument_step
+    return instrument_step(_jit_replicated_out(step, mesh),
+                           "pp_train_step", mesh=mesh,
+                           batch=tcfg.batch_size, microbatches=m_eff)

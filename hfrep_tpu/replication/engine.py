@@ -289,10 +289,19 @@ class ReplicationEngine:
 
     # ------------------------------------------------------------ training
     def train(self, key: Optional[jax.Array] = None) -> AEResult:
+        from hfrep_tpu.obs import get_obs
+        obs = get_obs()
         key = key if key is not None else jax.random.PRNGKey(self.cfg.seed)
         if self._train_fn is None:
             self._train_fn = jax.jit(lambda k: train_autoencoder(k, self.x_train, self.cfg))
-        self.result = self._train_fn(key)
+        with obs.span("ae_train", latent_dim=self.cfg.latent_dim,
+                      epochs=self.cfg.epochs):
+            self.result = self._train_fn(key)
+            if obs.enabled:        # time the scan, not its async dispatch
+                jax.block_until_ready(self.result.params)
+        if obs.enabled:
+            obs.counter("ae_trainings").inc()
+            obs.gauge("ae_stop_epoch").set(int(self.result.stop_epoch))
         self.mask = None            # full-latent model: drop any use_params() mask
         self._invalidate()
         return self.result
@@ -341,12 +350,14 @@ class ReplicationEngine:
         are traced arguments (not baked constants) so the program survives
         retraining / param swaps."""
         if self._oos_cache is None:
+            from hfrep_tpu.obs import get_obs
             if self._oos_eval_fn is None:
                 self._oos_eval_fn = jax.jit(
                     lambda p, m: oos_prefix_metrics(self.model, self.x_test, p, m))
             mask = self.mask if self.mask is not None else jnp.ones(
                 (self.params["encoder_kernel"].shape[1],), jnp.float32)
-            self._oos_cache = self._oos_eval_fn(self.params, mask)
+            with get_obs().span("ae_oos_eval"):
+                self._oos_cache = self._oos_eval_fn(self.params, mask)
         return self._oos_cache
 
     def model_OOS_r2(self) -> np.ndarray:
@@ -366,10 +377,12 @@ class ReplicationEngine:
         ``beta_mode='rolling'`` uses each window's own beta.  Body shared
         with the vmapped sweep path via :func:`ante_weights`.
         """
+        from hfrep_tpu.obs import get_obs
         window = window or self.cfg.ols_window
-        ante, weights = ante_weights(self.model, self.cfg, self.params,
-                                     self.mask, self.x_test, self.y_test,
-                                     jnp.asarray(rf, jnp.float32), window)
+        with get_obs().span("ae_ante", window=int(window)):
+            ante, weights = ante_weights(self.model, self.cfg, self.params,
+                                         self.mask, self.x_test, self.y_test,
+                                         jnp.asarray(rf, jnp.float32), window)
         p = weights.shape[0]
         self._strat_weights = weights
         self._ante = ante

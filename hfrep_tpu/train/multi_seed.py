@@ -184,22 +184,37 @@ class MultiSeedTrainer:
         return ks[:, 1]
 
     def train(self, epochs: Optional[int] = None):
+        from hfrep_tpu.obs import get_obs, mesh_attrs
+        obs = get_obs()
         spc = self.cfg.train.steps_per_call
         epochs = epochs if epochs is not None else self.cfg.train.epochs
         n_full, remainder = divmod(epochs, spc)
-        for _ in range(n_full):
-            self.states, _ = self._multi(self.states, self._split_keys())
-            self.epoch += spc
-        if remainder:
-            if self._one is None:
-                step = make_train_step(self.pair, self.cfg.train, self.windows)
-                if self.mesh is not None:
-                    self._one = _seed_shard(step, self.mesh)
-                else:
-                    self._one = jax.jit(jax.vmap(step), donate_argnums=(0,))
-            for _ in range(remainder):
-                self.states, _ = self._one(self.states, self._split_keys())
-                self.epoch += 1
+        if obs.enabled:
+            obs.event("multi_seed_train_start", members=self.n_seeds,
+                      epochs=epochs, mesh=mesh_attrs(self.mesh),
+                      mode="seed_sharded" if self.mesh is not None else "vmap")
+        blocks = obs.counter("multi_seed_blocks")    # no-op when disabled
+        with obs.span("multi_seed_train", members=self.n_seeds, epochs=epochs):
+            for _ in range(n_full):
+                self.states, _ = self._multi(self.states, self._split_keys())
+                self.epoch += spc
+                blocks.inc(member_epochs=self.n_seeds * spc)
+            if remainder:
+                if self._one is None:
+                    step = make_train_step(self.pair, self.cfg.train, self.windows)
+                    if self.mesh is not None:
+                        self._one = _seed_shard(step, self.mesh)
+                    else:
+                        self._one = jax.jit(jax.vmap(step), donate_argnums=(0,))
+                for _ in range(remainder):
+                    self.states, _ = self._one(self.states, self._split_keys())
+                    self.epoch += 1
+            if obs.enabled:
+                # sync before the span closes so it times compute, not the
+                # async dispatches the loop queued
+                jax.block_until_ready(self.states.g_params)
+        if obs.enabled:
+            obs.memory_snapshot(phase="multi_seed_train_end")
         return self.states
 
     def generate(self, key: jax.Array, n_samples: int,

@@ -19,6 +19,7 @@ import numpy as np
 from hfrep_tpu.config import ExperimentConfig
 from hfrep_tpu.core.data import GanDataset
 from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.obs import get_obs, mesh_attrs
 from hfrep_tpu.train.states import GanState, init_gan_state
 from hfrep_tpu.train.steps import make_multi_step
 from hfrep_tpu.utils import checkpoint as ckpt
@@ -105,6 +106,37 @@ class GanTrainer:
 
     # ------------------------------------------------------------ training
     def train(self, epochs: Optional[int] = None) -> GanState:
+        """Run the schedule; when ``hfrep_tpu.obs`` telemetry is enabled,
+        the whole run is wrapped in a ``train`` span with the trainer's
+        config/mesh merged into the run manifest.  The jitted programs
+        are identical either way — telemetry is host-side only."""
+        obs = get_obs()
+        if not obs.enabled:
+            return self._train_impl(epochs)
+        from hfrep_tpu.obs import manifest
+        obs.annotate(config=manifest.config_dict(self.cfg),
+                     mesh=mesh_attrs(self.mesh))
+        n = epochs if epochs is not None else self.cfg.train.epochs
+        obs.event("train_start", family=self.cfg.model.family, epochs=n,
+                  start_epoch=self.epoch, mesh=mesh_attrs(self.mesh),
+                  steps_per_call=self.cfg.train.steps_per_call)
+        obs.memory_snapshot(phase="train_start")
+        with obs.span("train", epochs=n):
+            state = self._train_impl(epochs)
+        obs.memory_snapshot(phase="train_end")
+        sps = self.timer.steps_per_sec
+        obs.gauge("steps_per_sec").set(sps)
+        if self.cfg.model.family == "mtss_wgan_gp":
+            # the analytic FLOPs model is flagship-specific (obs/flops.py)
+            from hfrep_tpu.obs import flops
+            obs.gauge("mfu").set(flops.mfu(
+                sps, self.cfg.model.window, self.cfg.model.features,
+                self.cfg.model.hidden, self.cfg.train.batch_size))
+        obs.event("train_end", epoch=self.epoch, recoveries=self.recoveries)
+        obs.flush()
+        return state
+
+    def _train_impl(self, epochs: Optional[int] = None) -> GanState:
         tcfg = self.cfg.train
         spc = tcfg.steps_per_call
         epochs = epochs if epochs is not None else tcfg.epochs
@@ -308,9 +340,12 @@ class GanTrainer:
         multihost = self._multihost()
         if multihost and jax.process_index() != 0:
             return path
-        ckpt.save(path, self._ckpt_tree(),
-                  metadata={"family": self.cfg.model.family, "epoch": self.epoch},
-                  coordination_free=multihost)
+        obs = get_obs()
+        with obs.span("checkpoint", epoch=self.epoch, path=str(path)):
+            ckpt.save(path, self._ckpt_tree(),
+                      metadata={"family": self.cfg.model.family, "epoch": self.epoch},
+                      coordination_free=multihost)
+        obs.counter("checkpoints").inc()
         return path
 
     def restore_checkpoint(self, path: Optional[str] = None) -> None:
@@ -350,7 +385,11 @@ class GanTrainer:
             be = resolve_lstm_backend(self.cfg.train.lstm_backend)
             self._generate_fn = jax.jit(
                 lambda p, z: self.pair.generator.apply({"params": p}, z, backend=be))
-        out = self._generate_fn(self.state.g_params, noise)
+        obs = get_obs()
+        with obs.span("generate", n_samples=int(n_samples), synced=obs.enabled):
+            out = self._generate_fn(self.state.g_params, noise)
+            if obs.enabled:      # sync inside the span: time compute, not dispatch
+                jax.block_until_ready(out)
         if unscale and self.scaler is not None:
             from hfrep_tpu.core import scaler as mm
             out = mm.inverse_transform(self.scaler, out)

@@ -1,4 +1,4 @@
-"""Structured metric logging.
+"""Structured metric logging (compatibility shim over ``hfrep_tpu.obs``).
 
 The reference's observability is ``print`` statements in the epoch loop
 (``GAN/MTSS_WGAN_GP.py:284``) — including the WGAN quirk of printing
@@ -6,6 +6,12 @@ The reference's observability is ``print`` statements in the epoch loop
 (SURVEY §5.5).  Here metrics stream to JSONL (and optionally CSV) with a
 console formatter that can reproduce the reference's exact print lines
 for eyeball comparison.
+
+Since the ``hfrep_tpu.obs`` layer landed, :class:`MetricLogger` is a thin
+shim: its per-run JSONL file and console echo are unchanged, and every
+``log()`` additionally forwards into the active obs event stream (gauge
+metrics named ``train/<key>``) when telemetry is enabled — one logging
+call site, two sinks, zero cost when obs is off.
 """
 
 from __future__ import annotations
@@ -16,6 +22,8 @@ from pathlib import Path
 from typing import IO, Mapping, Optional
 
 import numpy as np
+
+from hfrep_tpu.obs import get_obs
 
 
 def _to_py(v):
@@ -49,6 +57,11 @@ class MetricLogger:
         rec.update({k: _to_py(v) for k, v in metrics.items()})
         if self._fh:
             self._fh.write(json.dumps(rec) + "\n")
+        obs = get_obs()
+        if obs.enabled:
+            for k, v in rec.items():
+                if k not in ("step", "t") and isinstance(v, (int, float)):
+                    obs.gauge(f"train/{k}").set(v, step=int(step))
         if self.echo:
             print(self.format_line(step, rec))
 
@@ -67,6 +80,15 @@ class MetricLogger:
             self._fh.flush()
 
     def close(self) -> None:
-        if self._fh:
+        """Idempotent — a sweep's error path may close an already-closed
+        logger (and ``__exit__`` always will after an explicit close)."""
+        if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # file handles must not leak when a sweep raises mid-run
+        self.close()

@@ -1,7 +1,12 @@
-"""Step timing and profiler hooks (SURVEY §5.1 — absent in the reference).
+"""Step timing and profiler hooks (SURVEY §5.1 — absent in the reference;
+compatibility shim over ``hfrep_tpu.obs`` since the telemetry layer).
 
 `StepTimer` measures device-synchronized wall time around jitted calls
-and reports steps/sec — BASELINE.json's primary runtime metric.
+and reports steps/sec — BASELINE.json's primary runtime metric.  Every
+``stop()`` now also lands in the active obs event stream as a ``block``
+span (with ``steps``/``warmup`` attributes) and a ``step_time``
+histogram sample, so the trainer's existing timing discipline feeds the
+unified telemetry without a second set of call sites.
 `trace` wraps `jax.profiler.trace` for on-demand XLA profiles.
 """
 
@@ -12,6 +17,8 @@ import time
 from typing import List, Optional
 
 import jax
+
+from hfrep_tpu.obs import get_obs
 
 
 class StepTimer:
@@ -33,16 +40,29 @@ class StepTimer:
             jax.block_until_ready(sync_on)
         dt = time.perf_counter() - self._t0
         self.samples.append((n_steps, dt, warmup))
+        obs = get_obs()
+        if obs.enabled:
+            obs.record_span("block", dt, steps=int(n_steps),
+                            warmup=bool(warmup), synced=sync_on is not None)
+            if n_steps > 0:
+                obs.histogram("step_time").observe(dt / n_steps,
+                                                   warmup=bool(warmup))
         return dt
 
     @property
     def steps_per_sec(self) -> float:
-        """Steady-state rate (warmup samples excluded when possible)."""
+        """Steady-state rate (warmup samples excluded when possible).
+
+        Guarded against zero-duration windows: on a fast-enough host a
+        warmup-only sample set can carry ``dt == 0`` at perf_counter
+        resolution — the rate is then undefined, not infinite, so this
+        returns ``nan`` rather than dividing by zero.
+        """
         steady = [(n, t) for n, t, w in self.samples if not w]
         samples = steady or [(n, t) for n, t, _ in self.samples]
         steps = sum(n for n, _ in samples)
         secs = sum(t for _, t in samples)
-        return steps / secs if secs else float("nan")
+        return steps / secs if secs > 0.0 else float("nan")
 
     def reset(self) -> None:
         self.samples.clear()

@@ -1,0 +1,523 @@
+"""hfrep_tpu.obs: spans, metrics, manifests, device telemetry, report CLI,
+and the disabled-mode zero-overhead contract (ISSUE 2 acceptance)."""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.obs import NULL, get_obs, instrument_step, mesh_attrs
+from hfrep_tpu.obs import report as report_mod
+from hfrep_tpu.obs.manifest import (REQUIRED_KEYS, read_manifest,
+                                    write_manifest)
+from hfrep_tpu.train.trainer import GanTrainer
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+MCFG = ModelConfig(family="gan", features=5, window=8, hidden=8)
+TCFG = TrainConfig(epochs=3, batch_size=4, n_critic=2, steps_per_call=2,
+                   log_every=1)
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """No test may leak an enabled sink into the rest of the suite."""
+    obs_pkg.disable()
+    yield
+    obs_pkg.disable()
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(7)
+    return jnp.asarray(g.uniform(0, 1, (32, 8, 5)).astype(np.float32))
+
+
+def _events(run_dir):
+    return report_mod.load_events(run_dir)
+
+
+# ----------------------------------------------------------------- spans
+def test_span_nesting_and_timing(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    with obs.span("outer", tag="a"):
+        with obs.span("inner"):
+            pass
+        obs.record_span("premeasured", 0.25, steps=5)
+    obs_pkg.disable()
+
+    spans = {e["name"]: e for e in _events(tmp_path / "run")
+             if e["type"] == "span"}
+    assert spans["inner"]["parent"] == "outer"
+    assert spans["inner"]["depth"] == 1
+    assert spans["outer"]["parent"] is None
+    assert spans["outer"]["depth"] == 0
+    assert spans["outer"]["tag"] == "a"
+    # children close before parents, and nest inside the parent's time
+    assert spans["inner"]["dur"] <= spans["outer"]["dur"]
+    assert spans["premeasured"]["dur"] == 0.25
+    assert spans["premeasured"]["parent"] == "outer"
+
+
+def test_span_sync_on_device_array(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    x = jnp.ones((4, 4))
+    with obs.span("synced_work", sync_on=x):
+        y = x @ x
+    obs_pkg.disable()
+    (span,) = [e for e in _events(tmp_path / "run") if e["type"] == "span"]
+    assert span["synced"] is True
+    assert span["dur"] >= 0
+    del y
+
+
+# --------------------------------------------------------------- metrics
+def test_metrics_registry_roundtrip_through_jsonl(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    obs.counter("retries").inc()
+    obs.counter("retries").inc(3)
+    obs.gauge("steps_per_sec").set(55.5)
+    for v in (0.1, 0.2, 0.3):
+        obs.histogram("step_time").observe(v)
+    summary = obs.summary()
+    obs_pkg.disable()
+
+    metrics = [e for e in _events(tmp_path / "run") if e["type"] == "metric"]
+    counters = [e for e in metrics if e["kind"] == "counter"]
+    assert [c["value"] for c in counters] == [1, 4]      # running total
+    gauges = [e for e in metrics if e["kind"] == "gauge"]
+    assert gauges[-1]["name"] == "steps_per_sec"
+    assert gauges[-1]["value"] == 55.5
+    hist = [e["value"] for e in metrics if e["kind"] == "histogram"]
+    assert hist == [0.1, 0.2, 0.3]
+    # in-memory summary agrees with what went over the wire
+    assert summary["counters"]["retries"] == 4
+    assert summary["gauges"]["steps_per_sec"] == 55.5
+    assert summary["histograms"]["step_time"]["n"] == 3
+    # run_end event carries the same summary
+    end = [e for e in _events(tmp_path / "run")
+           if e["type"] == "event" and e["name"] == "run_end"]
+    assert end and end[0]["summary"]["counters"]["retries"] == 4
+
+
+# -------------------------------------------------------------- manifest
+def test_manifest_completeness(tmp_path):
+    write_manifest(tmp_path, extra={"command": "test"})
+    doc = read_manifest(tmp_path)
+    for key in REQUIRED_KEYS:
+        assert key in doc, f"manifest missing {key}"
+    assert doc["versions"]["jax"] == jax.__version__
+    assert doc["versions"]["python"].count(".") >= 1
+    assert doc["devices"]["backend"] == "cpu"
+    assert doc["devices"]["local_device_count"] == len(jax.local_devices())
+    assert doc["git"]["sha"] is None or len(doc["git"]["sha"]) == 40
+    assert doc["command"] == "test"
+
+
+def test_annotate_merges_into_manifest(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", compile_listener=False)
+    obs.annotate(config={"model": {"window": 8}}, mesh={"dp": 2})
+    obs_pkg.disable()
+    doc = read_manifest(tmp_path / "run")
+    assert doc["config"]["model"]["window"] == 8
+    assert doc["mesh"] == {"dp": 2}
+    assert doc["run_id"] == "run"        # original fields survive the merge
+
+
+def test_mesh_attrs():
+    from jax.sharding import Mesh
+    assert mesh_attrs(None) is None
+    n = min(2, len(jax.devices()))
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("dp",))
+    assert mesh_attrs(mesh) == {"dp": n}
+
+
+# ------------------------------------------------------ device telemetry
+def test_memory_snapshot_counts_live_arrays(tmp_path):
+    keep = jnp.ones((64, 64), jnp.float32)     # ≥16 KiB live on device
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    obs.memory_snapshot(phase="test")
+    obs_pkg.disable()
+    (mem,) = [e for e in _events(tmp_path / "run") if e["type"] == "memory"]
+    assert mem["phase"] == "test"
+    assert mem["live_arrays"] >= 1
+    assert mem["live_bytes"] >= keep.nbytes
+    assert mem["high_water"] >= keep.nbytes
+    assert len(mem["devices"]) == len(jax.local_devices())
+
+
+def test_compile_listener_counts_backend_compiles(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False)
+    jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))     # fresh shape => compile
+    obs_pkg.disable()
+    counters = [e for e in _events(tmp_path / "run")
+                if e["type"] == "metric" and e["kind"] == "counter"
+                and e["name"] == "backend_compiles"]
+    assert counters, "no backend compile recorded"
+    # after disable() the listener is disarmed: no crash, no new events
+    n = len(_events(tmp_path / "run"))
+    jax.jit(lambda x: x - 11)(jnp.arange(9))
+    assert len(_events(tmp_path / "run")) == n
+
+
+def test_session_context_manager_lifecycle(tmp_path, capsys):
+    """session() is THE lifecycle for CLIs/bench probes: falsy dir yields
+    the NULL sink; a raising body still gets run_end + close."""
+    with obs_pkg.session(None) as obs:
+        assert obs is NULL
+    assert not capsys.readouterr().out        # no hint when disabled
+
+    with pytest.raises(RuntimeError):
+        with obs_pkg.session(tmp_path / "run", command="t") as obs:
+            obs.counter("work").inc()
+            raise RuntimeError("mid-run crash")
+    assert not obs_pkg.is_enabled()
+    assert "telemetry:" in capsys.readouterr().out
+    end = [e for e in _events(tmp_path / "run")
+           if e["type"] == "event" and e["name"] == "run_end"]
+    assert end and end[0]["summary"]["counters"]["work"] == 1
+
+
+def test_summary_p95_nearest_rank(tmp_path):
+    """int(n*0.95) overshoots when 0.95n is whole — n=20 must report the
+    19th value (nearest-rank p95), not the max."""
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    for v in range(1, 21):                     # 1..20
+        obs.histogram("t").observe(float(v))
+    s = obs.summary()["histograms"]["t"]
+    obs_pkg.disable()
+    assert s["p95"] == 19.0
+    assert s["max"] == 20.0
+
+
+def test_compile_listener_registration_is_constant(tmp_path):
+    """jax.monitoring listeners are process-global and cannot be publicly
+    unregistered, so repeated enable/disable must NOT grow the global
+    lists — one forwarding pair, flipped inert by disable()."""
+    from hfrep_tpu.obs import device
+    for i in range(3):
+        obs_pkg.enable(tmp_path / f"run{i}", manifest=False)
+        obs_pkg.disable()
+    assert len(device._FORWARDERS) <= 2     # one event + one duration cb
+    # a compile while disabled reaches no sink; while enabled, exactly one
+    obs = obs_pkg.enable(tmp_path / "live", manifest=False)
+    jax.jit(lambda x: x * 17)(jnp.arange(5))
+    n = obs.counter("backend_compiles").value
+    obs_pkg.disable()
+    assert n >= 1, "enabled sink missed the compile event"
+
+
+# -------------------------------------------------- disabled-mode contract
+def test_disabled_singleton_is_inert(tmp_path):
+    assert get_obs() is NULL
+    assert not NULL.enabled
+    with NULL.span("anything", sync_on=jnp.ones(2)):
+        pass
+    NULL.counter("c").inc()
+    NULL.gauge("g").set(1.0)
+    NULL.histogram("h").observe(1.0)
+    NULL.event("e", x=1)
+    NULL.memory_snapshot()
+    assert NULL.summary() == {}
+    # instrument_step is a build-time no-op: the very same object back
+    fn = lambda s, k: (s, k)
+    assert instrument_step(fn, "noop_step") is fn
+
+
+def test_disabled_mode_no_events_and_identical_trajectory(tmp_path, dataset):
+    """Zero-overhead contract: with telemetry off nothing is written, and
+    the 3-epoch train-loss trajectory is IDENTICAL (not merely close) to
+    an enabled run — telemetry must never touch the compiled programs."""
+    cfg = ExperimentConfig(model=MCFG, train=TCFG)
+
+    # a previously-used run dir must see no writes from a disabled run
+    obs = obs_pkg.enable(tmp_path / "old", compile_listener=False)
+    obs_pkg.disable()
+    before = (tmp_path / "old" / "events.jsonl").read_text()
+
+    tr_off = GanTrainer(cfg, dataset)
+    tr_off.train(epochs=3)
+    assert (tmp_path / "old" / "events.jsonl").read_text() == before
+    assert not (tmp_path / "old" / "events.jsonl").read_text() == ""
+
+    obs_pkg.enable(tmp_path / "on")
+    tr_on = GanTrainer(cfg, dataset)
+    tr_on.train(epochs=3)
+    obs_pkg.disable()
+
+    assert [h["epoch"] for h in tr_off.history] == [0, 1, 2]
+    for h_off, h_on in zip(tr_off.history, tr_on.history):
+        assert h_off == h_on, "telemetry changed the trajectory"
+
+
+def test_enabled_run_dir_has_manifest_and_all_event_types(tmp_path, dataset):
+    """The acceptance shape: run dir contains run.json and a non-empty
+    events.jsonl with span + metric + memory events, and the report CLI
+    prints steps/sec, p50/p95 and MFU over it without error."""
+    run_dir = tmp_path / "run"
+    obs_pkg.enable(run_dir)
+    cfg = ExperimentConfig(model=MCFG, train=TCFG)
+    tr = GanTrainer(cfg, dataset)
+    tr.train(epochs=3)
+    tr.generate(jax.random.PRNGKey(5), 2)
+    obs_pkg.disable()
+
+    assert (run_dir / "run.json").exists()
+    events = _events(run_dir)           # parses ⇒ schema-valid
+    types = {e["type"] for e in events}
+    assert {"span", "metric", "memory", "event"} <= types
+    doc = read_manifest(run_dir)
+    assert doc["config"]["model"]["family"] == "gan"
+    assert doc["config"]["train"]["batch_size"] == 4
+    # block spans carry the trainer's step accounting
+    blocks = [e for e in events if e["type"] == "span" and e["name"] == "block"]
+    assert sum(b["steps"] for b in blocks) == 3
+    assert any(b["warmup"] for b in blocks)
+    spans = {e["name"] for e in events if e["type"] == "span"}
+    assert {"train", "generate"} <= spans
+
+    s = report_mod.summarize(run_dir)
+    assert s["n_events"] == len(events)
+    assert s["steps"] == 3
+    assert np.isfinite(s["steps_per_sec"])
+    assert np.isfinite(s["step_time_p50_s"])
+    assert np.isfinite(s["step_time_p95_s"])
+    out = report_mod.render(s)
+    for needle in ("steps/sec", "p50 step time", "p95 step time", "MFU",
+                   "memory high-water"):
+        assert needle in out
+
+
+def test_trainer_checkpoint_span_nests_under_train(tmp_path, dataset):
+    run_dir = tmp_path / "run"
+    obs_pkg.enable(run_dir)
+    cfg = ExperimentConfig(
+        model=MCFG,
+        train=dataclasses.replace(TCFG, checkpoint_dir=str(tmp_path / "ck"),
+                                  checkpoint_every=2))
+    GanTrainer(cfg, dataset).train(epochs=2)
+    obs_pkg.disable()
+    events = _events(run_dir)
+    ckpt = [e for e in events if e["type"] == "span"
+            and e["name"] == "checkpoint"]
+    assert ckpt and all(c["parent"] == "train" for c in ckpt)
+    counters = {e["name"]: e["value"] for e in events
+                if e["type"] == "metric" and e["kind"] == "counter"}
+    assert counters.get("checkpoints", 0) >= 1
+
+
+def test_instrument_step_emits_build_compile_and_dispatch(tmp_path):
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    calls = []
+    fn = instrument_step(lambda x: (calls.append(1), jnp.asarray(x * 2))[1],
+                         "toy_step", batch=4)
+    assert fn(3) == 6 and fn(4) == 8 and fn(5) == 10
+    obs_pkg.disable()
+    events = _events(tmp_path / "run")
+    builds = [e for e in events if e["type"] == "event"
+              and e["name"] == "parallel_build"]
+    assert builds and builds[0]["step"] == "toy_step"
+    compiles = [e for e in events if e["type"] == "span"
+                and e["name"] == "compile:toy_step"]
+    assert len(compiles) == 1
+    dispatch = [e for e in events if e["type"] == "metric"
+                and e["name"] == "dispatch:toy_step"]
+    assert dispatch[-1]["value"] == 2           # calls 2 and 3
+    assert len(calls) == 3
+
+
+# ------------------------------------------------------------ report CLI
+def test_report_cli_on_fixture_run_dir():
+    fx = report_mod.fixture_dir()
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "report", str(fx)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    for needle in ("steps/sec", "p50 step time", "p95 step time", "MFU",
+                   "memory high-water"):
+        assert needle in proc.stdout
+    assert "nan" not in proc.stdout.split("MFU")[1].splitlines()[0]
+
+
+def test_report_cli_self_test_and_json_and_diff():
+    fx = str(report_mod.fixture_dir())
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "report", "--self-test"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "obs self-test OK" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "report", fx, "--format",
+         "json"], cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["steps_per_sec"] > 0
+    assert 0 < doc["mfu"] < 1
+    assert doc["memory_high_water_bytes"] > 0
+
+    # diff mode: a run against itself is ratio 1.00x everywhere it's defined
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "report", fx, fx],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "1.00x" in proc.stdout
+
+
+def test_enable_rotates_previous_runs_events(tmp_path):
+    """Re-using a run dir must not merge two runs' statistics: the old
+    stream is rotated to events-<n>.jsonl and the report reads only the
+    fresh events.jsonl."""
+    run_dir = tmp_path / "run"
+    obs = obs_pkg.enable(run_dir, manifest=False, compile_listener=False)
+    obs.record_span("block", 1.0, steps=100)
+    obs_pkg.disable()
+    obs = obs_pkg.enable(run_dir, manifest=False, compile_listener=False)
+    obs.record_span("block", 1.0, steps=3)
+    obs_pkg.disable()
+
+    assert (run_dir / "events-1.jsonl").exists()
+    s = report_mod.summarize(run_dir)
+    assert s["steps"] == 3, "second run's report blended in the first run"
+    # the rotated stream still holds the first run, schema-valid
+    first = [report_mod.parse_event(l, i) for i, l in enumerate(
+        (run_dir / "events-1.jsonl").read_text().splitlines(), 1)]
+    assert any(e["type"] == "span" and e.get("steps") == 100 for e in first)
+
+
+def test_load_events_drops_torn_final_line(tmp_path, capsys):
+    """A run killed mid-write leaves a truncated last line (the writer
+    buffers); the valid prefix must stay readable with a warning, while
+    strict mode (the fixture self-test) still raises."""
+    good = ('{"v": 1, "t": 0.1, "type": "span", "name": "block", '
+            '"dur": 1.0, "depth": 0, "steps": 5}\n')
+    torn = '{"v": 1, "t": 0.2, "type": "met'          # no newline: torn
+    (tmp_path / "events.jsonl").write_text(good * 3 + torn)
+    events = report_mod.load_events(tmp_path)
+    assert len(events) == 3
+    assert "torn final line" in capsys.readouterr().err
+    with pytest.raises(report_mod.SchemaError):
+        report_mod.load_events(tmp_path, strict=True)
+    # a COMPLETE final line (newline present) that is invalid still raises:
+    # that is schema drift, not a crash artifact
+    (tmp_path / "events.jsonl").write_text(good + "not json\n")
+    with pytest.raises(report_mod.SchemaError):
+        report_mod.load_events(tmp_path)
+
+
+def test_report_rejects_malformed_events(tmp_path):
+    (tmp_path / "events.jsonl").write_text(
+        '{"v": 1, "t": 0.1, "type": "span", "name": "x", "dur": 1}\n')
+    with pytest.raises(report_mod.SchemaError):   # missing "depth"
+        report_mod.load_events(tmp_path)
+    (tmp_path / "events.jsonl").write_text('{"v": 99, "t": 0.1, "type": "event", "name": "x"}\n')
+    with pytest.raises(report_mod.SchemaError):
+        report_mod.load_events(tmp_path)
+    (tmp_path / "events.jsonl").write_text("not json\n")
+    with pytest.raises(report_mod.SchemaError):
+        report_mod.load_events(tmp_path)
+
+
+# --------------------------------------------------- compatibility shims
+def test_metric_logger_context_manager_and_idempotent_close(tmp_path):
+    from hfrep_tpu.utils.logging import MetricLogger
+    path = tmp_path / "m.jsonl"
+    with pytest.raises(RuntimeError):
+        with MetricLogger(str(path)) as ml:
+            ml.log(0, {"d_loss": 1.0})
+            raise RuntimeError("sweep failed mid-run")
+    assert ml._fh is None, "file handle leaked on the error path"
+    ml.close()          # second close (and close-after-__exit__) is a no-op
+    ml.close()
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["step"] == 0 and rec["d_loss"] == 1.0
+
+
+def test_metric_logger_forwards_to_obs(tmp_path):
+    from hfrep_tpu.utils.logging import MetricLogger
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    with MetricLogger(str(tmp_path / "m.jsonl")) as ml:
+        ml.log(7, {"d_loss": 0.5, "g_loss": 0.25})
+    obs_pkg.disable()
+    gauges = {e["name"]: e for e in _events(tmp_path / "run")
+              if e["type"] == "metric" and e["kind"] == "gauge"}
+    assert gauges["train/d_loss"]["value"] == 0.5
+    assert gauges["train/g_loss"]["value"] == 0.25
+    assert gauges["train/d_loss"]["step"] == 7
+
+
+def test_step_timer_zero_duration_returns_nan():
+    from hfrep_tpu.utils.profiling import StepTimer
+    t = StepTimer()
+    # only warmup samples, all at perf_counter resolution zero (the very
+    # fast CPU-test regime): rate is undefined, must be nan not a crash
+    t.samples.append((1, 0.0, True))
+    assert np.isnan(t.steps_per_sec)
+    t.samples.append((2, 0.0, True))
+    assert np.isnan(t.steps_per_sec)
+    # a real steady sample recovers the rate
+    t.samples.append((10, 2.0, False))
+    assert t.steps_per_sec == pytest.approx(5.0)
+
+
+def test_step_timer_emits_block_spans_when_enabled(tmp_path):
+    from hfrep_tpu.utils.profiling import StepTimer
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    t = StepTimer()
+    t.start()
+    t.stop(5, sync_on=jnp.ones(3), warmup=True)
+    t.start()
+    t.stop(5)
+    obs_pkg.disable()
+    blocks = [e for e in _events(tmp_path / "run")
+              if e["type"] == "span" and e["name"] == "block"]
+    assert [b["warmup"] for b in blocks] == [True, False]
+    assert [b["steps"] for b in blocks] == [5, 5]
+    assert blocks[0]["synced"] and not blocks[1]["synced"]
+    hist = [e for e in _events(tmp_path / "run")
+            if e["type"] == "metric" and e["name"] == "step_time"]
+    assert len(hist) == 2
+
+
+# ----------------------------------------------------------------- flops
+def test_flops_moved_module_and_shim():
+    from hfrep_tpu.obs import flops
+    assert flops.epoch_flops(48, 35, 100) > 0
+    # the tools/ shim re-exports the same objects
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "flops_shim", REPO_ROOT / "tools" / "flops_accounting.py")
+    shim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(shim)
+    assert shim.epoch_flops is flops.epoch_flops
+    assert shim.PEAK_BF16 == flops.PEAK_BF16
+
+
+def test_mfu_guards_and_series_contract():
+    from hfrep_tpu.obs import flops
+    assert np.isnan(flops.mfu(float("nan"), 48, 35))
+    assert np.isnan(flops.mfu(0.0, 48, 35))
+    assert np.isnan(flops.mfu(None, 48, 35))
+    v = flops.mfu(553.0, 48, 35)
+    assert 0 < v < 1
+    series = flops.mfu_series(np.asarray([1 / 553.0, 0.0, 1 / 553.0]), 48, 35)
+    assert series.shape == (3,)
+    assert series[0] == pytest.approx(v, rel=1e-6)
+    assert np.isnan(series[1])
+    from hfrep_tpu.analysis.contracts import ContractError
+    with pytest.raises(ContractError):      # rank-2 input violates (N,)
+        flops.mfu_series(np.ones((2, 2)), 48, 35)

@@ -7,9 +7,13 @@ models per program varies.
 Run on the real chip: `python tools/bench_multi_seed.py [K ...]`
 (default 1 2 4).  Uses bench.py's measurement discipline: one jitted
 50-epoch block per dispatch, distinct keys per call (the tunneled
-backend dedupes identical executions).
+backend dedupes identical executions).  Pass ``--obs-dir DIR`` to emit
+the run through :mod:`hfrep_tpu.obs` (manifest + block spans + per-K
+gauges + memory snapshots) so two bench runs diff machine-readably with
+``python -m hfrep_tpu.obs report A B``.
 """
 
+import argparse
 import os
 import sys
 import time
@@ -20,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.obs import get_obs
 
 
 def measure(n_seeds: int, n_calls: int = 10) -> float:
@@ -28,6 +33,7 @@ def measure(n_seeds: int, n_calls: int = 10) -> float:
     from hfrep_tpu.train.multi_seed import (init_multi_seed_states,
                                             make_multi_seed_step)
 
+    obs = get_obs()
     mcfg = ModelConfig(family="mtss_wgan_gp")
     tcfg = TrainConfig(steps_per_call=50)
     dataset = load_dataset(mcfg, include_rf=False)
@@ -38,13 +44,19 @@ def measure(n_seeds: int, n_calls: int = 10) -> float:
 
     run_keys = jnp.stack([jax.random.PRNGKey(s) for s in range(n_seeds)])
     fold = jax.jit(jax.vmap(jax.random.fold_in, in_axes=(0, None)))
+    t0 = time.perf_counter()
     states, metrics = fn(states, fold(run_keys, 0))      # compile + warm
     jax.block_until_ready(metrics)
+    obs.record_span("block", time.perf_counter() - t0,
+                    steps=tcfg.steps_per_call, warmup=True, synced=True,
+                    n_seeds=n_seeds)
     t0 = time.perf_counter()
     for i in range(1, n_calls + 1):
         states, metrics = fn(states, fold(run_keys, i))
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
+    obs.record_span("block", dt, steps=n_calls * tcfg.steps_per_call,
+                    warmup=False, synced=True, n_seeds=n_seeds)
     assert jnp.isfinite(metrics["d_loss"]).all()
     assert jnp.isfinite(metrics["g_loss"]).all()
     # model-epochs per second (each member advances 50 epochs per call)
@@ -52,15 +64,27 @@ def measure(n_seeds: int, n_calls: int = 10) -> float:
 
 
 def main(argv):
-    ks = [int(a) for a in argv] or [1, 2, 4]
-    base = None
-    for k in ks:
-        rate = measure(k)
-        if base is None:
-            base = rate / k               # per-model rate at the first K
-        print(f"K={k}: {rate:8.1f} model-epochs/s  "
-              f"({rate / k:7.1f} per model, {rate / k / base:4.2f}x vs K={ks[0]})",
-              flush=True)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ks", nargs="*", type=int, default=None,
+                    help="member counts to measure (default: 1 2 4)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="emit through hfrep_tpu.obs into this run dir")
+    args = ap.parse_args(argv)
+    ks = args.ks or [1, 2, 4]
+    import hfrep_tpu.obs as obs_pkg
+    with obs_pkg.session(args.obs_dir, command="bench_multi_seed",
+                         ks=ks) as obs:
+        base = None
+        for k in ks:
+            rate = measure(k)
+            if base is None:
+                base = rate / k           # per-model rate at the first K
+            obs.gauge(f"bench/K{k}/model_epochs_per_sec").set(
+                rate, per_model=rate / k, vs_first=rate / k / base)
+            print(f"K={k}: {rate:8.1f} model-epochs/s  ({rate / k:7.1f} "
+                  f"per model, {rate / k / base:4.2f}x vs K={ks[0]})",
+                  flush=True)
+        obs.memory_snapshot(phase="bench_end")
 
 
 if __name__ == "__main__":

@@ -48,14 +48,22 @@ from hfrep_tpu.models.registry import build_gan
 from hfrep_tpu.train.states import init_gan_state
 
 
-def _time_step(step, state, reps):
+def _time_step(step, state, reps, label=None):
+    from hfrep_tpu.obs import get_obs
+    obs = get_obs()
+    t0 = time.perf_counter()
     state, m = step(state, jax.random.PRNGKey(99))      # compile + warm
     jax.block_until_ready(m["d_loss"])
+    obs.record_span("block", time.perf_counter() - t0, steps=1, warmup=True,
+                    synced=True, config=label)
     t0 = time.perf_counter()
     for r in range(reps):
         state, m = step(state, jax.random.PRNGKey(100 + r))
         jax.block_until_ready(m["d_loss"])
-    return (time.perf_counter() - t0) / reps * 1e3      # ms/epoch
+    dt = time.perf_counter() - t0
+    obs.record_span("block", dt, steps=reps, warmup=False, synced=True,
+                    config=label)
+    return dt / reps * 1e3                              # ms/epoch
 
 
 def main():
@@ -64,7 +72,23 @@ def main():
     ap.add_argument("--features", type=int, default=35)
     ap.add_argument("--hidden", type=int, default=100)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--obs-dir", default=None,
+                    help="emit the run through hfrep_tpu.obs (manifest + "
+                         "events.jsonl) so bench trajectories diff with "
+                         "`python -m hfrep_tpu.obs report A B`")
     args = ap.parse_args()
+
+    import hfrep_tpu.obs as obs_pkg
+    with obs_pkg.session(args.obs_dir, command="bench_pp") as obs:
+        _bench(args, obs)
+
+
+def _bench(args, obs):
+    obs.annotate(config={"model": {"family": "mtss_wgan_gp",
+                                   "window": args.window,
+                                   "features": args.features,
+                                   "hidden": args.hidden},
+                         "train": {"batch_size": 32}})
 
     from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
     from hfrep_tpu.parallel.layer_pipeline import make_pp_train_step
@@ -82,13 +106,13 @@ def main():
 
     rows = []
     t_plain = _time_step(jax.jit(make_train_step(pair, tcfg, dataset)),
-                         fresh(), args.reps)
+                         fresh(), args.reps, label="plain")
     rows.append({"config": "plain (1 dev)", "ms_per_epoch": t_plain,
                  "vs_plain": 1.0, "chip_model": 1.0})
 
     dp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
     t_dp = _time_step(make_dp_multi_step(pair, tcfg, dataset, dp_mesh),
-                      fresh(), args.reps)
+                      fresh(), args.reps, label="dp2")
     rows.append({"config": "dp=2", "ms_per_epoch": t_dp,
                  "vs_plain": t_dp / t_plain,
                  "chip_model": None})   # dp splits rows: latency-parity on chip
@@ -97,7 +121,7 @@ def main():
     for m in (1, 2, 4):
         t_pp = _time_step(
             make_pp_train_step(pair, tcfg, dataset, pp_mesh, microbatches=m),
-            fresh(), args.reps)
+            fresh(), args.reps, label=f"pp2_m{m}")
         rows.append({"config": f"pp=2 M={m}", "ms_per_epoch": t_pp,
                      "vs_plain": t_pp / t_plain,
                      # latency-bound chip prediction: (M+1)·W·t vs 2·W·t
@@ -110,6 +134,10 @@ def main():
     os.makedirs("results", exist_ok=True)
     with open("results/bench_pp.json", "w") as f:
         json.dump({"window": args.window, "rows": rows}, f, indent=2)
+    for r in rows:
+        obs.gauge(f"bench/{r['config']}/ms_per_epoch").set(
+            r["ms_per_epoch"], vs_plain=r["vs_plain"])
+    obs.memory_snapshot(phase="bench_end")
 
 
 if __name__ == "__main__":

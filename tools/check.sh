@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Static-analysis gate: JAX-aware lint + shape contracts over the whole
-# tree.  Exit 0 = clean (fixed, # noqa'd, or baselined in
-# hfrep_tpu/analysis/baseline.json); exit 1 = new violations; 2 = usage.
+# tree, plus the obs event-schema self-test.  Exit 0 = clean (fixed,
+# # noqa'd, or baselined in hfrep_tpu/analysis/baseline.json) AND the
+# committed telemetry fixture still parses; non-zero otherwise; 2 = usage.
 #
 #   tools/check.sh              # human output
 #   tools/check.sh --format json
@@ -10,5 +11,9 @@
 # on new violations even when this script isn't invoked directly.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m hfrep_tpu.analysis check \
+python -m hfrep_tpu.analysis check \
     hfrep_tpu tools tests bench.py bench_extra.py "$@"
+# telemetry schema gate: writer (hfrep_tpu.obs) and parser (obs.report)
+# must agree on the committed fixture run directory.  Status goes to
+# stderr so `--format json` keeps stdout pure JSON for machine consumers.
+python -m hfrep_tpu.obs report --self-test 1>&2

@@ -1,66 +1,19 @@
-"""Analytic FLOPs/step + achieved-MFU accounting for the MTSS-WGAN-GP
-train epoch (VERDICT r1 item 3).
-
-XLA's `compiled.cost_analysis()` reports ~3e7 flops/epoch for the (48,35)
-step because `pallas_call` bodies are opaque to it — the LSTM kernels
-hold nearly all the matmul FLOPs — so the accounting is analytic.
-
-Model math per epoch (``GAN/MTSS_WGAN_GP.py:254-284`` semantics,
-B=32, H=100, n_critic=5; matmul = 2mnk FLOPs):
-
-* generator fwd on b samples:
-  ``Gf(b) = 2bW(5HF + 12H²)``  — LSTM(F→H) proj 4HF + rec 4H²,
-  LSTM(H→H) proj 4H² + rec 4H², Dense(H→F) HF.
-* critic fwd on b: ``Cf(b) = 2bW(4HF + 12H² + H)`` — two LSTMs +
-  Flatten→Dense(WH→1).
-* per critic iteration: fake gen Gf(B) (stop-grad) + loss graph
-  [Cf(2B) real⊕fake + Cf(B) interp + 2·Cf(B) GP input-grad] and its
-  parameter backward ≈ 2× the loss graph (the GP second-order path is
-  inside this 2× of a graph that already contains the inner backward):
-  ≈ Gf(B) + 3·(Cf(2B) + 3·Cf(B)) = Gf(B) + 15·Cf(B).
-* generator update: fwd Gf(B)+Cf(B), backward ≈ 2×: ≈ 3(Gf(B)+Cf(B)).
-* epoch ≈ 8·Gf(B) + 78·Cf(B).
-
-"Executed" FLOPs additionally count the lane padding the kernels run at
-(H → Hp = 128 in every gate/recurrent matmul; output Dense stays
-logical).  MFU is quoted against both the v5e bf16 peak (197 TFLOP/s)
-and the f32-matmul peak (~½ of bf16); the workload's recurrent matmuls
-are (32, Hp) × (Hp, 4Hp) — 32 of 128 systolic rows occupied — so the
-practical ceiling is ~25% of peak before any other inefficiency.
+"""Compatibility shim — the analytic FLOPs/MFU accounting moved into the
+package as :mod:`hfrep_tpu.obs.flops` so the telemetry layer can compute
+per-step MFU in-process (VERDICT r1 item 3 lives on there; this file
+keeps the documented ``python tools/flops_accounting.py [sps ...]``
+invocation working).
 """
 
+import os
 import sys
 
-PEAK_BF16 = 197e12          # TPU v5e (v5 lite) peak, bf16 matmul
-PEAK_F32 = PEAK_BF16 / 2    # conventional f32-matmul rate on the MXU
-B, H, HP, N_CRITIC = 32, 100, 128, 5
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def gf(b, w, f, h):
-    return 2 * b * w * (5 * h * f + 12 * h * h)
-
-
-def cf(b, w, f, h):
-    return 2 * b * w * (4 * h * f + 12 * h * h + h)
-
-
-def epoch_flops(w, f, h):
-    return 8 * gf(B, w, f, h) + 78 * cf(B, w, f, h)
-
-
-def report(w, f, steps_per_sec):
-    logical = epoch_flops(w, f, H)
-    executed = epoch_flops(w, f, HP)    # H→Hp everywhere the kernels pad
-    achieved = logical * steps_per_sec
-    print(f"shape ({w}, {f}) @ {steps_per_sec} steps/s:")
-    print(f"  model FLOPs/epoch:    {logical/1e9:.1f} GF  "
-          f"(executed incl. lane padding: {executed/1e9:.1f} GF)")
-    print(f"  achieved:             {achieved/1e12:.1f} TFLOP/s")
-    print(f"  MFU vs bf16 peak:     {achieved/PEAK_BF16*100:.1f}%")
-    print(f"  MFU vs f32 peak:      {achieved/PEAK_F32*100:.1f}%  "
-          f"(batch occupies 32/128 MXU rows → ~25% practical ceiling)")
-
+from hfrep_tpu.obs.flops import (  # noqa: F401  (re-exported API)
+    B, H, HP, N_CRITIC, PEAK_BF16, PEAK_F32, cf, epoch_flops, gf, main,
+    mfu, mfu_series, report,
+)
 
 if __name__ == "__main__":
-    report(48, 35, float(sys.argv[1]) if len(sys.argv) > 1 else 553.0)
-    report(168, 36, float(sys.argv[2]) if len(sys.argv) > 2 else 168.8)
+    sys.exit(main())
