@@ -31,17 +31,27 @@ line has a floor derived from the four-round history (562.6 / 552.7 /
 session-to-session jitter headroom.  A silent drift below any floor
 turns into a nonzero exit code — the driver's BENCH_r{N}.json records
 ``rc`` — while the JSON line is still emitted for the record.
+
+Telemetry: with ``HFREP_OBS_DIR=<dir>`` every measurement also lands in
+an obs run dir (block/bench spans, ``bench/*`` gauges, manifest) —
+stdout keeps the single-JSON-line contract.  With ``HFREP_HISTORY=
+<history.jsonl>`` on top, the run is gated against the rolling
+median/MAD baseline of comparable past runs (``hfrep_tpu.obs.regress``)
+and ingested on pass — the static floors above catch cliff-edge drops,
+the history gate catches the slow drift between them.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+import hfrep_tpu.obs as obs_pkg
 from hfrep_tpu.config import ModelConfig, TrainConfig
 from hfrep_tpu.models.registry import build_gan
 from hfrep_tpu.train.states import init_gan_state
@@ -71,35 +81,54 @@ def load_dataset(mcfg: ModelConfig, include_rf: bool = False) -> jnp.ndarray:
 
 
 def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
-                 steps_per_call: int) -> float:
+                 steps_per_call: int, label: str = "bench") -> float:
     """The ONE timing harness every measurement shares: state-threaded
     calls with distinct keys (nothing to dedup server-side), ``n_warmups``
     untimed dispatches (compile, plus the donated-state retrace on
     resharded paths), and a ``device_get`` of the final metrics as the
     fence — `block_until_ready` does not reliably fence on the tunneled
     backend (RESULTS.md measurement traps), but the calls chain through
-    the donated state, so materializing the last loss forces them all."""
+    the donated state, so materializing the last loss forces them all.
+
+    Both windows land in the obs event stream when telemetry is on (one
+    attribute check each when off).  Only the HEADLINE measurement may
+    emit ``block`` spans — the report folds every block into the run's
+    steps/sec, and blending the (48, 35) and (168, 36) shapes would
+    produce a rate no shape ever ran; the other measurements emit
+    ``bench`` spans (same fields, out of the headline fold) and publish
+    their rates as ``bench/<label>`` gauges instead."""
+    obs = obs_pkg.get_obs()
+    span = "block" if label == "headline" else "bench"
+    t0 = time.perf_counter()
     for i in range(n_warmups):
         state, metrics = multi(state, jax.random.fold_in(key, i))
         float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
+    obs.record_span(span, time.perf_counter() - t0,
+                    steps=n_warmups * steps_per_call, warmup=True,
+                    synced=True, config=label)
     t0 = time.perf_counter()
     for i in range(n_warmups, n_warmups + n_calls):
         state, metrics = multi(state, jax.random.fold_in(key, i))
     float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
     dt = time.perf_counter() - t0
+    obs.record_span(span, dt, steps=n_calls * steps_per_call,
+                    warmup=False, synced=True, config=label)
     for v in metrics.values():
         assert jnp.isfinite(v).all()
     return n_calls * steps_per_call / dt
 
 
-def measure(mcfg: ModelConfig, include_rf: bool, n_calls: int) -> float:
-    tcfg = TrainConfig(steps_per_call=50)
+def measure(mcfg: ModelConfig, include_rf: bool, n_calls: int,
+            label: str = "bench",
+            tcfg: TrainConfig | None = None) -> float:
+    tcfg = tcfg if tcfg is not None else TrainConfig(steps_per_call=50)
     dataset = load_dataset(mcfg, include_rf)
     pair = build_gan(mcfg)
     key = jax.random.PRNGKey(tcfg.seed)
     state = init_gan_state(key, mcfg, tcfg, pair)
     multi = make_multi_step(pair, tcfg, dataset)
-    return _timed_multi(multi, state, key, 1, n_calls, tcfg.steps_per_call)
+    return _timed_multi(multi, state, key, 1, n_calls, tcfg.steps_per_call,
+                        label=label)
 
 
 def measure_dp(n_calls: int) -> float:
@@ -119,7 +148,8 @@ def measure_dp(n_calls: int) -> float:
     key = jax.random.PRNGKey(tcfg.seed)
     state = init_gan_state(key, mcfg, tcfg, pair)
     multi = make_dp_multi_step(pair, tcfg, dataset, make_mesh())
-    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call)
+    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call,
+                        label="dp_shard_map")
 
 
 def measure_sp(n_calls: int) -> float:
@@ -141,18 +171,104 @@ def measure_sp(n_calls: int) -> float:
     state = init_gan_state(key, mcfg, tcfg, pair)
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
     multi = make_sp_multi_step(pair, tcfg, dataset, mesh)
-    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call)
+    return _timed_multi(multi, state, key, 2, n_calls, tcfg.steps_per_call,
+                        label="sp_prod")
 
 
 def main() -> None:
+    # Telemetry opt-in (HFREP_OBS_DIR): every measurement lands in a run
+    # dir — block/bench spans, bench/* gauges, run.json with the
+    # headline config — so BENCH trajectories are diffable AND gateable
+    # (`obs report A B`, `obs gate`).  stdout stays the single JSON
+    # line; the session's telemetry hint goes to stderr.
+    obs_dir = os.environ.get("HFREP_OBS_DIR")
+    # annotate from the SAME dataclass instances the headline measurement
+    # runs with (_bench receives these): the report's MFU math and the
+    # history key's shape signature read window/features/hidden/batch
+    # from this annotation, so a separately-built config here could
+    # silently drift from the shape actually benchmarked
+    mcfg = ModelConfig(family="mtss_wgan_gp")
+    tcfg = TrainConfig(steps_per_call=50)
+    obs_degraded = False
+    with obs_pkg.session_or_off(obs_dir, "bench", command="bench") as obs:
+        if obs_dir and not obs.enabled:
+            # an unwritable HFREP_OBS_DIR degraded to telemetry-off: the
+            # gate below must not try to summarize a run dir that was
+            # never written (the JSON line survives the tooling failure)
+            obs_degraded = True
+            obs_dir = None
+        obs.annotate(config={
+            "model": {"family": mcfg.family, "window": mcfg.window,
+                      "features": mcfg.features, "hidden": mcfg.hidden},
+            "train": {"batch_size": tcfg.batch_size,
+                      "steps_per_call": tcfg.steps_per_call}})
+        rc = _bench(obs, mcfg, tcfg)
+    # Perf-regression sentinel (HFREP_HISTORY): gate this run against
+    # the rolling median/MAD baseline of comparable past runs, then
+    # ingest it on pass — silent drift across sessions (the BENCH_r01-
+    # r05 pattern) becomes a nonzero exit code with a named metric.
+    hist = os.environ.get("HFREP_HISTORY")
+    if hist and not obs_dir:
+        # The operator armed the tripwire but nothing was emitted to
+        # gate — say so, naming the REAL cause (an unusable run dir is a
+        # permissions hunt, a missing env var is not), instead of
+        # exiting 0 with the sentinel silently disarmed (the exact
+        # failure mode the gate exists to close).
+        why = ("HFREP_OBS_DIR was unusable (see above)" if obs_degraded
+               else "HFREP_OBS_DIR is not")
+        print(f"bench: HFREP_HISTORY is set but {why} -- "
+              "no run dir was recorded, perf gate skipped", file=sys.stderr)
+    if obs_dir and hist:
+        from hfrep_tpu.obs import history as hist_mod
+        from hfrep_tpu.obs import regress
+        from hfrep_tpu.obs.report import SchemaError
+
+        try:
+            record = hist_mod.summarize_run(obs_dir)
+            records = hist_mod.load_history(hist)
+            verdict = regress.check_run(record, records)
+        except (OSError, SchemaError, ValueError) as e:
+            # a corrupt/unreadable store is a tooling failure, not a
+            # perf regression: name it on stderr and reuse the CLI's
+            # exit code for it (2) instead of dying in a traceback
+            # after the JSON line already went out
+            print(f"bench: history gate unavailable ({e})", file=sys.stderr)
+            # a floor regression (rc=1) outranks the tooling error: a
+            # driver that distinguishes 1 (perf) from 2 (tooling) must
+            # not see a real floor breach recategorized
+            raise SystemExit(rc or 2)
+        print(regress.render_verdict(verdict), file=sys.stderr)
+        if not verdict["ok"]:
+            rc = max(rc, 1)
+        if rc == 0:
+            # index the record in hand (same object the gate judged) —
+            # and only a fully clean run: a floor-failed or regressed
+            # run must not become a baseline sample
+            try:
+                hist_mod.append_record(
+                    hist, dict(record, ingested_unix=round(time.time(), 3)),
+                    records=records)
+            except OSError as e:
+                # same tooling-vs-perf split as the load path above: an
+                # unwritable store is exit 2, never the regression code
+                print(f"bench: history ingest failed ({e})",
+                      file=sys.stderr)
+                raise SystemExit(2)
+    if rc:
+        raise SystemExit(rc)
+
+
+def _bench(obs, mcfg: ModelConfig, tcfg: TrainConfig) -> int:
     t_start = time.perf_counter()
-    # Headline: committed-script shape, 20 × 50 = 1000 timed epochs.
-    steps = measure(ModelConfig(family="mtss_wgan_gp"), False, n_calls=20)
+    # Headline: committed-script shape, 20 × 50 = 1000 timed epochs —
+    # the very dataclasses main() annotated into run.json, so the
+    # manifest shape can never drift from the shape measured.
+    steps = measure(mcfg, False, n_calls=20, label="headline", tcfg=tcfg)
     # Production-artifact shape (168, 36): ~3.5× the sequential work per
     # epoch; 10 × 50 timed epochs keeps the whole bench under a minute.
     prod = measure(
         ModelConfig(family="mtss_wgan_gp", window=168, features=36), True,
-        n_calls=10)
+        n_calls=10, label="prod_168x36")
     # The dp/sp measurements cost extra compiles (~90 s each through the
     # tunnel); skip rather than risk losing the whole JSON line to a
     # driver timeout on a slow-compile day.
@@ -184,6 +300,17 @@ def main() -> None:
         "dp_devices": len(jax.devices()),
     }))
 
+    # The same numbers as gauges: the bench/ prefix makes them
+    # first-class run-history metrics (history.BENCH_GAUGE_PREFIX), so
+    # `obs gate` baselines each line independently of the headline fold.
+    for name, value in (("headline_steps_per_sec", steps),
+                        ("prod_168x36_steps_per_sec", prod),
+                        ("dp_shard_map_steps_per_sec", dp),
+                        ("sp_prod_steps_per_sec", sp)):
+        if value is not None:
+            obs.gauge(f"bench/{name}").set(float(value))
+    obs.memory_snapshot(phase="bench_end")
+
     # Regression floors (RESULTS.md §bench-gate): fail loudly on silent
     # drift.  Skipped measurements (dp/sp None) don't gate — their floors
     # only apply when the number exists.
@@ -193,7 +320,8 @@ def main() -> None:
               if v is not None and v < f}
     if failed:
         print(f"bench: REGRESSION below floor: {failed}", file=sys.stderr)
-        raise SystemExit(1)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

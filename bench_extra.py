@@ -11,17 +11,23 @@ train steps/sec); this script measures the other two declared metrics:
   (`GAN/generated_data2022-07-09.pkl`), both in scaled space; plus our
   fresh-noise samples scored against the real windows.
 
-Prints one JSON line per metric.
+Prints one JSON line per metric (stdout contract unchanged); with
+``HFREP_OBS_DIR=<dir>`` both measurements additionally land in an obs
+run dir as ``bench`` spans + ``bench/*`` gauges, so the secondary
+metrics enter the same run-history/gate loop as bench.py's.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+import hfrep_tpu.obs as obs_pkg
 
 GEN_PKL = "/root/reference/GAN/generated_data2022-07-09.pkl"
 PROD_H5 = "/root/reference/GAN/trained_generator/MTTS_GAN_GP20220621_02-49-32.h5"
@@ -42,15 +48,20 @@ def bench_ae_epoch() -> None:
     fn = jax.jit(lambda k: train_autoencoder(k, x_scaled, cfg))
     jax.block_until_ready(fn(jax.random.PRNGKey(0)).params)       # compile
 
+    obs = obs_pkg.get_obs()
     times = []
     for r in range(3):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(jax.random.PRNGKey(r)).params)
-        times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        obs.record_span("bench", dt, steps=epochs, warmup=False,
+                        synced=True, config="ae_epoch")
     # single long run: the one-dispatch overhead (~4 ms through the
     # tunnel) amortizes to <0.2 us/epoch, far below measurement noise of
     # a two-point difference.
     per_epoch = min(times) / epochs
+    obs.gauge("bench/ae_epoch_time_ms").set(round(per_epoch * 1e3, 4))
     print(json.dumps({"metric": "ae_epoch_time", "value": round(per_epoch * 1e3, 4),
                       "unit": "ms/epoch", "vs_baseline": None}))
 
@@ -71,11 +82,14 @@ def bench_js_regeneration() -> None:
     # fresh samples and the reference's cached samples (0 ⇔ identical
     # distributions; the oracle for "regenerates within tolerance").
     js = float(js_div(ref_cube, ours, jnp.concatenate([ref_cube, ours], axis=0)))
+    obs_pkg.get_obs().gauge("bench/js_div_regenerated").set(round(js, 6))
     print(json.dumps({"metric": "js_div_regenerated_vs_reference_cube",
                       "value": round(js, 6), "unit": "nats",
                       "vs_baseline": None}))
 
 
 if __name__ == "__main__":
-    bench_ae_epoch()
-    bench_js_regeneration()
+    with obs_pkg.session_or_off(os.environ.get("HFREP_OBS_DIR"),
+                                "bench_extra", command="bench_extra"):
+        bench_ae_epoch()
+        bench_js_regeneration()

@@ -311,13 +311,16 @@ def _cmd_train_gan_impl(args) -> int:
             # recovery completes the original schedule, not epochs on top
             target = max(0, target - trainer.epoch)
     if args.profile_dir and target:
-        from hfrep_tpu.utils.profiling import trace
+        from hfrep_tpu.obs import trace_capture
 
         # Trace a bounded window (compile + one steady-state block): an
         # unbounded trace of a 5000-epoch run buffers millions of events
-        # on the host and produces a file xprof can't open.
+        # on the host and produces a file xprof can't open.  Under
+        # --obs-dir the capture path + xplane count land in run.json's
+        # ``traces`` list (manifest schema v2), so the profile is part
+        # of the run's record instead of a loose directory.
         traced = min(target, 2 * cfg.train.steps_per_call)
-        with trace(args.profile_dir):
+        with trace_capture(args.profile_dir, epochs=traced):
             trainer.train(epochs=traced)
         print(f"profile: {args.profile_dir} (first {traced} epochs)")
         trainer.train(epochs=target - traced)

@@ -20,9 +20,15 @@ single telemetry subsystem behind all of them:
 * **MFU** — analytic FLOPs accounting for the flagship epoch
   (:mod:`hfrep_tpu.obs.flops`, moved from ``tools/flops_accounting.py``);
 * **run manifests** — ``run.json`` with git SHA, config, mesh shape,
-  jax/flax versions and host info (:mod:`hfrep_tpu.obs.manifest`);
+  jax/flax versions, host info and xprof trace links
+  (:mod:`hfrep_tpu.obs.manifest`; captures via :func:`trace_capture`);
 * **report CLI** — ``python -m hfrep_tpu.obs report RUN_DIR [RUN_DIR2]``
-  summarizes or diffs run directories (:mod:`hfrep_tpu.obs.report`).
+  summarizes or diffs run directories (:mod:`hfrep_tpu.obs.report`),
+  ``report --merge`` folds a multi-host launch's per-process dirs;
+* **run history & regression gate** — ``python -m hfrep_tpu.obs gate``
+  baselines a run against the append-only history index
+  (:mod:`hfrep_tpu.obs.history` / :mod:`hfrep_tpu.obs.regress`:
+  median/MAD rolling baselines per (metric, family, mesh, host)).
 
 Design rule — *no-op when disabled*: the module-level singleton starts
 as :data:`NULL` (``enabled = False``); every instrumentation hook in
@@ -347,13 +353,21 @@ def enable(run_dir, *, manifest: bool = True, compile_listener: bool = True,
         disable()
     obs = Obs(run_dir)
     _active = obs
-    if manifest:
-        from hfrep_tpu.obs import manifest as mf
-        mf.write_manifest(obs.run_dir, extra=manifest_extra or None)
-    if compile_listener:
-        from hfrep_tpu.obs import device
-        device.install_compile_listener(obs)
-    obs.event("run_start")
+    try:
+        if manifest:
+            from hfrep_tpu.obs import manifest as mf
+            mf.write_manifest(obs.run_dir, extra=manifest_extra or None)
+        if compile_listener:
+            from hfrep_tpu.obs import device
+            device.install_compile_listener(obs)
+        obs.event("run_start")
+    except BaseException:
+        # a partial enable (events stream opened, manifest write raised)
+        # must not leave the half-open sink as the active singleton —
+        # callers that catch the error and degrade to telemetry-off
+        # would otherwise keep emitting through it, unclosed, forever
+        disable()
+        raise
     return obs
 
 
@@ -384,8 +398,105 @@ def session(run_dir, **manifest_extra):
         yield obs
     finally:
         disable()
+        # stderr, not stdout: the bench probes' single-JSON-line stdout
+        # contract (and any CLI's --format json) must stay machine-pure
+        import sys
         print(f"telemetry: {run_dir} "
-              f"(python -m hfrep_tpu.obs report {run_dir})")
+              f"(python -m hfrep_tpu.obs report {run_dir})", file=sys.stderr)
+
+
+@contextlib.contextmanager
+def session_or_off(run_dir, prog: str, **manifest_extra):
+    """:func:`session` that degrades to telemetry-off instead of raising
+    when the run dir is unusable (unwritable path, ``run.json`` blocked):
+    the bench probes' contract is that telemetry must never cost the
+    measurement or the stdout JSON line, so the failure becomes a stderr
+    notice and the :data:`NULL` sink.  Callers that gate on the run dir
+    afterwards should check ``obs.enabled``.  ``prog`` prefixes the
+    notice (the only thing the probes were duplicating)."""
+    with contextlib.ExitStack() as stack:
+        try:
+            obs = stack.enter_context(session(run_dir, **manifest_extra))
+        except OSError as e:
+            import sys
+            print(f"{prog}: telemetry disabled (run dir {run_dir}: {e})",
+                  file=sys.stderr)
+            obs = stack.enter_context(session(None))
+        yield obs
+
+
+@contextlib.contextmanager
+def trace_capture(log_dir=None, **attrs):
+    """Capture a jax.profiler (xprof/XLA) trace AND link it into the run.
+
+    Wraps ``jax.profiler.start_trace`` / ``stop_trace`` so on-chip
+    profiling joins the telemetry stream instead of living beside it
+    (the ROADMAP xprof-linkage gap): with obs enabled the capture lands
+    under ``<run_dir>/traces`` by default, a ``trace_capture`` event
+    enters the stream, and ``run.json`` gains a ``traces`` entry
+    (path, file count, wall seconds) so the report side can find every
+    capture a run produced.  With obs disabled an explicit ``log_dir``
+    still captures (plain profiling keeps working); no dir at all is a
+    no-op.
+
+    Capture failures propagate — the user asked for a profile, unlike
+    passive telemetry — but the manifest/stream linkage is best-effort.
+    Yields the capture directory (or None when inactive).
+    """
+    obs = get_obs()
+    if log_dir is None:
+        if not obs.enabled:
+            yield None
+            return
+        log_dir = Path(obs.run_dir) / "traces"
+    log_dir = Path(log_dir)
+    import jax
+    # Snapshot what's already under the capture root: repeated captures
+    # into the shared default <run_dir>/traces must each report only the
+    # files THEY produced, not the cumulative pile.  Only the linkage
+    # branch reads the count, so a disabled-obs capture into a big
+    # profile root skips both directory walks.
+    pre = _trace_file_set(log_dir) if obs.enabled else frozenset()
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield str(log_dir)
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            dur = time.perf_counter() - t0
+            if obs.enabled:
+                n = _count_trace_files(log_dir, exclude=pre)
+                obs.event("trace_capture", path=str(log_dir), n_traces=n,
+                          secs=round(dur, 6), **_json_safe(attrs))
+                from hfrep_tpu.obs import manifest as mf
+                mf.add_trace_link(obs.run_dir, str(log_dir), n_traces=n,
+                                  secs=round(dur, 6))
+
+
+def _trace_file_set(log_dir) -> frozenset:
+    """Every file currently under the capture root (empty when the dir
+    doesn't exist yet) — the pre-capture snapshot ``_count_trace_files``
+    subtracts so each capture reports its own output."""
+    try:
+        return frozenset(p for p in Path(log_dir).rglob("*") if p.is_file())
+    except OSError:
+        return frozenset()
+
+
+def _count_trace_files(log_dir, exclude: frozenset = frozenset()) -> int:
+    """How many xplane captures landed (every host/session writes one
+    ``*.xplane.pb``); falls back to a raw file count for older runtimes
+    that only emit ``trace.json.gz``.  ``exclude`` holds files from
+    earlier captures into the same root."""
+    try:
+        new = [p for p in Path(log_dir).rglob("*")
+               if p.is_file() and p not in exclude]
+    except OSError:
+        return 0
+    xplanes = [p for p in new if p.name.endswith(".xplane.pb")]
+    return len(xplanes) or len(new)
 
 
 def maybe_enable_from_env() -> Optional[Obs]:
@@ -434,3 +545,25 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
     wrapped.__wrapped__ = fn
     wrapped.__name__ = f"obs_instrumented_{name}"
     return wrapped
+
+
+def instrument_launch(fn, name: str, mesh=None, tcfg=None, jit: bool = True,
+                      sp: bool = False, **attrs):
+    """The ONE launch-factory wrapper over :func:`instrument_step` —
+    shared by every parallel step builder (dp, sp, tp, dp×sp, dp×tp,
+    dp×sp×tp, pp) so the hook contract cannot drift between them.
+
+    ``jit=False`` (a composition-internal raw step that a later builder
+    will wrap) returns ``fn`` unchanged, like the disabled-telemetry
+    case.  ``tcfg`` contributes the batch size, plus the sp pipeline
+    knobs when ``sp=True``; extra attrs ride through to the
+    ``parallel_build`` event.
+    """
+    if not jit:
+        return fn
+    if tcfg is not None:
+        attrs.setdefault("batch", tcfg.batch_size)
+        if sp:
+            attrs.setdefault("sp_microbatches", tcfg.sp_microbatches)
+            attrs.setdefault("sp_remat", tcfg.sp_remat)
+    return instrument_step(fn, name, mesh=mesh, **attrs)

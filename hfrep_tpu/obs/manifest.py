@@ -27,6 +27,12 @@ from typing import Optional
 
 MANIFEST_NAME = "run.json"
 
+#: manifest schema: v2 adds the optional ``traces`` list (xprof capture
+#: links appended by :func:`add_trace_link` /
+#: :func:`hfrep_tpu.obs.trace_capture`); readers accept v1 manifests
+#: unchanged — every v1 field survives, ``traces`` is simply absent.
+SCHEMA_VERSION = 2
+
 #: keys :func:`write_manifest` always emits (the completeness test and
 #: the report's self-test check against this list)
 REQUIRED_KEYS = ("schema_version", "run_id", "created_unix", "created",
@@ -100,7 +106,7 @@ def write_manifest(run_dir, extra: Optional[dict] = None,
     run_dir.mkdir(parents=True, exist_ok=True)
     now = time.time()
     doc = {
-        "schema_version": 1,
+        "schema_version": SCHEMA_VERSION,
         "run_id": run_dir.name,
         "created_unix": now,
         "created": time.strftime("%Y-%m-%dT%H:%M:%S%z", time.localtime(now)),
@@ -118,19 +124,37 @@ def write_manifest(run_dir, extra: Optional[dict] = None,
     return path
 
 
-def annotate(run_dir, fields: dict) -> None:
-    """Merge fields into an existing ``run.json`` (write one if absent —
-    annotation must not be order-coupled to :func:`write_manifest`)."""
+def _update_manifest(run_dir, mutate) -> None:
+    """Best-effort read-mutate-write of ``run.json`` (an empty doc when
+    absent or corrupt, write failures swallowed): the one durability
+    policy every post-hoc manifest writer shares — telemetry must never
+    fail the run it describes."""
     path = Path(run_dir) / MANIFEST_NAME
     try:
         doc = json.loads(path.read_text()) if path.exists() else {}
     except (OSError, json.JSONDecodeError):
         doc = {}
-    doc.update(fields)
+    mutate(doc)
     try:
         path.write_text(json.dumps(doc, indent=2, default=str) + "\n")
     except OSError:
         pass
+
+
+def annotate(run_dir, fields: dict) -> None:
+    """Merge fields into an existing ``run.json`` (write one if absent —
+    annotation must not be order-coupled to :func:`write_manifest`)."""
+    _update_manifest(run_dir, lambda doc: doc.update(fields))
+
+
+def add_trace_link(run_dir, trace_dir, **extra) -> None:
+    """Append one xprof capture link to the manifest's ``traces`` list
+    (schema v2) — best-effort like :func:`annotate`: linkage must never
+    fail the profiled run."""
+    _update_manifest(
+        run_dir,
+        lambda doc: doc.setdefault("traces", []).append(
+            {"path": str(trace_dir), **extra}))
 
 
 def read_manifest(run_dir) -> dict:

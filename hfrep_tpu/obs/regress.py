@@ -1,0 +1,259 @@
+"""Regression engine: robust rolling baselines over the run history.
+
+Given one run (a history record, ingested or not) and the history index
+(:mod:`hfrep_tpu.obs.history`), decide per metric whether the run
+regressed against the rolling baseline of *comparable* runs — same
+``(family, shape, mesh, host, backend)`` key — and produce a machine- and
+human-readable verdict.  This is the consumer the telemetry layer was
+missing: Podracer-style continuous throughput/MFU accounting
+(arXiv:2104.06272) needs something that remembers, not just reports.
+
+Baseline math — median/MAD, not mean/stddev: bench series carry
+occasional far outliers (a compile-heavy warmstart, a noisy-neighbor
+session) that would poison a mean baseline and inflate a stddev gate
+into uselessness.  The baseline is the **median** of the last
+``window`` comparable samples; the allowed deviation is
+
+    max(rel_tol * |median|,  mad_mult * 1.4826 * MAD,  abs_tol)
+
+— the relative-tolerance floor keeps a zero-MAD series (N identical
+CPU-fixture numbers) from flagging measurement jitter, the scaled MAD
+term (1.4826 ≈ consistency with σ under normality) adapts to genuinely
+noisy series, and ``abs_tol`` covers integer metrics like compile
+counts where ±1 is noise at any scale.
+
+Small-N behavior: fewer than ``min_runs`` comparable samples yields an
+``insufficient-history`` check that PASSES — a gate must not brick the
+first CI run on a new host/mesh; it starts enforcing once the series
+exists.  A run that measured *nothing at all* (every check ``missing``:
+empty event stream, writer killed before the first flush) fails as
+``no-data`` — a green gate with zero evidence would be the silently
+disarmed sentinel.  Direction matters: steps/sec and MFU regress
+*down*, step times, memory and compile counts regress *up*;
+improvements never fail.
+
+Stdlib-only, like the rest of the obs read path.
+"""
+
+from __future__ import annotations
+
+import json
+from statistics import median
+from typing import Dict, List, Optional
+
+from hfrep_tpu.obs.history import _num
+
+#: metric -> gate config.  ``direction``: "up" = higher is better.
+#: ``rel_tol`` is the relative floor on the allowed deviation, ``abs_tol``
+#: an absolute floor (integer-ish metrics), ``mad_mult`` scales the
+#: robust spread term.  Every threshold is overridable per metric via
+#: the CLI / function arguments; unlisted metrics (e.g. ``bench/...``
+#: gauges) gate with :data:`DEFAULT_RULE` and direction "up".
+DEFAULT_THRESHOLDS: Dict[str, dict] = {
+    "steps_per_sec":           {"direction": "up",   "rel_tol": 0.05,
+                                "mad_mult": 5.0},
+    "step_time_p50_s":         {"direction": "down", "rel_tol": 0.08,
+                                "mad_mult": 5.0},
+    "step_time_p95_s":         {"direction": "down", "rel_tol": 0.15,
+                                "mad_mult": 5.0},
+    "mfu":                     {"direction": "up",   "rel_tol": 0.05,
+                                "mad_mult": 5.0},
+    "memory_high_water_bytes": {"direction": "down", "rel_tol": 0.10,
+                                "mad_mult": 5.0},
+    "backend_compiles":        {"direction": "down", "rel_tol": 0.0,
+                                "abs_tol": 2.0, "mad_mult": 5.0},
+    "compile_secs":            {"direction": "down", "rel_tol": 0.50,
+                                "mad_mult": 5.0},
+    # bench_extra.py's lower-is-better emissions (epoch time, divergence
+    # from the reference distribution) — without these the fallback rule
+    # would invert their gates
+    "bench/ae_epoch_time_ms":  {"direction": "down", "rel_tol": 0.10,
+                                "mad_mult": 5.0},
+    "bench/js_div_regenerated": {"direction": "down", "rel_tol": 0.25,
+                                 "mad_mult": 5.0},
+}
+
+#: fallback rule for metrics without an entry above (bench gauges are
+#: throughput-like by convention: higher is better).  The suffix check
+#: in :func:`_rule_for` flips direction for names that are self-evidently
+#: costs — a future ``bench/foo_time_ms`` gauge must not gate inverted
+#: just because nobody added a table entry.
+DEFAULT_RULE = {"direction": "up", "rel_tol": 0.05, "mad_mult": 5.0}
+
+#: name suffixes that mark a metric as a cost (lower is better) when it
+#: has no explicit table entry.  Checked only after the rate suffixes —
+#: ``*_per_sec`` stays higher-is-better even though it ends in ``_sec``.
+_RATE_SUFFIXES = ("_per_sec", "_per_s", "/sec", "_rate", "_mfu")
+_COST_SUFFIXES = ("_ms", "_secs", "_sec", "_s", "_time", "_bytes", "_div",
+                  "_loss", "_count", "_compiles")
+
+#: MAD -> σ consistency constant under normality
+MAD_TO_SIGMA = 1.4826
+
+DEFAULT_WINDOW = 8
+DEFAULT_MIN_RUNS = 3
+
+
+def mad(values: List[float], center: Optional[float] = None) -> float:
+    """Median absolute deviation around ``center`` (default: the median)."""
+    c = median(values) if center is None else center
+    return median([abs(v - c) for v in values])
+
+
+def comparable_series(records: List[dict], key: dict,
+                      metric: str) -> List[float]:
+    """The metric's samples from records sharing the comparability key,
+    oldest-first (history is append-only, so file order IS time order)."""
+    out = []
+    for rec in records:
+        if rec.get("key") != key:
+            continue
+        v = _num((rec.get("metrics") or {}).get(metric))
+        if v is not None:
+            out.append(float(v))
+    return out
+
+
+def _rule_for(metric: str, thresholds: Optional[dict]) -> dict:
+    if metric in DEFAULT_THRESHOLDS:
+        base = dict(DEFAULT_THRESHOLDS[metric])
+    else:
+        base = dict(DEFAULT_RULE)
+        low = metric.lower()
+        if (not low.endswith(_RATE_SUFFIXES)
+                and low.endswith(_COST_SUFFIXES)):
+            base["direction"] = "down"
+    if thresholds and metric in thresholds:
+        override = thresholds[metric]
+        if isinstance(override, dict):
+            base.update(override)
+        else:
+            # bare number = EXACT relative tolerance: an explicit
+            # per-metric tolerance replaces the adaptive MAD term rather
+            # than being maxed against it (otherwise a tight override
+            # could never tighten a noisy series' gate)
+            base["rel_tol"] = float(override)
+            base["mad_mult"] = 0.0
+            base["abs_tol"] = 0.0
+    return base
+
+
+def check_metric(metric: str, observed, series: List[float], *,
+                 thresholds: Optional[dict] = None,
+                 min_runs: int = DEFAULT_MIN_RUNS,
+                 window: int = DEFAULT_WINDOW) -> dict:
+    """One metric's gate decision against its comparable series.
+
+    Returns ``{metric, status, baseline, observed, threshold, n, mad}``
+    with ``status`` in ``ok`` / ``regression`` / ``insufficient-history``
+    / ``missing`` (the run did not measure the metric — never a failure:
+    a CPU fixture has no device memory stats).
+    """
+    rule = _rule_for(metric, thresholds)
+    tail = series[-max(1, int(window)):]
+    # the enforcement floor can never exceed the window: --window 2
+    # --min-runs 3 would otherwise park every check in
+    # insufficient-history forever — a green gate that never gates
+    need = max(1, min(int(min_runs), max(1, int(window))))
+    value = _num(observed)     # ingest's filter: bool/NaN/inf are absent
+    if value is None:
+        return {"metric": metric, "status": "missing", "baseline": None,
+                "observed": None, "threshold": None, "n": len(tail),
+                "mad": None}
+    if len(tail) < need:
+        return {"metric": metric, "status": "insufficient-history",
+                "baseline": median(tail) if tail else None,
+                "observed": float(value), "threshold": None,
+                "n": len(tail), "mad": mad(tail) if tail else None}
+    base = median(tail)
+    spread = mad(tail, base)
+    allowed = max(float(rule.get("rel_tol", 0.0)) * abs(base),
+                  float(rule.get("mad_mult", 0.0)) * MAD_TO_SIGMA * spread,
+                  float(rule.get("abs_tol", 0.0)))
+    delta = (base - float(value) if rule["direction"] == "up"
+             else float(value) - base)          # positive = got worse
+    status = "regression" if delta > allowed else "ok"
+    return {"metric": metric, "status": status,
+            "baseline": round(base, 9), "observed": float(value),
+            "threshold": round(allowed, 9), "delta": round(delta, 9),
+            "direction": rule["direction"], "n": len(tail),
+            "mad": round(spread, 9)}
+
+
+def check_run(record: dict, records: List[dict], *,
+              thresholds: Optional[dict] = None,
+              min_runs: int = DEFAULT_MIN_RUNS,
+              window: int = DEFAULT_WINDOW,
+              metrics: Optional[List[str]] = None) -> dict:
+    """Gate one run record against the history: the full verdict.
+
+    ``records`` may or may not already contain this run — a sample with
+    the same (run_id, created_unix) is excluded from its own baseline,
+    so gate-after-ingest and gate-before-ingest agree.
+    """
+    key = record.get("key") or {}
+    prior = [r for r in records
+             if not (r.get("run_id") == record.get("run_id")
+                     and r.get("created_unix") == record.get("created_unix"))]
+    names = metrics if metrics is not None else list(
+        (record.get("metrics") or {}).keys())
+    checks = [
+        check_metric(m, (record.get("metrics") or {}).get(m),
+                     comparable_series(prior, key, m),
+                     thresholds=thresholds, min_runs=min_runs, window=window)
+        for m in names]
+    regressions = [c for c in checks if c["status"] == "regression"]
+    # a run that measured NOTHING (every check "missing" — empty event
+    # stream, OOM-killed before the first flush, broken emission) must
+    # not gate green: exit-0-with-zero-evidence is the silently-disarmed
+    # sentinel this module exists to close.  Individual missing metrics
+    # stay non-failing; it is the total absence that fails.
+    no_data = not any(c["status"] != "missing" for c in checks)
+    return {
+        "v": 2,
+        "run_id": record.get("run_id"),
+        "git_sha": record.get("git_sha"),
+        "key": key,
+        "ok": not regressions and not no_data,
+        "no_data": no_data,
+        "n_comparable": len([r for r in prior if r.get("key") == key]),
+        "regressions": [c["metric"] for c in regressions],
+        "checks": checks,
+    }
+
+
+# ------------------------------------------------------------- rendering
+_STATUS_GLYPH = {"ok": "ok  ", "regression": "FAIL", "missing": "--  ",
+                 "insufficient-history": "n={n} "}
+
+
+def render_verdict(verdict: dict) -> str:
+    """Human verdict: one line per metric, worst news first."""
+    word = ("NO-DATA" if verdict.get("no_data")
+            else "PASS" if verdict["ok"] else "REGRESSION")
+    head = word + (
+        f"  run {verdict['run_id']}  (key: "
+        f"family={verdict['key'].get('family')}, "
+        f"shape={verdict['key'].get('shape')}, "
+        f"host={verdict['key'].get('host')}, "
+        f"backend={verdict['key'].get('backend')}, "
+        f"mesh={verdict['key'].get('mesh')}; "
+        f"{verdict['n_comparable']} comparable runs)")
+    order = {"regression": 0, "ok": 1, "insufficient-history": 2,
+             "missing": 3}
+    lines = [head]
+    for c in sorted(verdict["checks"], key=lambda c: order[c["status"]]):
+        glyph = _STATUS_GLYPH[c["status"]].format(n=c["n"])
+        if c["status"] == "missing":
+            lines.append(f"  {glyph} {c['metric']:26s} (not measured)")
+            continue
+        base = "-" if c["baseline"] is None else f"{c['baseline']:.6g}"
+        thr = "-" if c["threshold"] is None else f"{c['threshold']:.3g}"
+        lines.append(
+            f"  {glyph} {c['metric']:26s} observed {c['observed']:.6g}"
+            f"  baseline {base} (n={c['n']})  allowed ±{thr}")
+    return "\n".join(lines)
+
+
+def verdict_json(verdict: dict) -> str:
+    return json.dumps(verdict, indent=2, default=str)

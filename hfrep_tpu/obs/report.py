@@ -1,4 +1,4 @@
-"""``python -m hfrep_tpu.obs report`` — summarize or diff run directories.
+"""``python -m hfrep_tpu.obs`` — the obs CLI: report / gate / ingest.
 
 Input is what the telemetry layer writes: ``run.json`` (manifest) and
 ``events.jsonl`` (span / metric / memory / event stream).  The headline
@@ -27,6 +27,7 @@ import argparse
 import json
 import math
 import sys
+import time
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
@@ -73,34 +74,41 @@ def parse_event(line: str, lineno: int = 0) -> Optional[dict]:
     return rec
 
 
-def load_events(run_dir, strict: bool = False) -> List[dict]:
-    """Parse + validate ``events.jsonl``.
-
-    The writer buffers (flushing every N events), so a run killed
-    mid-write — OOM kill, SIGKILL — leaves a torn final line.  Those are
-    exactly the runs whose telemetry must stay readable, so a final line
-    that is missing its newline and fails to parse is dropped with a
-    warning instead of failing the whole report.  Anything else — garbage
+def load_jsonl(path, parse_line, strict: bool = False,
+               torn_hint: str = "writer was likely killed mid-write",
+               ) -> List[dict]:
+    """The ONE torn-tail-tolerant JSONL loader (events AND the history
+    index share it, so the tail policy cannot diverge between them): a
+    final line missing its newline that fails ``parse_line`` is dropped
+    with a warning — appenders buffer, so a killed writer tears exactly
+    there and those files must stay readable.  Anything else — garbage
     mid-file, schema drift on a complete line — still raises
     :class:`SchemaError`; ``strict=True`` raises for the torn tail too
-    (the self-test uses it: the committed fixture must be whole).
-    """
-    path = Path(run_dir) / EVENTS_NAME
-    events = []
+    (the self-tests use it: committed fixtures must be whole)."""
+    path = Path(path)
+    records = []
     with open(path) as fh:
         lines = fh.readlines()
     for i, line in enumerate(lines, 1):
         try:
-            rec = parse_event(line, i)
+            rec = parse_line(line, i)
         except SchemaError:
             if not strict and i == len(lines) and not line.endswith("\n"):
                 print(f"warning: {path}: dropped torn final line {i} "
-                      "(run was likely killed mid-write)", file=sys.stderr)
+                      f"({torn_hint})", file=sys.stderr)
                 break
             raise
         if rec is not None:
-            events.append(rec)
-    return events
+            records.append(rec)
+    return records
+
+
+def load_events(run_dir, strict: bool = False) -> List[dict]:
+    """Parse + validate ``events.jsonl`` (torn-tail policy:
+    :func:`load_jsonl`)."""
+    return load_jsonl(Path(run_dir) / EVENTS_NAME, parse_event,
+                      strict=strict,
+                      torn_hint="run was likely killed mid-write")
 
 
 def _weighted_percentile(pairs: List[Tuple[float, float]], q: float) -> float:
@@ -279,6 +287,13 @@ def fixture_dir() -> Path:
     return Path(__file__).resolve().parent / "_fixture"
 
 
+def history_fixture_dir() -> Path:
+    """The committed history fixture: ≥3 clean run dirs + one multi-host
+    pair + one seeded-regression run + the pre-built ``history.jsonl``
+    index over the clean runs (tier-1's perf-regression tripwire)."""
+    return fixture_dir() / "history"
+
+
 def self_test() -> int:
     """Exercise the event-schema parser + summary on the fixture run.
 
@@ -316,30 +331,189 @@ def self_test() -> int:
     return 0
 
 
+def gate_self_test() -> int:
+    """Exercise the full history/regression loop on the committed
+    fixture: ingest, multi-host merge, baseline math, verdict shape and
+    the pass/fail decision — strict mode throughout, with ONE pure-JSON
+    result document on stdout (diagnostics go to stderr) so
+    ``tools/check.sh --format json`` consumers stay machine-parseable.
+
+    Wired into tier-1: if the writer, the store or the engine drift
+    apart, CI fails before a real run's history is corrupted.
+    """
+    import tempfile
+
+    from hfrep_tpu.obs import history as hist_mod
+    from hfrep_tpu.obs import regress
+
+    fx = history_fixture_dir()
+    try:
+        records = hist_mod.load_history(fx / "history.jsonl", strict=True)
+        if len(records) < 3:
+            raise SchemaError(f"fixture history holds {len(records)} "
+                              "records, need >= 3 for baseline math")
+
+        # the clean (un-indexed) run gates PASS against the committed
+        # index, with the baseline actually ENFORCED (n >= min_runs —
+        # an insufficient-history pass would not prove the math)
+        clean = hist_mod.summarize_run(fx / "run_d")
+        v_clean = regress.check_run(clean, records)
+        if not v_clean["ok"]:
+            raise SchemaError(
+                f"clean fixture run flagged: {v_clean['regressions']}")
+        if not any(c["status"] == "ok" and c["metric"] == "steps_per_sec"
+                   for c in v_clean["checks"]):
+            raise SchemaError("clean run's steps_per_sec was not enforced "
+                              "(insufficient history in the fixture index?)")
+
+        # the seeded regression gates FAIL, and the verdict names the
+        # metric, baseline, observed value and threshold (ISSUE 3
+        # acceptance shape)
+        bad = hist_mod.summarize_run(fx / "regressed")
+        v_bad = regress.check_run(bad, records)
+        if v_bad["ok"] or "steps_per_sec" not in v_bad["regressions"]:
+            raise SchemaError("seeded regression not flagged on "
+                              f"steps_per_sec: {v_bad['regressions']}")
+        (spc,) = [c for c in v_bad["checks"]
+                  if c["metric"] == "steps_per_sec"]
+        for field in ("metric", "baseline", "observed", "threshold"):
+            if spc.get(field) is None:
+                raise SchemaError(f"verdict check missing {field!r}")
+        if not spc["observed"] < spc["baseline"] - spc["threshold"]:
+            raise SchemaError("verdict numbers do not justify the flag")
+
+        # cross-host merge: conservative folds over the committed pair
+        merged = hist_mod.merge_run_dirs(fx / "multihost")
+        per = merged["per_host"]
+        if merged["hosts"] != 2 or len(per) != 2:
+            raise SchemaError(f"multihost merge saw {merged['hosts']} hosts")
+        rates = [h["steps_per_sec"] for h in per.values()]
+        if merged["steps_per_sec"] != min(rates):
+            raise SchemaError("merged steps/sec is not the min over hosts")
+        if merged["memory_high_water_bytes"] != max(
+                h["memory_high_water_bytes"] for h in per.values()):
+            raise SchemaError("merged memory high-water is not the max")
+        if merged["backend_compiles"] != sum(
+                h["backend_compiles"] for h in per.values()):
+            raise SchemaError("merged compile count is not the sum")
+
+        # ingest round trip + idempotency into a scratch index
+        with tempfile.TemporaryDirectory() as td:
+            scratch = Path(td) / "history.jsonl"
+            first = hist_mod.ingest(fx / "run_c", scratch)
+            again = hist_mod.ingest(fx / "run_c", scratch)
+            mh = hist_mod.ingest_multihost(fx / "multihost", scratch)
+            if not first["ingested"] or again["ingested"]:
+                raise SchemaError("ingest is not idempotent on "
+                                  "(run_id, created_unix)")
+            if not mh["ingested"] or mh["hosts"] != 2:
+                raise SchemaError("multihost ingest did not merge 2 hosts")
+            back = hist_mod.load_history(scratch, strict=True)
+            if len(back) != 2:
+                raise SchemaError(f"scratch index holds {len(back)} records,"
+                                  " expected 2")
+    except (OSError, json.JSONDecodeError, SchemaError, KeyError,
+            ValueError) as e:
+        print(f"obs gate self-test FAILED: {e}", file=sys.stderr)
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 1
+    print("obs gate self-test OK", file=sys.stderr)
+    print(json.dumps({
+        "ok": True,
+        "history_records": len(records),
+        "clean_run": {"run_id": v_clean["run_id"], "ok": True},
+        "regressed_run": {"run_id": v_bad["run_id"], "ok": False,
+                          "regressions": v_bad["regressions"],
+                          "steps_per_sec": {
+                              "baseline": spc["baseline"],
+                              "observed": spc["observed"],
+                              "threshold": spc["threshold"]}},
+        "multihost": {"hosts": merged["hosts"],
+                      "steps_per_sec": merged["steps_per_sec"]},
+    }))
+    return 0
+
+
 # -------------------------------------------------------------------- CLI
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m hfrep_tpu.obs",
-        description="summarize / diff telemetry run directories")
+        description="summarize / diff / gate telemetry run directories")
     sub = p.add_subparsers(dest="command", required=True)
+
     r = sub.add_parser("report", help="summarize one run dir or diff two")
     r.add_argument("run_dirs", nargs="*", help="1 run dir (summary) or "
                                                "2 (diff: second vs first)")
     r.add_argument("--format", choices=("human", "json"), default="human")
+    r.add_argument("--merge", action="store_true",
+                   help="treat each RUN_DIR as a multi-host launch parent "
+                        "(proc0/, proc1/, ...) and summarize the folded "
+                        "logical run (history.merge_run_dirs)")
     r.add_argument("--self-test", action="store_true",
                    help="validate the committed fixture run dir (CI gate)")
+
+    g = sub.add_parser(
+        "gate", help="perf-regression gate: one run vs the run history")
+    g.add_argument("run_dir", nargs="?",
+                   help="run dir to gate (omit with --self-test)")
+    g.add_argument("--history", default=None,
+                   help="history.jsonl index (default: $HFREP_HISTORY)")
+    g.add_argument("--format", choices=("human", "json"), default="human")
+    g.add_argument("--merge", action="store_true",
+                   help="RUN_DIR is a multi-host parent; gate the folded run")
+    g.add_argument("--ingest", action="store_true",
+                   help="append the run to the history AFTER a passing "
+                        "gate (a regressed run must not become its own "
+                        "baseline)")
+    g.add_argument("--min-runs", type=int, default=None, metavar="N",
+                   help="comparable runs required before enforcing "
+                        "(default 3; fewer passes as insufficient-history)")
+    g.add_argument("--window", type=int, default=None, metavar="N",
+                   help="rolling baseline window (last N comparable runs)")
+    g.add_argument("--threshold", action="append", default=None,
+                   metavar="METRIC=REL_TOL",
+                   help="set a metric's EXACT relative tolerance (replaces "
+                        "the adaptive MAD term), e.g. steps_per_sec=0.08 "
+                        "(repeatable)")
+    g.add_argument("--self-test", action="store_true",
+                   help="exercise ingest/merge/baseline/verdict on the "
+                        "committed history fixture (CI gate; pure-JSON "
+                        "stdout)")
+
+    i = sub.add_parser(
+        "ingest", help="append a run dir to a history.jsonl index")
+    i.add_argument("run_dir")
+    i.add_argument("--history", required=True)
+    i.add_argument("--merge", action="store_true",
+                   help="RUN_DIR is a multi-host parent; ingest the "
+                        "folded logical run")
     return p
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+def _parse_threshold_overrides(pairs):
+    if not pairs:
+        return None
+    out = {}
+    for pair in pairs:
+        metric, _, tol = pair.partition("=")
+        if not metric or not tol:
+            raise ValueError(f"--threshold wants METRIC=REL_TOL, got {pair!r}")
+        out[metric] = float(tol)
+    return out
+
+
+def _cmd_report(args) -> int:
     if args.self_test:
         return self_test()
     if not 1 <= len(args.run_dirs) <= 2:
         print("report wants 1 run dir (summary) or 2 (diff)", file=sys.stderr)
         return 2
     try:
-        summaries = [summarize(d) for d in args.run_dirs]
+        if args.merge:
+            from hfrep_tpu.obs.history import merge_run_dirs
+            summaries = [merge_run_dirs(d) for d in args.run_dirs]
+        else:
+            summaries = [summarize(d) for d in args.run_dirs]
     except (OSError, SchemaError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -353,6 +527,71 @@ def main(argv=None) -> int:
     else:
         print(render_diff(summaries[0], summaries[1]))
     return 0
+
+
+def _cmd_gate(args) -> int:
+    import os
+
+    from hfrep_tpu.obs import history as hist_mod
+    from hfrep_tpu.obs import regress
+
+    if args.self_test:
+        return gate_self_test()
+    if not args.run_dir:
+        print("gate wants a run dir (or --self-test)", file=sys.stderr)
+        return 2
+    history_path = args.history or os.environ.get("HFREP_HISTORY")
+    if not history_path:
+        print("gate wants --history PATH (or $HFREP_HISTORY)",
+              file=sys.stderr)
+        return 2
+    try:
+        overrides = _parse_threshold_overrides(args.threshold)
+        record = (hist_mod.merged_record(args.run_dir) if args.merge
+                  else hist_mod.summarize_run(args.run_dir))
+        records = hist_mod.load_history(history_path)
+        kw = {"thresholds": overrides}
+        if args.min_runs is not None:
+            kw["min_runs"] = args.min_runs
+        if args.window is not None:
+            kw["window"] = args.window
+        verdict = regress.check_run(record, records, **kw)
+    except (OSError, SchemaError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(regress.verdict_json(verdict))
+    else:
+        print(regress.render_verdict(verdict))
+    if verdict["ok"] and args.ingest:
+        try:
+            ok = hist_mod.append_record(
+                history_path, dict(record, ingested_unix=round(time.time(), 3)),
+                records=records)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(("ingested into" if ok else "already indexed in")
+              + f" {history_path}", file=sys.stderr)
+    return 0 if verdict["ok"] else 1
+
+
+def _cmd_ingest(args) -> int:
+    from hfrep_tpu.obs import history as hist_mod
+    try:
+        rec = (hist_mod.ingest_multihost(args.run_dir, args.history)
+               if args.merge else hist_mod.ingest(args.run_dir, args.history))
+    except (OSError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(rec, indent=2, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"report": _cmd_report, "gate": _cmd_gate,
+            "ingest": _cmd_ingest}[args.command](args)
 
 
 if __name__ == "__main__":

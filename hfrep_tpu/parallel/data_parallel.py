@@ -99,7 +99,6 @@ def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     fn = wrap_batch_parallel(inner, mesh, axis_name, controlled_sampling)
     # telemetry hook — decided at build time: a no-op (fn returned
     # unchanged, zero wrapper frames) unless hfrep_tpu.obs is enabled
-    from hfrep_tpu.obs import instrument_step
-    return instrument_step(fn, "dp_multi_step", mesh=mesh,
-                           batch=tcfg.batch_size,
-                           steps_per_call=tcfg.steps_per_call)
+    from hfrep_tpu.obs import instrument_launch
+    return instrument_launch(fn, "dp_multi_step", mesh=mesh, tcfg=tcfg,
+                             steps_per_call=tcfg.steps_per_call)

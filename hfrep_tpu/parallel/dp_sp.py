@@ -156,13 +156,11 @@ def make_dp_sp_train_step(pair: GanPair, tcfg: TrainConfig,
 def _instrument(fn, name: str, mesh: Mesh, tcfg: TrainConfig, jit: bool):
     """The launch paths' telemetry hook: build-time no-op (``fn``
     returned unchanged) when obs is disabled or the caller asked for the
-    raw un-jitted step (composition builds must stay wrappable)."""
-    if not jit:
-        return fn
-    from hfrep_tpu.obs import instrument_step
-    return instrument_step(fn, name, mesh=mesh, batch=tcfg.batch_size,
-                           sp_microbatches=tcfg.sp_microbatches,
-                           sp_remat=tcfg.sp_remat)
+    raw un-jitted step (composition builds must stay wrappable).
+    Delegates to the one shared contract in ``hfrep_tpu.obs``."""
+    from hfrep_tpu.obs import instrument_launch
+    return instrument_launch(fn, name, mesh=mesh, tcfg=tcfg, jit=jit,
+                             sp=True)
 
 
 def make_dp_sp_multi_step(pair: GanPair, tcfg: TrainConfig,

@@ -288,7 +288,7 @@ def make_pp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     if not jit:
         return step
     # telemetry hook — build-time no-op unless hfrep_tpu.obs is enabled
-    from hfrep_tpu.obs import instrument_step
-    return instrument_step(_jit_replicated_out(step, mesh),
-                           "pp_train_step", mesh=mesh,
-                           batch=tcfg.batch_size, microbatches=m_eff)
+    from hfrep_tpu.obs import instrument_launch
+    return instrument_launch(_jit_replicated_out(step, mesh),
+                             "pp_train_step", mesh=mesh, tcfg=tcfg,
+                             microbatches=m_eff)

@@ -585,7 +585,7 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     # Mirror the dp×sp builder's build-time checks (dp_sp.py:87-103) so a
     # bad M refuses here rather than on the first call inside _sp_pipeline.
     n_sp = mesh.shape[axis_name]
-    m_eff = n_sp if microbatches is None else microbatches
+    m_eff = _effective_sp_microbatches(mesh, axis_name, tcfg, microbatches)
     if m_eff < 1:
         raise ValueError(f"sp_microbatches must be >= 1, got {m_eff}")
     if tcfg.batch_size % m_eff:
@@ -614,7 +614,16 @@ def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                                      microbatches=microbatches,
                                      backend=backend, remat=remat)
     step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
-    return _jit_replicated_out(step, mesh) if jit else step
+    if not jit:
+        return step
+    from hfrep_tpu.obs import instrument_launch
+    # sp_microbatches passed explicitly: the telemetry must report the
+    # effective M (kwarg > config > one-per-device), not whatever
+    # tcfg.sp_microbatches happens to hold — a microbatch sweep's points
+    # would otherwise all log the same value.
+    return instrument_launch(_jit_replicated_out(step, mesh),
+                             "sp_train_step", mesh=mesh, tcfg=tcfg, sp=True,
+                             sp_microbatches=m_eff)
 
 
 def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -635,7 +644,27 @@ def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                               axis_name=axis_name,
                               microbatches=microbatches, jit=False)
     multi = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _jit_replicated_out(multi, mesh) if jit else multi
+    if not jit:
+        return multi
+    # telemetry hook — the shared build-time contract (obs disabled ⇒
+    # the raw jitted step back, zero wrapper frames)
+    from hfrep_tpu.obs import instrument_launch
+    m_eff = _effective_sp_microbatches(
+        mesh, _resolve_axis(mesh, axis_name), tcfg, microbatches)
+    return instrument_launch(_jit_replicated_out(multi, mesh),
+                             "sp_multi_step", mesh=mesh, tcfg=tcfg, sp=True,
+                             sp_microbatches=m_eff)
+
+
+def _effective_sp_microbatches(mesh: Mesh, axis_name: str, tcfg,
+                               microbatches: Optional[int]) -> int:
+    """The M the sp pipeline actually runs: explicit kwarg beats
+    ``TrainConfig.sp_microbatches`` beats one microbatch per sp device.
+    Both sp builders and their telemetry attrs resolve through here so
+    a sweep's ``parallel_build`` events report the swept value."""
+    if microbatches is None:
+        microbatches = tcfg.sp_microbatches
+    return mesh.shape[axis_name] if microbatches is None else microbatches
 
 
 def _jit_replicated_out(fn, mesh: Mesh):

@@ -354,6 +354,7 @@ def make_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     arithmetically.  No gradient normalization is needed: nothing
     shards the batch, and the slice-transpose psums already hand every
     device the full parameter gradients (module docstring)."""
+    from hfrep_tpu.obs import instrument_launch
     from hfrep_tpu.train.steps import make_train_step
 
     axis_name = _resolve_tp_axis(mesh, axis_name)
@@ -361,7 +362,8 @@ def make_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     _validate_tp_backend(tcfg)
     inner = make_train_step(pair, tcfg, dataset,
                             apply_fns=_tp_apply_fns(pair, axis_name))
-    return _wrap_replicated(inner, mesh, jit)
+    return instrument_launch(_wrap_replicated(inner, mesh, jit),
+                             "tp_train_step", mesh=mesh, tcfg=tcfg, jit=jit)
 
 
 def make_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -369,6 +371,7 @@ def make_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     """``tcfg.steps_per_call`` tp epochs scanned into ONE compiled
     program — the dispatch-amortized launch shape (same argument as
     :func:`~hfrep_tpu.train.steps.make_multi_step`)."""
+    from hfrep_tpu.obs import instrument_launch
     from hfrep_tpu.train.steps import make_multi_step, make_train_step
 
     axis_name = _resolve_tp_axis(mesh, axis_name)
@@ -377,7 +380,8 @@ def make_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     step = make_train_step(pair, tcfg, dataset,
                            apply_fns=_tp_apply_fns(pair, axis_name))
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _wrap_replicated(inner, mesh, jit)
+    return instrument_launch(_wrap_replicated(inner, mesh, jit),
+                             "tp_multi_step", mesh=mesh, tcfg=tcfg, jit=jit)
 
 
 def _split_dp_tp(mesh: Mesh) -> Tuple[str, str]:
@@ -418,10 +422,13 @@ def make_dp_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     (proven by check_vma).  ``controlled_sampling=True`` follows the
     single-device sample stream at the same global batch — the
     trajectory-test mode (tests/test_tensor_parallel.py)."""
+    from hfrep_tpu.obs import instrument_launch
     from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
 
     inner = _make_dp_tp_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)
+    return instrument_launch(
+        wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit),
+        "dp_tp_train_step", mesh=mesh, tcfg=tcfg, jit=jit)
 
 
 def make_dp_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
@@ -430,9 +437,12 @@ def make_dp_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
     """``tcfg.steps_per_call`` dp×tp epochs scanned into ONE compiled
     program — the launch shape for real runs (the trainer dispatches
     this from its ordinary block loop)."""
+    from hfrep_tpu.obs import instrument_launch
     from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
     from hfrep_tpu.train.steps import make_multi_step
 
     step = _make_dp_tp_inner(pair, tcfg, dataset, mesh, controlled_sampling)
     inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit)
+    return instrument_launch(
+        wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit),
+        "dp_tp_multi_step", mesh=mesh, tcfg=tcfg, jit=jit)

@@ -70,5 +70,11 @@ class StepTimer:
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    with jax.profiler.trace(log_dir):
+    """On-demand XLA profile — now via :func:`hfrep_tpu.obs.trace_capture`,
+    so when telemetry is enabled the capture is recorded in the event
+    stream and linked from ``run.json`` (path + xplane count) instead of
+    living entirely outside the run's record."""
+    from hfrep_tpu.obs import trace_capture
+
+    with trace_capture(log_dir):
         yield

@@ -1,0 +1,454 @@
+"""hfrep_tpu.obs history store, regression engine, gate CLI, cross-host
+merge and xprof trace links (ISSUE 3 acceptance)."""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.obs import history as hist_mod
+from hfrep_tpu.obs import regress
+from hfrep_tpu.obs import report as report_mod
+from hfrep_tpu.obs.manifest import read_manifest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FX = report_mod.history_fixture_dir()
+HIST = FX / "history.jsonl"
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    obs_pkg.disable()
+    yield
+    obs_pkg.disable()
+
+
+# ---------------------------------------------------------------- ingest
+def test_ingest_record_shape_and_key(tmp_path):
+    rec = hist_mod.ingest(FX / "run_a", tmp_path / "h.jsonl")
+    assert rec["v"] == hist_mod.HISTORY_SCHEMA_VERSION
+    assert rec["ingested"] is True
+    assert rec["run_id"] == "run_a"
+    assert rec["key"] == {"family": "mtss_wgan_gp", "shape": "w48f35h100b32",
+                          "mesh": None, "host": "fixturehost",
+                          "backend": "cpu"}
+    m = rec["metrics"]
+    assert m["steps_per_sec"] == pytest.approx(551.0, abs=1.0)
+    assert m["step_time_p50_s"] == pytest.approx(0.0907 / 50, rel=1e-3)
+    assert 0 < m["mfu"] < 1
+    assert m["memory_high_water_bytes"] == 174000
+    assert m["backend_compiles"] == 1
+    # bench/ gauges ride into the record as first-class metrics
+    assert m["bench/headline_steps_per_sec"] == pytest.approx(551.0, abs=1.0)
+    # and the line round-trips through the loader
+    (back,) = hist_mod.load_history(tmp_path / "h.jsonl", strict=True)
+    assert back["metrics"]["steps_per_sec"] == m["steps_per_sec"]
+
+
+def test_ingest_is_idempotent_on_run_identity(tmp_path):
+    h = tmp_path / "h.jsonl"
+    assert hist_mod.ingest(FX / "run_a", h)["ingested"] is True
+    assert hist_mod.ingest(FX / "run_a", h)["ingested"] is False
+    assert len(hist_mod.load_history(h)) == 1
+    # a different run still appends
+    assert hist_mod.ingest(FX / "run_b", h)["ingested"] is True
+    assert len(hist_mod.load_history(h)) == 2
+
+
+def test_ingest_tolerates_torn_event_tail(tmp_path, capsys):
+    """A run killed mid-write must still be ingestable — crashed runs
+    are exactly the ones a regression hunt wants in the index."""
+    run = tmp_path / "run_torn"
+    shutil.copytree(FX / "run_a", run)
+    whole = (run / "events.jsonl").read_text()
+    (run / "events.jsonl").write_text(
+        whole.rstrip("\n")[:-25])          # torn final line, no newline
+    rec = hist_mod.ingest(run, tmp_path / "h.jsonl")
+    assert rec["ingested"] is True
+    assert rec["metrics"]["steps_per_sec"] == pytest.approx(551.0, abs=1.0)
+    assert "torn final line" in capsys.readouterr().err
+
+
+def test_append_after_torn_tail_truncates_not_fuses(tmp_path, capsys):
+    """Appending to a history whose writer was killed mid-line must drop
+    the torn fragment first — writing straight after it would fuse the
+    new record onto the fragment, turning recoverable tail damage into
+    permanent mid-file garbage that fails every later load."""
+    h = tmp_path / "h.jsonl"
+    hist_mod.ingest(FX / "run_a", h)
+    h.write_text(h.read_text() + '{"v": 2, "kind": "run", "run')  # torn
+    rec = hist_mod.ingest(FX / "run_b", h)
+    assert rec["ingested"] is True
+    assert "truncated torn final line" in capsys.readouterr().err
+    back = hist_mod.load_history(h, strict=True)          # no garbage left
+    assert [r["run_id"] for r in back] == ["run_a", "run_b"]
+
+
+def test_append_keeps_complete_record_missing_only_newline(tmp_path):
+    """A final record whose writer died between the '}' and the newline
+    parses fine — it is data the reader accepts, not damage — so append
+    must supply the newline, not delete an indexed baseline sample."""
+    h = tmp_path / "h.jsonl"
+    hist_mod.ingest(FX / "run_a", h)
+    hist_mod.ingest(FX / "run_b", h)
+    h.write_text(h.read_text().rstrip("\n"))              # torn newline only
+    assert len(hist_mod.load_history(h)) == 2             # reader accepts it
+    rec = hist_mod.ingest(FX / "run_c", h)
+    assert rec["ingested"] is True
+    back = hist_mod.load_history(h, strict=True)
+    assert [r["run_id"] for r in back] == ["run_a", "run_b", "run_c"]
+
+
+def test_history_loader_torn_tail_and_strictness(tmp_path):
+    h = tmp_path / "h.jsonl"
+    hist_mod.ingest(FX / "run_a", h)
+    hist_mod.ingest(FX / "run_b", h)
+    good = h.read_text()
+    h.write_text(good + '{"v": 2, "kind": "run", "run')   # torn append
+    assert len(hist_mod.load_history(h)) == 2             # dropped, kept prefix
+    with pytest.raises(report_mod.SchemaError):
+        hist_mod.load_history(h, strict=True)
+    # a COMPLETE bad line (newline present) is schema drift: always raises
+    h.write_text(good + '{"v": 99, "kind": "run"}\n')
+    with pytest.raises(report_mod.SchemaError):
+        hist_mod.load_history(h)
+
+
+# ------------------------------------------------------- cross-host merge
+def test_merge_multihost_conservative_folds():
+    merged = hist_mod.merge_run_dirs(FX / "multihost")
+    per = merged["per_host"]
+    assert merged["hosts"] == 2 and set(per) == {"proc0", "proc1"}
+    rates = [p["steps_per_sec"] for p in per.values()]
+    assert merged["steps_per_sec"] == min(rates)          # slowest host gates
+    assert merged["step_time_p95_s"] == max(
+        p["step_time_p95_s"] for p in per.values())
+    assert merged["memory_high_water_bytes"] == max(
+        p["memory_high_water_bytes"] for p in per.values())
+    assert merged["backend_compiles"] == sum(
+        p["backend_compiles"] for p in per.values())
+    assert merged["blocks"]["n"] == 10 and merged["blocks"]["steady"] == 8
+
+
+def test_ingest_multihost_records_one_logical_run(tmp_path):
+    h = tmp_path / "h.jsonl"
+    rec = hist_mod.ingest_multihost(FX / "multihost", h)
+    assert rec["ingested"] is True and rec["hosts"] == 2
+    assert rec["key"]["mesh"] == {"dp": 2}    # pod runs index their own series
+    (back,) = hist_mod.load_history(h)
+    assert back["metrics"]["steps_per_sec"] == rec["metrics"]["steps_per_sec"]
+
+
+def test_merged_key_host_is_pod_stable_not_leader():
+    """The pod key must not depend on which node happened to be proc0 (a
+    per-launch leader hostname would give every pod run a fresh series —
+    a gate that never enforces), and a single proc dir ingested without
+    --merge (un-folded metrics) must not collide with the pod's series."""
+    pod = hist_mod.merged_record(FX / "multihost")
+    assert pod["key"]["host"] == "pod2:fixturehost"
+    single = hist_mod.summarize_run(FX / "multihost" / "proc0")
+    assert single["key"]["host"] == "fixturehost"
+    assert single["key"] != pod["key"]
+
+
+def test_run_key_separates_program_shapes(tmp_path):
+    """Same family+host, different model shape => different series: a
+    window=168 production run must not blend into the window=48 headline
+    baseline (the two differ ~3.5x in steps/sec by construction)."""
+    run = tmp_path / "run_prod"
+    shutil.copytree(FX / "run_a", run)
+    man = json.loads((run / "run.json").read_text())
+    man["config"]["model"]["window"] = 168
+    man["config"]["model"]["features"] = 36
+    (run / "run.json").write_text(json.dumps(man))
+    headline = hist_mod.summarize_run(FX / "run_a")["key"]
+    prod = hist_mod.summarize_run(run)["key"]
+    assert headline["shape"] == "w48f35h100b32"
+    assert prod["shape"] == "w168f36h100b32"
+    assert headline != prod
+    # no annotated config at all -> shapeless, its own series
+    del man["config"]
+    (run / "run.json").write_text(json.dumps(man))
+    assert hist_mod.summarize_run(run)["key"]["shape"] is None
+
+
+def test_merge_refuses_empty_parent(tmp_path):
+    with pytest.raises(report_mod.SchemaError):
+        hist_mod.merge_run_dirs(tmp_path)
+
+
+def test_report_merge_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "report", "--merge",
+         str(FX / "multihost"), "--format", "json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["hosts"] == 2
+    assert doc["steps_per_sec"] == pytest.approx(537.3, abs=0.5)
+
+
+# --------------------------------------------------------- baseline math
+def test_median_and_mad():
+    assert regress.median([3.0, 1.0, 2.0]) == 2.0
+    assert regress.median([4.0, 1.0, 3.0, 2.0]) == 2.5
+    assert regress.mad([1.0, 2.0, 3.0, 100.0]) == 1.0     # outlier-immune
+    assert regress.mad([5.0, 5.0, 5.0]) == 0.0
+
+
+def test_check_metric_small_n_passes_as_insufficient():
+    c = regress.check_metric("steps_per_sec", 100.0, [553.0, 551.0])
+    assert c["status"] == "insufficient-history" and c["n"] == 2
+    # ... even though the value would regress against a fuller series
+    c = regress.check_metric("steps_per_sec", 100.0, [553.0, 551.0, 555.0])
+    assert c["status"] == "regression"
+
+
+def test_check_metric_window_clamps_enforcement_floor():
+    """--window below --min-runs must not park the gate in
+    insufficient-history forever (a green gate that never gates): the
+    enforcement floor clamps to the window."""
+    series = [553.0, 551.0, 555.0, 552.0]
+    c = regress.check_metric("steps_per_sec", 100.0, series,
+                             window=2, min_runs=3)
+    assert c["status"] == "regression" and c["n"] == 2
+
+
+def test_check_metric_directions_and_floors():
+    series = [553.0] * 5                                   # zero MAD
+    # rel_tol floor keeps identical-sample series from flagging jitter
+    assert regress.check_metric("steps_per_sec", 552.0,
+                                series)["status"] == "ok"
+    assert regress.check_metric("steps_per_sec", 500.0,
+                                series)["status"] == "regression"
+    # improvements never fail, in either direction
+    assert regress.check_metric("steps_per_sec", 600.0,
+                                series)["status"] == "ok"
+    assert regress.check_metric("step_time_p95_s", 0.0001,
+                                [0.0018] * 4)["status"] == "ok"
+    # step time regresses UP
+    assert regress.check_metric("step_time_p95_s", 0.0040,
+                                [0.0018] * 4)["status"] == "regression"
+    # compile counts: ±abs_tol is noise, beyond it is a retracing bug
+    assert regress.check_metric("backend_compiles", 3,
+                                [1.0, 1.0, 1.0])["status"] == "ok"
+    assert regress.check_metric("backend_compiles", 9,
+                                [1.0, 1.0, 1.0])["status"] == "regression"
+    # a missing measurement is never a failure
+    assert regress.check_metric("mfu", None,
+                                [0.1, 0.1, 0.1])["status"] == "missing"
+
+
+def test_check_metric_mad_widens_noisy_series():
+    noisy = [500.0, 560.0, 520.0, 545.0, 505.0]           # MAD 20
+    c = regress.check_metric("steps_per_sec", 470.0, noisy)
+    # 5 * 1.4826 * 20 ≈ 148 allowed: well inside for a series this loud
+    assert c["status"] == "ok"
+    tight = [520.0, 521.0, 519.0, 520.0, 520.0]
+    assert regress.check_metric("steps_per_sec", 470.0,
+                                tight)["status"] == "regression"
+
+
+def test_threshold_overrides_and_unknown_metric_rule():
+    series = [100.0] * 4
+    # bare-number override = rel_tol shorthand
+    assert regress.check_metric(
+        "steps_per_sec", 98.0, series,
+        thresholds={"steps_per_sec": 0.001})["status"] == "regression"
+    # dict override can flip direction
+    assert regress.check_metric(
+        "steps_per_sec", 98.0, series,
+        thresholds={"steps_per_sec": {"direction": "down"}})["status"] == "ok"
+    # unlisted metrics (bench gauges) default to higher-is-better
+    assert regress.check_metric("bench/custom", 80.0,
+                                series)["status"] == "regression"
+
+
+def test_cost_shaped_gauges_gate_lower_is_better():
+    """bench_extra's emissions are costs: slower/more-divergent must
+    FAIL and improvements must pass — the inverse of throughput gauges."""
+    series = [100.0, 101.0, 99.0]
+    assert regress.check_metric("bench/ae_epoch_time_ms", 200.0,
+                                series)["status"] == "regression"
+    assert regress.check_metric("bench/ae_epoch_time_ms", 80.0,
+                                series)["status"] == "ok"
+    assert regress.check_metric("bench/js_div_regenerated", 0.5,
+                                [0.01, 0.012, 0.011])["status"] == "regression"
+    assert regress.check_metric("bench/js_div_regenerated", 0.001,
+                                [0.01, 0.012, 0.011])["status"] == "ok"
+    # unlisted cost-shaped names flip via the suffix heuristic ...
+    assert regress.check_metric("bench/warmup_compile_secs", 300.0,
+                                series)["status"] == "regression"
+    assert regress.check_metric("bench/peak_rss_bytes", 250.0,
+                                series)["status"] == "regression"
+    # ... while rate-shaped names stay higher-is-better despite "_sec"
+    assert regress.check_metric("bench/sp_prod_steps_per_sec", 80.0,
+                                series)["status"] == "regression"
+    assert regress.check_metric("bench/sp_prod_steps_per_sec", 120.0,
+                                series)["status"] == "ok"
+
+
+def test_check_run_fails_when_nothing_was_measured(tmp_path):
+    """A run that measured NOTHING (empty event stream — OOM-killed
+    before the first flush, broken emission) must not gate green: exit 0
+    with zero evidence is the silently-disarmed sentinel.  Individually
+    missing metrics stay non-failing; only total absence fails."""
+    records = hist_mod.load_history(HIST)
+    run = tmp_path / "run_empty"
+    shutil.copytree(FX / "run_d", run)
+    (run / "events.jsonl").write_text("")
+    v = regress.check_run(hist_mod.summarize_run(run), records)
+    assert v["no_data"] is True and v["ok"] is False
+    assert v["regressions"] == []              # absence, not a regression
+    assert regress.render_verdict(v).startswith("NO-DATA")
+    proc = _gate(str(run), "--history", str(HIST))
+    assert proc.returncode == 1
+    # the real run_d still passes, with no_data reported False
+    v = regress.check_run(hist_mod.summarize_run(FX / "run_d"), records)
+    assert v["ok"] is True and v["no_data"] is False
+
+
+def test_check_run_excludes_itself_from_baseline():
+    records = hist_mod.load_history(HIST)
+    rec = hist_mod.summarize_run(FX / "run_c")            # indexed run
+    v = regress.check_run(rec, records)
+    (c,) = [c for c in v["checks"] if c["metric"] == "steps_per_sec"]
+    assert c["n"] == 2, "run_c leaked into its own baseline"
+
+
+def test_comparable_series_respects_key():
+    records = hist_mod.load_history(HIST)
+    single = hist_mod.summarize_run(FX / "run_a")["key"]
+    assert len(regress.comparable_series(
+        records, single, "steps_per_sec")) == 3
+    # the dp=2 multihost record is its own series, not the single-host one
+    pod_key = dict(single, mesh={"dp": 2}, host="pod2:fixturehost")
+    assert len(regress.comparable_series(
+        records, pod_key, "steps_per_sec")) == 1
+
+
+# -------------------------------------------------------------- gate CLI
+def _gate(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "gate", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+
+
+def test_gate_cli_clean_fixture_exits_zero():
+    proc = _gate(str(FX / "run_d"), "--history", str(HIST))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.startswith("PASS")
+
+
+def test_gate_cli_seeded_regression_exits_nonzero_with_named_verdict():
+    """The ISSUE 3 acceptance shape: nonzero exit + a JSON verdict naming
+    metric, baseline, observed value and threshold."""
+    proc = _gate(str(FX / "regressed"), "--history", str(HIST),
+                 "--format", "json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)                          # stdout pure JSON
+    assert doc["ok"] is False
+    assert "steps_per_sec" in doc["regressions"]
+    (c,) = [c for c in doc["checks"] if c["metric"] == "steps_per_sec"]
+    assert c["status"] == "regression"
+    assert c["baseline"] == pytest.approx(552.8, abs=0.5)
+    assert c["observed"] < c["baseline"] - c["threshold"]
+    assert c["threshold"] > 0
+
+
+def test_gate_cli_threshold_override_and_ingest_on_pass(tmp_path):
+    h = tmp_path / "h.jsonl"
+    shutil.copy(HIST, h)
+    # an absurdly tight tolerance turns the clean run into a failure —
+    # and a failing gate must NOT ingest (it would poison its baseline)
+    proc = _gate(str(FX / "run_d"), "--history", str(h),
+                 "--threshold", "steps_per_sec=0.0001", "--ingest")
+    assert proc.returncode == 1
+    assert len(hist_mod.load_history(h)) == 4
+    # at default thresholds it passes and --ingest appends exactly once
+    proc = _gate(str(FX / "run_d"), "--history", str(h), "--ingest")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert len(hist_mod.load_history(h)) == 5
+
+
+def test_gate_cli_merge_gates_the_folded_run(tmp_path):
+    h = tmp_path / "h.jsonl"
+    for _ in range(3):          # 3 identical pod samples = enforced baseline
+        rec = hist_mod.merged_record(FX / "multihost")
+        rec["created_unix"] = rec["created_unix"] + _     # distinct identity
+        hist_mod.append_record(h, rec)
+    proc = _gate(str(FX / "multihost"), "--history", str(h), "--merge")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_gate_cli_usage_errors():
+    assert _gate().returncode == 2                         # no run dir
+    assert _gate(str(FX / "run_d")).returncode == 2        # no history
+
+
+def test_gate_self_test_pure_json_stdout():
+    proc = _gate("--self-test")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)          # the WHOLE stdout is one JSON doc
+    assert doc["ok"] is True
+    assert doc["regressed_run"]["regressions"]
+    spc = doc["regressed_run"]["steps_per_sec"]
+    assert spc["observed"] < spc["baseline"] - spc["threshold"]
+
+
+def test_ingest_cli_roundtrip(tmp_path):
+    h = tmp_path / "h.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "ingest",
+         str(FX / "run_a"), "--history", str(h)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["ingested"] is True
+    assert len(hist_mod.load_history(h)) == 1
+
+
+# -------------------------------------------------------- xprof linkage
+def test_trace_capture_links_into_manifest_and_stream(tmp_path):
+    jax = pytest.importorskip("jax")
+    run_dir = tmp_path / "run"
+    obs_pkg.enable(run_dir, compile_listener=False)
+    try:
+        with obs_pkg.trace_capture() as trace_dir:
+            jax.numpy.ones(8).block_until_ready()
+    except Exception as e:          # profiler unavailable in odd sandboxes
+        obs_pkg.disable()
+        pytest.skip(f"jax.profiler unusable here: {e!r}")
+    obs_pkg.disable()
+    assert trace_dir == str(run_dir / "traces")
+    doc = read_manifest(run_dir)
+    (link,) = doc["traces"]
+    assert link["path"] == trace_dir
+    assert link["n_traces"] >= 1               # the xplane capture landed
+    events = report_mod.load_events(run_dir)
+    (ev,) = [e for e in events if e["type"] == "event"
+             and e["name"] == "trace_capture"]
+    assert ev["path"] == trace_dir and ev["n_traces"] == link["n_traces"]
+
+
+def test_trace_capture_explicit_dir_without_obs(tmp_path):
+    jax = pytest.importorskip("jax")
+    target = tmp_path / "prof"
+    try:
+        with obs_pkg.trace_capture(target) as trace_dir:
+            jax.numpy.ones(8).block_until_ready()
+    except Exception as e:
+        pytest.skip(f"jax.profiler unusable here: {e!r}")
+    assert trace_dir == str(target)
+    assert any(target.rglob("*"))              # capture happened, no linkage
+    assert not obs_pkg.is_enabled()
+
+
+def test_trace_capture_noop_without_dir_or_obs():
+    with obs_pkg.trace_capture() as trace_dir:
+        pass
+    assert trace_dir is None

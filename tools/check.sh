@@ -17,3 +17,8 @@ python -m hfrep_tpu.analysis check \
 # must agree on the committed fixture run directory.  Status goes to
 # stderr so `--format json` keeps stdout pure JSON for machine consumers.
 python -m hfrep_tpu.obs report --self-test 1>&2
+# perf-regression sentinel gate: ingest + cross-host merge + median/MAD
+# baseline math + pass/fail verdicts over the committed history fixture
+# (strict; emits one pure-JSON result doc, routed to stderr here for the
+# same stdout-purity reason).
+python -m hfrep_tpu.obs gate --self-test 1>&2
