@@ -203,12 +203,18 @@ def main() -> None:
             "train": {"batch_size": tcfg.batch_size,
                       "steps_per_call": tcfg.steps_per_call}})
         rc = _bench(obs, mcfg, tcfg)
-    # Perf-regression sentinel (HFREP_HISTORY): gate this run against
-    # the rolling median/MAD baseline of comparable past runs, then
-    # ingest it on pass — silent drift across sessions (the BENCH_r01-
-    # r05 pattern) becomes a nonzero exit code with a named metric.
-    hist = os.environ.get("HFREP_HISTORY")
-    if hist and not obs_dir:
+    # Perf-regression sentinel: gate this run against the rolling
+    # median/MAD baseline of comparable past runs, then ingest it on
+    # pass — silent drift across sessions (the BENCH_r01-r05 pattern)
+    # becomes a nonzero exit code with a named metric.  The store is
+    # $HFREP_HISTORY when set, else the repo-committed default
+    # (hfrep_tpu/obs/_bench_history/) — the driver's BENCH_r{N} runs
+    # auto-ingest into the committed baseline under HFREP_OBS_DIR alone
+    # (gate-then-ingest; the tooling-vs-perf exit-code split lives in
+    # history.gate_and_ingest).
+    from hfrep_tpu.obs import history as hist_mod
+    hist = hist_mod.resolve_history(obs_dir)
+    if os.environ.get("HFREP_HISTORY") and not obs_dir:
         # The operator armed the tripwire but nothing was emitted to
         # gate — say so, naming the REAL cause (an unusable run dir is a
         # permissions hunt, a missing env var is not), instead of
@@ -219,41 +225,7 @@ def main() -> None:
         print(f"bench: HFREP_HISTORY is set but {why} -- "
               "no run dir was recorded, perf gate skipped", file=sys.stderr)
     if obs_dir and hist:
-        from hfrep_tpu.obs import history as hist_mod
-        from hfrep_tpu.obs import regress
-        from hfrep_tpu.obs.report import SchemaError
-
-        try:
-            record = hist_mod.summarize_run(obs_dir)
-            records = hist_mod.load_history(hist)
-            verdict = regress.check_run(record, records)
-        except (OSError, SchemaError, ValueError) as e:
-            # a corrupt/unreadable store is a tooling failure, not a
-            # perf regression: name it on stderr and reuse the CLI's
-            # exit code for it (2) instead of dying in a traceback
-            # after the JSON line already went out
-            print(f"bench: history gate unavailable ({e})", file=sys.stderr)
-            # a floor regression (rc=1) outranks the tooling error: a
-            # driver that distinguishes 1 (perf) from 2 (tooling) must
-            # not see a real floor breach recategorized
-            raise SystemExit(rc or 2)
-        print(regress.render_verdict(verdict), file=sys.stderr)
-        if not verdict["ok"]:
-            rc = max(rc, 1)
-        if rc == 0:
-            # index the record in hand (same object the gate judged) —
-            # and only a fully clean run: a floor-failed or regressed
-            # run must not become a baseline sample
-            try:
-                hist_mod.append_record(
-                    hist, dict(record, ingested_unix=round(time.time(), 3)),
-                    records=records)
-            except OSError as e:
-                # same tooling-vs-perf split as the load path above: an
-                # unwritable store is exit 2, never the regression code
-                print(f"bench: history ingest failed ({e})",
-                      file=sys.stderr)
-                raise SystemExit(2)
+        rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
     if rc:
         raise SystemExit(rc)
 

@@ -103,6 +103,16 @@ class AEConfig:
                                    # verified against tf 2.21 in-image; 2e-3 was
                                    # the standalone-Keras-1.x value and rounds
                                    # 1-4 shipped it by mistake)
+    chunk_epochs: int = 50         # epochs per jitted dispatch on the chunked
+                                   # early-exit training path: the host checks
+                                   # the early-stopping flags between chunks
+                                   # (one scalar device→host sync each) and
+                                   # stops dispatching once every lane stopped,
+                                   # instead of paying the full `epochs` scan
+                                   # with post-stop updates merely masked.
+                                   # 0 = monolithic single-scan (the pre-chunk
+                                   # behavior); results are bit-identical
+                                   # either way (pinned by test)
     seed: int = 123
     beta_mode: str = "first"       # "first" replicates ante()'s use of ae_ols_beta[0]
                                    # for every window (Autoencoder_encapsulate.py:167);

@@ -90,6 +90,20 @@ def augment_training_set(x_train: jnp.ndarray, y_train: jnp.ndarray,
     return x_aug, y_aug
 
 
+def augment_training_sets(x_train: jnp.ndarray, y_train: jnp.ndarray,
+                          augs) -> list:
+    """The cross-dataset sweep fabric's input: the real-only training
+    set plus one augmented variant per sampled generator, as the
+    ``(x, y)`` list :func:`hfrep_tpu.experiments.sweep.run_sweep_multi`
+    pads and batches into one program.  Row counts differ across the
+    list (each generator contributes its own synthetic rows) — that is
+    the fabric's whole padding problem, not an error."""
+    real = (jnp.asarray(x_train, jnp.float32),
+            jnp.asarray(y_train, jnp.float32))
+    return [real] + [augment_training_set(x_train, y_train, a)
+                     for a in augs]
+
+
 def inverse_scale_cube(cube_scaled: jnp.ndarray, panel: Panel,
                        include_rf: bool = True) -> jnp.ndarray:
     """Re-derive the notebook's inverse scaler (cell 47: MinMax fit on

@@ -125,15 +125,32 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--latents", default="1:21", help="'lo:hi' inclusive, or comma list")
     s.add_argument("--out", required=True)
     src = s.add_mutually_exclusive_group()
-    src.add_argument("--gan-checkpoint", default=None,
-                     help="generator checkpoint: run the GAN-augmented sweep")
-    src.add_argument("--h5-generator", default=None,
+    src.add_argument("--gan-checkpoint", action="append", default=None,
+                     help="generator checkpoint: run the GAN-augmented "
+                          "sweep.  Repeatable: K checkpoints batch the "
+                          "real-only and K augmented training sets into "
+                          "ONE (K+1)-dataset vmapped program "
+                          "(experiments/sweep.py::run_sweep_multi) instead "
+                          "of K+1 serial sweeps.  NOTE the batched mode "
+                          "trains every lane with the padded-fabric "
+                          "semantics (weighted validation mean, padded "
+                          "batch stream) — per-dataset results are pinned "
+                          "bit-identical to the serial PADDED sweep, "
+                          "numerically close to but not bitwise the "
+                          "single-source dense path")
+    src.add_argument("--h5-generator", action="append", default=None,
                      help="reference Keras .h5 generator artifact: run the "
-                          "GAN-augmented sweep from it (notebook cell 42)")
+                          "GAN-augmented sweep from it (notebook cell 42). "
+                          "Repeatable, same batching as --gan-checkpoint")
     s.add_argument("--preset", default="mtss_wgan_gp_prod",
                    help="preset the checkpoint was trained with")
     s.add_argument("--n-gen-windows", type=int, default=10)
     s.add_argument("--epochs", type=int, default=None, help="AE epochs override")
+    s.add_argument("--chunk-epochs", type=int, default=None,
+                   help="epochs per jitted dispatch on the chunked "
+                        "early-exit AE training path (AEConfig.chunk_epochs "
+                        "override; 0 = monolithic single-scan, results "
+                        "bit-identical either way)")
     s.add_argument("--plots", action="store_true")
     s.add_argument("--stats", action="store_true",
                    help="full stats battery for the best latent (cell 25): "
@@ -413,42 +430,88 @@ def cmd_sweep(args) -> int:
         return _cmd_sweep_impl(args)
 
 
-def _cmd_sweep_impl(args) -> int:
+def _sample_augmentations(args, panel):
+    """Sample every ``--gan-checkpoint`` / ``--h5-generator`` source into
+    an :class:`~hfrep_tpu.experiments.augment.AugmentedData` list (the
+    flags are mutually exclusive, each repeatable)."""
     import jax
+
+    augs, names = [], []
+    if args.gan_checkpoint:
+        trainer, _, _, _ = _make_trainer(args.preset, args.cleaned_dir,
+                                         quiet=True)
+        from hfrep_tpu.experiments.augment import sample_generator
+        for i, ckpt in enumerate(args.gan_checkpoint):
+            trainer.restore_checkpoint(ckpt)
+            augs.append(sample_generator(trainer, jax.random.PRNGKey(7 + i),
+                                         n_windows=args.n_gen_windows))
+            names.append(f"gen{i}_{os.path.basename(ckpt.rstrip(os.sep))}")
+    elif args.h5_generator:
+        from hfrep_tpu.experiments.augment import sample_keras_generator
+        for i, h5 in enumerate(args.h5_generator):
+            augs.append(sample_keras_generator(h5, jax.random.PRNGKey(7 + i),
+                                               panel,
+                                               n_windows=args.n_gen_windows))
+            names.append(f"gen{i}_{os.path.splitext(os.path.basename(h5))[0]}")
+    return augs, names
+
+
+def _cmd_sweep_impl(args) -> int:
     from hfrep_tpu.config import AEConfig
     from hfrep_tpu.core.data import load_panel
-    from hfrep_tpu.experiments.augment import augment_training_set, sample_generator
-    from hfrep_tpu.experiments.sweep import run_sweep
-    from hfrep_tpu.experiments import report
+    from hfrep_tpu.experiments.augment import augment_training_set
+    from hfrep_tpu.experiments.sweep import run_sweep, run_sweep_multi
 
     panel = load_panel(args.cleaned_dir)
     x_train, x_test, y_train, y_test = panel.train_test_split()
     rf_test = panel.rf[x_train.shape[0]:]
 
-    aug = None
-    if args.gan_checkpoint:
-        trainer, _, _, _ = _make_trainer(args.preset, args.cleaned_dir, quiet=True)
-        trainer.restore_checkpoint(args.gan_checkpoint)
-        aug = sample_generator(trainer, jax.random.PRNGKey(7),
-                               n_windows=args.n_gen_windows)
-    elif args.h5_generator:
-        from hfrep_tpu.experiments.augment import sample_keras_generator
-        aug = sample_keras_generator(args.h5_generator, jax.random.PRNGKey(7),
-                                     panel, n_windows=args.n_gen_windows)
-    if aug is not None:
-        x_train, y_train = augment_training_set(x_train, y_train, aug)
-        print(f"augmented training set: {x_train.shape[0]} rows "
-              f"({aug.factors.shape[0]} synthetic)")
-
     cfg = AEConfig()
     if args.epochs:
         cfg = dataclasses.replace(cfg, epochs=args.epochs)
+    if args.chunk_epochs is not None:
+        cfg = dataclasses.replace(cfg, chunk_epochs=args.chunk_epochs)
+
+    augs, gen_names = _sample_augmentations(args, panel)
+    if len(augs) > 1:
+        # K generators: batch the real-only and K augmented training sets
+        # into ONE (K+1)×L-lane chunked program (padded to the max row
+        # count) instead of K+1 serial sweeps
+        from hfrep_tpu.experiments.augment import augment_training_sets
+        datasets = augment_training_sets(x_train, y_train, augs)
+        multi = run_sweep_multi(
+            datasets, x_test, y_test, rf_test, panel.factors, cfg,
+            _parse_latents(args.latents), strategy_names=panel.hf_names,
+            dataset_names=["real"] + gen_names)
+        multi.save(args.out)
+        doc = {name: res.summary()
+               for name, res in zip(multi.dataset_names, multi.results)}
+        if multi.chunk_stats is not None:
+            doc["chunk_stats"] = multi.chunk_stats._asdict()
+            doc["chunk_stats"]["epochs_saved"] = multi.chunk_stats.epochs_saved
+        print(json.dumps(doc, indent=2, default=str))
+        rc = 0
+        for name, res in zip(multi.dataset_names, multi.results):
+            rc |= _sweep_outputs(args, res, os.path.join(args.out, name),
+                                 panel, y_test, rf_test)
+        return rc
+
+    if augs:
+        x_train, y_train = augment_training_set(x_train, y_train, augs[0])
+        print(f"augmented training set: {x_train.shape[0]} rows "
+              f"({augs[0].factors.shape[0]} synthetic)")
     result = run_sweep(x_train, y_train, x_test, y_test, rf_test,
                        panel.factors, cfg, _parse_latents(args.latents),
                        strategy_names=panel.hf_names)
     result.save(args.out)
     print(json.dumps(result.summary(), indent=2, default=str))
+    return _sweep_outputs(args, result, args.out, panel, y_test, rf_test)
 
+
+def _sweep_outputs(args, result, out_dir, panel, y_test, rf_test) -> int:
+    from hfrep_tpu.experiments import report
+
+    os.makedirs(out_dir, exist_ok=True)
     if args.plots or args.stats:
         i_best = int(np.argmax(result.oos_r2_mean))
         p = result.post[i_best]
@@ -458,18 +521,18 @@ def _cmd_sweep_impl(args) -> int:
         # Three series per panel — Ex-ante / Ex-post / Real — full parity
         # with AE.plot (Autoencoder_encapsulate.py:226-243)
         report.multiplot(p, actual, panel.hf_names,
-                         os.path.join(args.out, "cumulative_returns.png"),
+                         os.path.join(out_dir, "cumulative_returns.png"),
                          labels=("replication (ex-post)", "actual"),
                          ante=a_ante)
-        print(f"plot: {os.path.join(args.out, 'cumulative_returns.png')}")
+        print(f"plot: {os.path.join(out_dir, 'cumulative_returns.png')}")
         # AE training diagnostics (Autoencoder_encapsulate.py:97-105 parity)
         path = report.ae_loss_curves(result.train_loss, result.val_loss,
                                      result.latent_dims,
-                                     os.path.join(args.out, "ae_loss_curves.png"))
+                                     os.path.join(out_dir, "ae_loss_curves.png"))
         print(f"plot: {path}")
         # Omega curves of the best-latent replication vs the actual index
         path = report.omega_curve_grid(p, actual, panel.hf_names,
-                                       os.path.join(args.out, "omega_curves.png"))
+                                       os.path.join(out_dir, "omega_curves.png"))
         print(f"plot: {path}")
     if args.stats:
         rf_aligned = np.asarray(rf_test).reshape(-1)[-p.shape[0]:]
@@ -489,7 +552,7 @@ def _cmd_sweep_impl(args) -> int:
                 returns, panel.hf_names, rf=rf_aligned,
                 ff3_path=args.ff3, ff5_path=args.ff5, span=span_set,
                 start=start, end=end)
-            path = os.path.join(args.out, f"stats_{name}.csv")
+            path = os.path.join(out_dir, f"stats_{name}.csv")
             table.to_csv(path)
             print(f"stats: {path}")
     return 0

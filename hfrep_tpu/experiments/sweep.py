@@ -26,8 +26,13 @@ import numpy as np
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.models.autoencoder import latent_mask
 from hfrep_tpu.replication.engine import (
+    ChunkStats,
     ReplicationEngine,
+    emit_chunk_stats,
+    stack_padded,
     sweep_autoencoders,
+    sweep_autoencoders_chunked,
+    sweep_autoencoders_multi,
     sweep_evaluate,
 )
 from hfrep_tpu.replication import perf_stats
@@ -113,22 +118,42 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
     cfg = dataclasses.replace(cfg, latent_dim=max_latent)
 
     engine = ReplicationEngine(x_train, y_train, x_test, y_test, cfg)
-    swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
+    if cfg.chunk_epochs and cfg.chunk_epochs > 0:
+        # chunked early-exit drive: the host stops dispatching once every
+        # latent lane's early stopping fired — bit-identical results to
+        # the monolithic scan (pinned by test), minus the dead epochs
+        swept, stats = sweep_autoencoders_chunked(key, engine.x_train, cfg,
+                                                  latent_dims)
+        emit_chunk_stats(stats)
+    else:
+        swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
 
     # One compiled program evaluates every latent dim (IS/OOS metrics,
     # ante/post, turnover, Sharpe) — vs the reference's 21-serial eval
     # loop (autoencoder_v4.ipynb cell 24) and round 1's host-serial
     # use_params loop.
-    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    return _evaluate_sweep(engine, cfg, rf_test, factor_full, swept.params,
+                           latent_dims, strategy_names,
+                           stop_epoch=swept.stop_epoch,
+                           train_loss=swept.train_loss,
+                           val_loss=swept.val_loss)
+
+
+def _evaluate_sweep(engine, cfg, rf_test, factor_full, params, latent_dims,
+                    strategy_names, *, stop_epoch, train_loss,
+                    val_loss) -> SweepResult:
+    """The ONE sweep-evaluation + :class:`SweepResult` assembly, shared
+    by the single-dataset and multi-dataset paths (a field added to the
+    result must not desynchronize the two)."""
+    masks = jnp.stack([latent_mask(d, cfg.latent_dim) for d in latent_dims])
     ev = jax.device_get(sweep_evaluate(
         engine.model, cfg, engine.x_train, engine.x_test, engine.y_test,
         jnp.asarray(rf_test, jnp.float32), jnp.asarray(factor_full, jnp.float32),
-        swept.params, masks))
-
+        params, masks))
     names = list(strategy_names) if strategy_names is not None else [
         f"strategy_{j}" for j in range(ev["ante"].shape[2])]
     return SweepResult(
-        latent_dims=latent_dims, strategy_names=names,
+        latent_dims=list(latent_dims), strategy_names=names,
         is_r2=np.asarray(ev["is_r2"]), is_rmse=np.asarray(ev["is_rmse"]),
         oos_r2_mean=np.asarray(ev["oos_r2"]).mean(axis=1),
         oos_r2_max=np.asarray(ev["oos_r2"]).max(axis=1),
@@ -137,7 +162,85 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
         turnover=np.asarray(ev["turnover"]),
         sharpe_ante=np.asarray(ev["sharpe_ante"]),
         sharpe_post=np.asarray(ev["sharpe_post"]),
-        stop_epoch=np.asarray(swept.stop_epoch),
-        train_loss=np.asarray(swept.train_loss),
-        val_loss=np.asarray(swept.val_loss),
+        stop_epoch=np.asarray(stop_epoch),
+        train_loss=np.asarray(train_loss),
+        val_loss=np.asarray(val_loss),
     )
+
+
+@dataclasses.dataclass
+class MultiSweepResult:
+    """One batched cross-dataset sweep: per-dataset :class:`SweepResult`
+    plus the shared dispatch accounting of the fused program."""
+
+    dataset_names: List[str]
+    results: List[SweepResult]          # aligned with dataset_names
+    chunk_stats: Optional[ChunkStats]   # None on the monolithic path
+
+    def __getitem__(self, name: str) -> SweepResult:
+        return self.results[self.dataset_names.index(name)]
+
+    def save(self, out_dir: str) -> None:
+        for name, res in zip(self.dataset_names, self.results):
+            res.save(os.path.join(out_dir, name))
+
+
+def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
+                    cfg: Optional[AEConfig] = None,
+                    latent_dims: Sequence[int] = tuple(range(1, 22)),
+                    key: Optional[jax.Array] = None,
+                    strategy_names: Optional[Sequence[str]] = None,
+                    dataset_names: Optional[Sequence[str]] = None,
+                    mesh=None) -> MultiSweepResult:
+    """The cross-dataset sweep fabric: K+1 training sets × L latent dims
+    as ONE vmapped chunked program instead of K+1 serial sweeps.
+
+    ``datasets`` is a sequence of ``(x_train, y_train)`` pairs — the
+    real-only set and K GAN-augmented variants, whose row counts differ
+    (each generator adds its own synthetic rows).  Each panel is
+    MinMax-scaled with its *own* train-set params (ReplicationEngine
+    semantics), padded to the max row count
+    (:func:`~hfrep_tpu.replication.engine.stack_padded`), and trained
+    through :func:`~hfrep_tpu.replication.engine.sweep_autoencoders_multi`
+    — the ``mse`` sample-weight masking makes the padded rows invisible
+    to every lane.  Evaluation (IS/OOS metrics, ante/post, Sharpe) runs
+    per dataset on the *unpadded* panels, one compiled program per
+    distinct row count.
+
+    ``mesh``: an optional ``('dp', ...)`` Mesh — the stacked cube is
+    ``device_put`` with the dataset axis sharded over ``dp`` and the
+    jitted chunk program follows its operand shardings (the row-count
+    vector stays host-derived: the engine reads it back to compute the
+    exact validation boundaries anyway).
+    """
+    cfg = cfg or AEConfig()
+    key = key if key is not None else jax.random.PRNGKey(cfg.seed)
+    latent_dims = list(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max(latent_dims))
+    names = (list(dataset_names) if dataset_names is not None
+             else [f"dataset_{d}" for d in range(len(datasets))])
+    if len(names) != len(datasets):
+        raise ValueError(f"{len(datasets)} datasets but {len(names)} names")
+
+    engines = [ReplicationEngine(x, y, x_test, y_test, cfg)
+               for x, y in datasets]
+    x_stack, n_rows = stack_padded([e.x_train for e in engines])
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        x_stack = jax.device_put(
+            x_stack, NamedSharding(mesh, PartitionSpec("dp")))
+    swept, stats = sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
+                                            latent_dims)
+    emit_chunk_stats(stats)
+
+    results = [
+        _evaluate_sweep(engine, cfg, rf_test, factor_full,
+                        jax.tree_util.tree_map(lambda a, d=d: a[d],
+                                               swept.params),
+                        latent_dims, strategy_names,
+                        stop_epoch=swept.stop_epoch[d],
+                        train_loss=swept.train_loss[d],
+                        val_loss=swept.val_loss[d])
+        for d, engine in enumerate(engines)]
+    return MultiSweepResult(dataset_names=names, results=results,
+                            chunk_stats=stats)

@@ -160,6 +160,31 @@ def _fold(values, fold) -> Optional[float]:
     return fold(nums) if nums else None
 
 
+def fold_gauges(summaries: List[dict]) -> Dict[str, float]:
+    """Pod-conservative fold of the per-host gauge vectors: for each
+    gauge name, **min** over hosts when higher is better (the slowest
+    host is the pod's true rate — same argument as steps/sec) and
+    **max** when the gauge is a cost (time, memory, divergence).
+    Direction comes from the regression engine's per-metric rules
+    (:func:`hfrep_tpu.obs.regress._rule_for` — table entry or name-
+    suffix heuristic), so the fold and the gate can never disagree
+    about which way a gauge points.  Replaces the leader's-gauges
+    shortcut (ROADMAP open item): a ``bench/*`` gauge emitted by every
+    host now baselines the pod's worst, not whichever host was proc0."""
+    from hfrep_tpu.obs import regress
+
+    votes: Dict[str, List[float]] = {}
+    for s in summaries:
+        for name, value in (s.get("gauges") or {}).items():
+            if _num(value) is not None:
+                votes.setdefault(str(name), []).append(float(value))
+    return {
+        name: (min(vals)
+               if regress._rule_for(name, None)["direction"] == "up"
+               else max(vals))
+        for name, vals in votes.items()}
+
+
 def merge_run_dirs(parent_dir) -> dict:
     """Fold a multi-host launch's per-process run dirs into ONE logical
     run summary (same shape as :func:`hfrep_tpu.obs.report.summarize`,
@@ -178,10 +203,13 @@ def merge_run_dirs(parent_dir) -> dict:
       compiles its own programs; total host-side compile work);
     * ``steps`` — the leader's (processes disagree only when a launch
       died asymmetrically; the leader's count is then the survivors'
-      floor and a warning goes to stderr).
+      floor and a warning goes to stderr);
+    * gauges — per-name pod-conservative fold over the per-host gauge
+      vectors (:func:`fold_gauges`: min where higher is better, max for
+      costs).
 
     Leader (first dir, lowest process index by sort order) supplies the
-    identity fields and gauges.
+    identity fields.
     """
     dirs = find_proc_dirs(parent_dir)
     if not dirs:
@@ -212,6 +240,7 @@ def merge_run_dirs(parent_dir) -> dict:
                          ("memory_high_water_bytes", max),
                          ("backend_compiles", sum), ("compile_secs", sum)):
         merged[metric] = _fold([s.get(metric) for s in summaries], fold)
+    merged["gauges"] = fold_gauges(summaries)
     merged["per_host"] = {
         Path(d).name: {m: _num(s.get(m)) for m in METRIC_FIELDS}
         for d, s in zip(merged["proc_dirs"], summaries)}
@@ -349,3 +378,71 @@ def ingest_multihost(parent_dir, history_path) -> dict:
     record = dict(record, ingested_unix=round(time.time(), 3))
     record["ingested"] = append_record(history_path, record)
     return record
+
+
+# ------------------------------------------------- bench-probe plumbing
+def default_store() -> Optional[Path]:
+    """The repo-committed bench history store
+    (``hfrep_tpu/obs/_bench_history/history.jsonl``), or None when this
+    checkout does not carry one.  With it present, the bench probes gate
+    and auto-ingest under ``HFREP_OBS_DIR`` alone — the driver's
+    ``BENCH_r{N}`` runs accumulate into a committed baseline series
+    instead of requiring ``HFREP_HISTORY`` as a second env var
+    (ROADMAP sentinel gap)."""
+    path = Path(__file__).resolve().parent / "_bench_history" / "history.jsonl"
+    return path if path.exists() else None
+
+
+def resolve_history(obs_dir) -> Optional[str]:
+    """The history store a bench probe should gate against:
+    ``$HFREP_HISTORY`` when set, else the repo-default store — but the
+    default only arms when a run dir is actually being recorded (without
+    ``obs_dir`` there is nothing to gate, and the probe should stay a
+    plain measurement, not warn about a tripwire nobody armed)."""
+    import os
+    hist = os.environ.get("HFREP_HISTORY")
+    if hist:
+        return hist
+    if not obs_dir:
+        return None
+    store = default_store()
+    if store:
+        print(f"bench: gating against repo-default history {store}",
+              file=sys.stderr)
+        return str(store)
+    return None
+
+
+def gate_and_ingest(run_dir, history_path, rc: int = 0) -> int:
+    """The bench probes' shared perf-sentinel tail: gate ``run_dir``
+    against the rolling baseline, ingest it on a fully clean run, and
+    return the updated exit code.
+
+    Exit-code split (the driver records ``rc``): a regression — floor or
+    history — is 1; a *tooling* failure (corrupt/unreadable store) raises
+    ``SystemExit(2)`` so a perf code is never recategorized, except that
+    an already-failing ``rc`` outranks the tooling error."""
+    from hfrep_tpu.obs import regress
+
+    try:
+        record = summarize_run(run_dir)
+        records = load_history(history_path)
+        verdict = regress.check_run(record, records)
+    except (OSError, SchemaError, ValueError) as e:
+        print(f"bench: history gate unavailable ({e})", file=sys.stderr)
+        raise SystemExit(rc or 2)
+    print(regress.render_verdict(verdict), file=sys.stderr)
+    if not verdict["ok"]:
+        rc = max(rc, 1)
+    if rc == 0:
+        # index the record in hand (same object the gate judged) — and
+        # only a fully clean run: a floor-failed or regressed run must
+        # not become a baseline sample
+        try:
+            append_record(history_path,
+                          dict(record, ingested_unix=round(time.time(), 3)),
+                          records=records)
+        except OSError as e:
+            print(f"bench: history ingest failed ({e})", file=sys.stderr)
+            raise SystemExit(2)
+    return rc

@@ -100,6 +100,20 @@ def mad(values: List[float], center: Optional[float] = None) -> float:
     return median([abs(v - c) for v in values])
 
 
+def trend_slope(values: List[float]) -> Optional[float]:
+    """Ordinary-least-squares slope of ``values`` against run index —
+    units per run; ``None`` below 3 samples (two points always fit a
+    line, proving nothing about a *trend*)."""
+    n = len(values)
+    if n < 3:
+        return None
+    x_bar = (n - 1) / 2.0
+    y_bar = sum(values) / n
+    den = sum((i - x_bar) ** 2 for i in range(n))
+    return sum((i - x_bar) * (y - y_bar)
+               for i, y in enumerate(values)) / den
+
+
 def comparable_series(records: List[dict], key: dict,
                       metric: str) -> List[float]:
     """The metric's samples from records sharing the comparability key,
@@ -173,11 +187,38 @@ def check_metric(metric: str, observed, series: List[float], *,
     delta = (base - float(value) if rule["direction"] == "up"
              else float(value) - base)          # positive = got worse
     status = "regression" if delta > allowed else "ok"
-    return {"metric": metric, "status": status,
-            "baseline": round(base, 9), "observed": float(value),
-            "threshold": round(allowed, 9), "delta": round(delta, 9),
-            "direction": rule["direction"], "n": len(tail),
-            "mad": round(spread, 9)}
+    check = {"metric": metric, "status": status,
+             "baseline": round(base, 9), "observed": float(value),
+             "threshold": round(allowed, 9), "delta": round(delta, 9),
+             "direction": rule["direction"], "n": len(tail),
+             "mad": round(spread, 9)}
+
+    # Trend-slope drift tracking: a sequence of sub-threshold moves —
+    # each inside the level gate, all in the worsening direction — is
+    # exactly the BENCH_r01-r05 pattern the level baseline structurally
+    # misses (the rolling median follows the drift down).  Fit a slope
+    # over the window *plus this run*; when the cumulative drift it
+    # projects across that span exceeds the level gate's rel/abs floors,
+    # flag ``drift: true``.  The MAD term is deliberately NOT part of
+    # the drift floor: a trending series inflates its own MAD, so the
+    # adaptive term that protects the level gate from noise would blind
+    # the trend check to exactly the pattern it exists to catch.
+    # Warn-only: a slope is an extrapolation, not an observation, so it
+    # colors the verdict without failing the gate.
+    trend = tail + [float(value)]
+    slope = trend_slope(trend)
+    if slope is not None and base:
+        slope_frac = slope / abs(base)
+        worsening = slope < 0 if rule["direction"] == "up" else slope > 0
+        projected = abs(slope) * (len(trend) - 1)
+        drift_floor = max(float(rule.get("rel_tol", 0.0)) * abs(base),
+                          float(rule.get("abs_tol", 0.0)))
+        check["slope"] = round(slope, 9)
+        check["slope_frac"] = round(slope_frac, 9)
+        check["drift"] = bool(status == "ok" and worsening
+                              and drift_floor > 0
+                              and projected > drift_floor)
+    return check
 
 
 def check_run(record: dict, records: List[dict], *,
@@ -218,6 +259,8 @@ def check_run(record: dict, records: List[dict], *,
         "no_data": no_data,
         "n_comparable": len([r for r in prior if r.get("key") == key]),
         "regressions": [c["metric"] for c in regressions],
+        # sustained sub-threshold drift (warn-only; never flips ``ok``)
+        "drifts": [c["metric"] for c in checks if c.get("drift")],
         "checks": checks,
     }
 
@@ -231,6 +274,7 @@ def render_verdict(verdict: dict) -> str:
     """Human verdict: one line per metric, worst news first."""
     word = ("NO-DATA" if verdict.get("no_data")
             else "PASS" if verdict["ok"] else "REGRESSION")
+    drifts = verdict.get("drifts") or []
     head = word + (
         f"  run {verdict['run_id']}  (key: "
         f"family={verdict['key'].get('family')}, "
@@ -239,19 +283,28 @@ def render_verdict(verdict: dict) -> str:
         f"backend={verdict['key'].get('backend')}, "
         f"mesh={verdict['key'].get('mesh')}; "
         f"{verdict['n_comparable']} comparable runs)")
+    if drifts:
+        head += (f"\nDRIFT WARNING: sustained sub-threshold trend on "
+                 f"{', '.join(drifts)} (slope below; level gate not tripped)")
     order = {"regression": 0, "ok": 1, "insufficient-history": 2,
              "missing": 3}
     lines = [head]
-    for c in sorted(verdict["checks"], key=lambda c: order[c["status"]]):
+    for c in sorted(verdict["checks"],
+                    key=lambda c: (order[c["status"]],
+                                   not c.get("drift"))):
         glyph = _STATUS_GLYPH[c["status"]].format(n=c["n"])
         if c["status"] == "missing":
             lines.append(f"  {glyph} {c['metric']:26s} (not measured)")
             continue
         base = "-" if c["baseline"] is None else f"{c['baseline']:.6g}"
         thr = "-" if c["threshold"] is None else f"{c['threshold']:.3g}"
-        lines.append(
-            f"  {glyph} {c['metric']:26s} observed {c['observed']:.6g}"
-            f"  baseline {base} (n={c['n']})  allowed ±{thr}")
+        line = (f"  {glyph} {c['metric']:26s} observed {c['observed']:.6g}"
+                f"  baseline {base} (n={c['n']})  allowed ±{thr}")
+        if c.get("slope_frac") is not None:
+            line += f"  slope {c['slope_frac'] * 100:+.2f}%/run"
+            if c.get("drift"):
+                line += "  DRIFT"
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -49,33 +49,87 @@ class AEResult(NamedTuple):
     val_loss: jnp.ndarray       # (epochs,)
 
 
+class ChunkStats(NamedTuple):
+    """Dispatch accounting of a chunked early-exit training drive."""
+
+    chunks_dispatched: int       # jitted scan calls the host actually issued
+    epochs_dispatched: int       # epochs those chunks executed on device
+    epochs_total: int            # cfg.epochs (what the monolithic scan pays)
+    chunk_epochs: int            # epochs per chunk
+    lanes: int                   # vmapped training lanes in the program
+    lanes_stopped: int           # lanes whose early stopping fired
+
+    @property
+    def epochs_saved(self) -> int:
+        return self.epochs_total - self.epochs_dispatched
+
+
 def _epoch_batches(n_train: int, batch_size: int) -> Tuple[int, int]:
     n_batches = -(-n_train // batch_size)
     return n_batches, n_batches * batch_size
 
 
-def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
-                      mask: Optional[jnp.ndarray] = None) -> AEResult:
-    """Train one (optionally masked) AE; pure function of (key, data, cfg).
+def _ae_model(cfg: AEConfig) -> Autoencoder:
+    return Autoencoder(n_features=cfg.n_factors, latent_dim=cfg.latent_dim,
+                       slope=cfg.leaky_slope)
 
-    ``mask`` is a (max_latent,) 0/1 vector selecting active latent dims;
-    None trains the full ``cfg.latent_dim``.
+
+def _ae_init(cfg: AEConfig, x_train_scaled: jnp.ndarray, key: jax.Array):
+    """Initial training carry + the per-epoch PRNG keys.
+
+    Shared by the monolithic scan and the chunked driver so the two paths
+    consume bit-identical initial state and key streams."""
+    model = _ae_model(cfg)
+    key, init_key = jax.random.split(key)
+    params = model.init(init_key, x_train_scaled[:1])["params"]
+    tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # tf.keras-exact Nadam
+    opt_state = tx.init(params)
+    carry = (params, opt_state, jnp.inf, jnp.zeros((), jnp.int32),
+             jnp.zeros((), bool))
+    return carry, jax.random.split(key, cfg.epochs)
+
+
+def _ae_epoch_step(cfg: AEConfig, x_train_scaled: jnp.ndarray,
+                   mask: Optional[jnp.ndarray],
+                   rows_info=None):
+    """One training epoch as a ``lax.scan`` body, shared by every path.
+
+    ``rows_info`` — a traced ``(n_rows, n_train_eff)`` scalar pair —
+    switches on the padded multi-dataset semantics: ``x_train_scaled``
+    then holds ``n_rows`` real rows followed by zero padding up to a
+    common static shape, ``n_train_eff`` is the dataset's own Keras
+    ``validation_split`` boundary (computed host-side in exact Python
+    arithmetic by :func:`_rows_info` — a traced float32
+    ``floor(n * 0.9)`` rounds the wrong way for some splits), and the
+    per-batch sample weights additionally zero every slot whose permuted
+    row index falls outside the dataset's own fit block — so one
+    compiled program trains datasets of different true lengths.  Each
+    lane still takes the full static batch count of optimizer steps per
+    epoch (all-masked batches contribute exactly-zero gradients — note
+    the Nadam momentum still decays through them, which is why the
+    padded path is pinned against the padded serial sweep, not bitwise
+    against the dense one); with ``n_rows == x.shape[0]`` the batch
+    stream degenerates to the dense path's exactly, only the validation
+    loss reduces through the weighted (rather than sliced) mean.
     """
-    model = Autoencoder(n_features=cfg.n_factors, latent_dim=cfg.latent_dim,
-                        slope=cfg.leaky_slope)
+    model = _ae_model(cfg)
+    tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)
     n = x_train_scaled.shape[0]
     # Keras validation_split semantics: split_at = floor(n * (1 - split))
     # training rows, the rest validation (167 → 125 train / 42 val).
     n_train = int(n * (1.0 - cfg.val_split))
-    n_val = n - n_train
     x_fit, x_val = x_train_scaled[:n_train], x_train_scaled[n_train:]
-
-    key, init_key = jax.random.split(key)
-    params = model.init(init_key, x_fit[:1])["params"]
-    tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # tf.keras-exact Nadam
-    opt_state = tx.init(params)
-
     n_batches, padded = _epoch_batches(n_train, cfg.batch_size)
+
+    if rows_info is None:
+        n_train_eff = None
+        val_x, val_w = x_val, None
+    else:
+        n_rows, n_train_eff = rows_info
+        rows = jnp.arange(n)
+        val_x = x_train_scaled
+        val_w = jnp.logical_and(rows >= n_train_eff,
+                                rows < n_rows).astype(jnp.float32)
 
     def mse(p, x, w=None):
         pred = model.apply({"params": p}, x, mask)
@@ -89,6 +143,8 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
         perm = jax.random.permutation(epoch_key, n_train)
         order = jnp.concatenate([perm, jnp.zeros(padded - n_train, jnp.int32)])
         weights = (jnp.arange(padded) < n_train).astype(jnp.float32)
+        if n_train_eff is not None:
+            weights = weights * (order < n_train_eff)
 
         def batch_step(c, i):
             p, o = c
@@ -108,7 +164,7 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
         opt_state = jax.tree_util.tree_map(
             lambda old, new: jnp.where(stopped, old, new), opt_state, new_opt)
 
-        val = mse(params, x_val)
+        val = mse(params, val_x, val_w)
         improved = val < best_val
         wait = jnp.where(stopped, wait, jnp.where(improved, 0, wait + 1))
         best_val = jnp.where(stopped, best_val, jnp.minimum(best_val, val))
@@ -118,11 +174,209 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
         stopped = jnp.logical_or(stopped, newly_stopped)
         return (params, opt_state, best_val, wait, stopped), (train_loss, val_out, stopped)
 
-    keys = jax.random.split(key, cfg.epochs)
-    init = (params, opt_state, jnp.inf, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
-    (params, _, _, _, _), (tl, vl, stop_trace) = lax.scan(epoch_step, init, keys)
-    stop_epoch = jnp.argmax(stop_trace) + jnp.where(jnp.any(stop_trace), 0, cfg.epochs)
-    return AEResult(params=params, stop_epoch=stop_epoch, train_loss=tl, val_loss=vl)
+    return epoch_step
+
+
+def _ae_result(params: dict, tl: jnp.ndarray, vl: jnp.ndarray,
+               stop_trace: jnp.ndarray, epochs: int) -> AEResult:
+    stop_epoch = jnp.argmax(stop_trace, axis=-1) + jnp.where(
+        jnp.any(stop_trace, axis=-1), 0, epochs)
+    return AEResult(params=params, stop_epoch=stop_epoch, train_loss=tl,
+                    val_loss=vl)
+
+
+def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
+                      mask: Optional[jnp.ndarray] = None) -> AEResult:
+    """Train one (optionally masked) AE; pure function of (key, data, cfg).
+
+    ``mask`` is a (max_latent,) 0/1 vector selecting active latent dims;
+    None trains the full ``cfg.latent_dim``.  This is the monolithic
+    single-scan form (traceable, so it vmaps/jits freely); the host-driven
+    early-exit form with identical results is
+    :func:`train_autoencoder_chunked`.
+    """
+    carry, keys = _ae_init(cfg, x_train_scaled, key)
+    step = _ae_epoch_step(cfg, x_train_scaled, mask)
+    (params, _, _, _, _), (tl, vl, stop_trace) = lax.scan(step, carry, keys)
+    return _ae_result(params, tl, vl, stop_trace, cfg.epochs)
+
+
+def _donate_argnums() -> Tuple[int, ...]:
+    # donated carries let XLA reuse the parameter/optimizer buffers across
+    # chunk dispatches; the CPU backend does not implement donation and
+    # warns per call, so only donate where it can land
+    return () if jax.default_backend() == "cpu" else (0,)
+
+
+# The compiled chunk/init programs, cached by (cfg, program kind).  The
+# chunked drive's economics depend on this: the fixed-size chunk program
+# compiles ONCE and every later dispatch — across chunks, re-trains,
+# sweep variants, bench repeats — reuses it; a per-call
+# ``jax.jit(lambda ...)`` would recompile per drive and hand the
+# early-exit savings straight back to XLA.  Data (panel, masks, row
+# counts) enters as traced operands, never as baked constants, for the
+# same reason; new shapes retrace inside the cached jit as usual.
+_PROGRAM_CACHE: dict = {}
+
+
+def _cached_program(cfg: AEConfig, kind: str, build):
+    key = (dataclasses.astuple(cfg), kind)
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = _PROGRAM_CACHE[key] = build()
+    return fn
+
+
+def _chunk_fn(cfg: AEConfig, kind: str):
+    """The jitted ``chunk_epochs``-long scan program for one drive kind:
+    ``single`` (one lane), ``lanes`` (L vmapped latent lanes over one —
+    dense or padded — dataset), ``multi`` (D×L lanes over stacked padded
+    datasets).  Signature is uniform — ``fn(carry, keys, xs, masks,
+    rows_info)``, with ``masks``/``rows_info`` None on the paths that
+    lack them — so :func:`_drive_chunks` stays one host loop for all
+    three."""
+    def build():
+        if kind == "single":
+            def run(carry, keys, xs, masks, rows_info):
+                return lax.scan(
+                    _ae_epoch_step(cfg, xs, masks, rows_info=rows_info),
+                    carry, keys)
+        elif kind == "lanes":
+            def run(carry, keys, xs, masks, rows_info):
+                def lane(c, ks, m):
+                    return lax.scan(
+                        _ae_epoch_step(cfg, xs, m, rows_info=rows_info),
+                        c, ks)
+                return jax.vmap(lane)(carry, keys, masks)
+        elif kind == "multi":
+            def run(carry, keys, xs, masks, rows_info):
+                def dataset(c, ks, x, ri):
+                    def lane(cl, kl, m):
+                        return lax.scan(
+                            _ae_epoch_step(cfg, x, m, rows_info=ri), cl, kl)
+                    return jax.vmap(lane)(c, ks, masks)
+                return jax.vmap(dataset)(carry, keys, xs, rows_info)
+        else:
+            raise ValueError(f"unknown chunk program kind {kind!r}")
+        return jax.jit(run, donate_argnums=_donate_argnums())
+    return _cached_program(cfg, f"chunk:{kind}", build)
+
+
+def _init_program(cfg: AEConfig, kind: str, n_lanes: int = 0):
+    """The jitted initial-carry program matching :func:`_chunk_fn`'s
+    kind: ``fn(keys, xs)`` with ``keys`` one PRNG key per lane (single:
+    one key; multi: one per dataset, split into ``n_lanes`` latent lanes
+    inside)."""
+    def build():
+        if kind == "single":
+            def run(keys, xs):
+                return _ae_init(cfg, xs, keys)
+        elif kind == "lanes":
+            def run(keys, xs):
+                return jax.vmap(lambda k: _ae_init(cfg, xs, k))(keys)
+        elif kind == "multi":
+            def run(keys, xs):
+                def dataset(dk, x):
+                    lane_keys = jax.random.split(dk, n_lanes)
+                    return jax.vmap(lambda k: _ae_init(cfg, x, k))(lane_keys)
+                return jax.vmap(dataset)(keys, xs)
+        else:
+            raise ValueError(f"unknown init program kind {kind!r}")
+        return jax.jit(run)
+    return _cached_program(cfg, f"init:{kind}:{n_lanes}", build)
+
+
+def _rows_info(cfg: AEConfig, n_rows) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The padded paths' ``(n_rows, n_train_eff)`` operand pair, with the
+    Keras ``validation_split`` boundary computed host-side in exact
+    Python arithmetic — ``int(r * (1 - val_split))`` in float64, exactly
+    the dense path's formula.  A traced float32 ``floor`` disagrees for
+    some (split, rows) pairs: ``float32(0.9) * 10`` floors to 8 where
+    Python's ``int(10 * 0.9)`` is 9."""
+    arr = np.asarray(jax.device_get(n_rows), dtype=np.int64)
+    fit = (arr * (1.0 - cfg.val_split)).astype(np.int64)  # float64, truncating
+    return jnp.asarray(arr, jnp.int32), jnp.asarray(fit, jnp.int32)
+
+
+def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
+                 lanes: int, n_lanes_init: int = 0,
+                 ) -> Tuple[AEResult, ChunkStats]:
+    """The shared drive tail of every chunked public entry point: init
+    carry, dispatch chunks until ``all(stopped)``, assemble the
+    bit-identical :class:`AEResult` and the :class:`ChunkStats`
+    accounting."""
+    carry, epoch_keys = _init_program(cfg, kind, n_lanes_init)(keys, xs)
+    fn = _chunk_fn(cfg, kind)
+    carry, (tl, vl, st), dispatched, chunks = _drive_chunks(
+        lambda c, ks: fn(c, ks, xs, masks, rows_info), carry, epoch_keys,
+        cfg.epochs, cfg.chunk_epochs)
+    res = _ae_result(carry[0], tl, vl, st, cfg.epochs)
+    stats = ChunkStats(chunks_dispatched=chunks, epochs_dispatched=dispatched,
+                       epochs_total=cfg.epochs,
+                       chunk_epochs=cfg.chunk_epochs or cfg.epochs,
+                       lanes=lanes,
+                       lanes_stopped=_lanes_stopped(res.stop_epoch, cfg.epochs))
+    return res, stats
+
+
+def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int):
+    """The host side of chunked early-exit training.
+
+    Dispatches ``chunk_epochs``-long jitted scans, reading back ONE scalar
+    (``all(stopped)``) between dispatches, and stops paying for epochs the
+    early stopping already cancelled.  Undispatched epochs are padded with
+    the exact values the monolithic scan's post-stop masking would have
+    produced (NaN losses, True stop flags), so the assembled traces — and
+    therefore :func:`_ae_result` — are bit-identical to the single-scan
+    path.  Returns ``(carry, (tl, vl, stop_trace), epochs_dispatched,
+    chunks_dispatched)``.
+    """
+    chunk = int(chunk_epochs) if chunk_epochs and chunk_epochs > 0 else epochs
+    traces: list = []
+    pos = 0
+    chunks = 0
+    while pos < epochs:
+        length = min(chunk, epochs - pos)
+        carry, tr = chunk_fn(carry, keys[..., pos:pos + length, :])
+        traces.append(tr)
+        pos += length
+        chunks += 1
+        # one scalar device→host sync per chunk decides continue/stop
+        if pos < epochs and bool(jax.device_get(jnp.all(carry[4]))):
+            break
+    tl = jnp.concatenate([t[0] for t in traces], axis=-1)
+    vl = jnp.concatenate([t[1] for t in traces], axis=-1)
+    st = jnp.concatenate([t[2] for t in traces], axis=-1)
+    if pos < epochs:
+        lead = tl.shape[:-1]
+        pad = (epochs - pos,)
+        tl = jnp.concatenate(
+            [tl, jnp.full(lead + pad, jnp.nan, tl.dtype)], axis=-1)
+        vl = jnp.concatenate(
+            [vl, jnp.full(lead + pad, jnp.nan, vl.dtype)], axis=-1)
+        st = jnp.concatenate([st, jnp.ones(lead + pad, st.dtype)], axis=-1)
+    return carry, (tl, vl, st), pos, chunks
+
+
+def _lanes_stopped(stop_epoch: jnp.ndarray, epochs: int) -> int:
+    return int(jax.device_get(jnp.sum(stop_epoch < epochs)))
+
+
+def train_autoencoder_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
+                              cfg: AEConfig,
+                              mask: Optional[jnp.ndarray] = None,
+                              ) -> Tuple[AEResult, ChunkStats]:
+    """:func:`train_autoencoder` as a chunked early-exit drive.
+
+    Scans ``cfg.chunk_epochs`` epochs per jitted call (donated carries)
+    and stops dispatching once early stopping fired — a run that stops at
+    epoch ~60 executes ~2 chunks instead of the full 1000-epoch scan.
+    The returned :class:`AEResult` is bit-identical to the monolithic
+    scan's (pinned by test); :class:`ChunkStats` reports what the exit
+    saved.
+    """
+    return _run_chunked(cfg, "single", key, x_train_scaled, mask, None,
+                        lanes=1)
 
 
 def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
@@ -135,6 +389,105 @@ def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfi
     masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
     keys = jax.random.split(key, len(latent_dims))
     return jax.vmap(lambda k, m: train_autoencoder(k, x_train_scaled, cfg, m))(keys, masks)
+
+
+def sweep_autoencoders_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
+                               cfg: AEConfig, latent_dims: Sequence[int],
+                               ) -> Tuple[AEResult, ChunkStats]:
+    """:func:`sweep_autoencoders` as a chunked early-exit drive.
+
+    One vmapped chunk program covers every latent lane; the host keeps
+    dispatching until ``all(stopped)`` across the sweep — the slowest lane
+    bounds the dispatch count, but nothing pays for the full 1000-epoch
+    scan once the last lane has stopped.  Bit-identical results to the
+    monolithic vmapped sweep (pinned by test).
+    """
+    max_latent = max(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    lane_keys = jax.random.split(key, len(latent_dims))
+    return _run_chunked(cfg, "lanes", lane_keys, x_train_scaled, masks, None,
+                        lanes=len(latent_dims))
+
+
+# ------------------------------------------- padded multi-dataset sweep
+def stack_padded(x_list: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stack differing-length (T_d, F) panels into a ``(D, T_max, F)``
+    cube (zero rows after each dataset's true tail) plus the ``(D,)``
+    true-row-count vector the padded training semantics key off."""
+    n_max = max(int(x.shape[0]) for x in x_list)
+    padded, rows = [], []
+    for x in x_list:
+        x = jnp.asarray(x, jnp.float32)
+        rows.append(x.shape[0])
+        if x.shape[0] < n_max:
+            x = jnp.concatenate(
+                [x, jnp.zeros((n_max - x.shape[0], x.shape[1]), x.dtype)])
+        padded.append(x)
+    return jnp.stack(padded), jnp.asarray(rows, jnp.int32)
+
+
+def sweep_autoencoders_padded(key: jax.Array, x_pad: jnp.ndarray,
+                              n_rows, cfg: AEConfig,
+                              latent_dims: Sequence[int],
+                              ) -> Tuple[AEResult, ChunkStats]:
+    """One padded dataset's latent sweep — the serial unit
+    :func:`sweep_autoencoders_multi` batches across datasets.  ``x_pad``
+    is a (T_max, F) panel holding ``n_rows`` real rows then zero padding;
+    the program shape depends on T_max only, so serially sweeping K
+    datasets padded to a common T_max is numerically equivalent to the
+    one batched multi-dataset program (pinned by test)."""
+    max_latent = max(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    lane_keys = jax.random.split(key, len(latent_dims))
+    return _run_chunked(cfg, "lanes", lane_keys, x_pad, masks,
+                        _rows_info(cfg, n_rows), lanes=len(latent_dims))
+
+
+def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
+                             n_rows: jnp.ndarray, cfg: AEConfig,
+                             latent_dims: Sequence[int],
+                             ) -> Tuple[AEResult, ChunkStats]:
+    """The cross-dataset sweep fabric: every (dataset, latent) pair as one
+    vmapped chunked program.
+
+    ``x_stack`` is the :func:`stack_padded` cube of K+1 training sets
+    (real + GAN-augmented variants, padded to a common row count) and
+    ``n_rows`` their true row counts; the result's arrays lead with a
+    ``(D, L)`` lane grid.  Replaces K+1 serial sweeps with ONE program —
+    and the chunked early exit only keeps dispatching while *some* lane
+    anywhere in the grid is still training.  Shard the leading dataset
+    axis over ``dp`` by ``jax.device_put``-ing ``x_stack``/``n_rows``
+    with a NamedSharding before calling (the jitted chunk program follows
+    its operand shardings).
+    """
+    max_latent = max(latent_dims)
+    cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+    masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
+    n_lanes = len(latent_dims)
+    dkeys = jax.random.split(key, x_stack.shape[0])
+    return _run_chunked(cfg, "multi", dkeys, x_stack, masks,
+                        _rows_info(cfg, n_rows),
+                        lanes=int(x_stack.shape[0]) * n_lanes,
+                        n_lanes_init=n_lanes)
+
+
+def emit_chunk_stats(stats: Optional[ChunkStats]) -> None:
+    """Publish a chunked drive's savings as obs gauges (no-op when
+    telemetry is off or the drive ran monolithically)."""
+    if stats is None:
+        return
+    from hfrep_tpu.obs import get_obs
+    obs = get_obs()
+    if not obs.enabled:
+        return
+    obs.gauge("ae/epochs_saved").set(int(stats.epochs_saved),
+                                     epochs_total=int(stats.epochs_total),
+                                     chunk_epochs=int(stats.chunk_epochs))
+    obs.gauge("ae/lanes_stopped").set(int(stats.lanes_stopped),
+                                      lanes=int(stats.lanes))
+    obs.counter("ae_chunks_dispatched").inc(int(stats.chunks_dispatched))
 
 
 # ----------------------------------------------------- pure evaluation
@@ -289,19 +642,32 @@ class ReplicationEngine:
 
     # ------------------------------------------------------------ training
     def train(self, key: Optional[jax.Array] = None) -> AEResult:
+        """Train the full-latent model.  With ``cfg.chunk_epochs > 0``
+        (the default) the scan is dispatched in early-exit chunks — the
+        host stops paying once early stopping fired — with results
+        bit-identical to the monolithic scan (``cfg.chunk_epochs = 0``)."""
         from hfrep_tpu.obs import get_obs
         obs = get_obs()
         key = key if key is not None else jax.random.PRNGKey(self.cfg.seed)
-        if self._train_fn is None:
-            self._train_fn = jax.jit(lambda k: train_autoencoder(k, self.x_train, self.cfg))
+        stats = None
         with obs.span("ae_train", latent_dim=self.cfg.latent_dim,
                       epochs=self.cfg.epochs):
-            self.result = self._train_fn(key)
+            if self.cfg.chunk_epochs and self.cfg.chunk_epochs > 0:
+                # compile reuse across re-train()s comes from the
+                # module-level chunk-program cache, not per-instance state
+                self.result, stats = train_autoencoder_chunked(
+                    key, self.x_train, self.cfg)
+            else:
+                if self._train_fn is None:
+                    self._train_fn = jax.jit(
+                        lambda k: train_autoencoder(k, self.x_train, self.cfg))
+                self.result = self._train_fn(key)
             if obs.enabled:        # time the scan, not its async dispatch
                 jax.block_until_ready(self.result.params)
         if obs.enabled:
             obs.counter("ae_trainings").inc()
             obs.gauge("ae_stop_epoch").set(int(self.result.stop_epoch))
+            emit_chunk_stats(stats)
         self.mask = None            # full-latent model: drop any use_params() mask
         self._invalidate()
         return self.result

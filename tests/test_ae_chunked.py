@@ -1,0 +1,294 @@
+"""Chunked early-exit AE training + padded cross-dataset sweep fabric
+(ISSUE 4 acceptance): bit-identical results to the monolithic scan,
+fewer dispatches on an early-stopping fixture, batched-multi-dataset
+equivalence with the serial padded sweeps, and the bench_ae probe."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.replication import engine as ae_engine
+from hfrep_tpu.replication.engine import (
+    ChunkStats,
+    ReplicationEngine,
+    stack_padded,
+    sweep_autoencoders,
+    sweep_autoencoders_chunked,
+    sweep_autoencoders_multi,
+    sweep_autoencoders_padded,
+    train_autoencoder,
+    train_autoencoder_chunked,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+CFG = AEConfig(n_factors=6, latent_dim=4, epochs=40, batch_size=16,
+               patience=3, seed=0, chunk_epochs=8)
+
+#: lr=0 pins early stopping deterministically: the validation loss never
+#: improves after epoch 1, so every lane stops at exactly patience + 1
+EARLY_CFG = dataclasses.replace(CFG, epochs=120, chunk_epochs=15,
+                                patience=5, lr=0.0)
+
+
+@pytest.fixture(scope="module")
+def xs():
+    g = np.random.default_rng(11)
+    z = g.normal(size=(90, 3))
+    x = (z @ g.normal(size=(3, 6))
+         + 0.05 * g.normal(size=(90, 6))).astype(np.float32) * 0.02
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    return scaled
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool(jnp.array_equal(x, y)) for x, y in zip(la, lb))
+
+
+def _results_identical(a, b) -> None:
+    assert _trees_equal(a.params, b.params)
+    assert np.array_equal(np.asarray(a.stop_epoch), np.asarray(b.stop_epoch))
+    assert np.array_equal(np.asarray(a.train_loss), np.asarray(b.train_loss),
+                          equal_nan=True)
+    assert np.array_equal(np.asarray(a.val_loss), np.asarray(b.val_loss),
+                          equal_nan=True)
+
+
+# --------------------------------------------- chunked == monolithic
+class TestChunkedEquivalence:
+    def test_single_training_bit_identical(self, xs):
+        key = jax.random.PRNGKey(0)
+        mono = train_autoencoder(key, xs, CFG)
+        chunked, stats = train_autoencoder_chunked(key, xs, CFG)
+        _results_identical(mono, chunked)
+        assert isinstance(stats, ChunkStats)
+        assert stats.epochs_total == CFG.epochs
+
+    def test_single_training_with_mask(self, xs):
+        key = jax.random.PRNGKey(3)
+        mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+        mono = train_autoencoder(key, xs, CFG, mask)
+        chunked, _ = train_autoencoder_chunked(key, xs, CFG, mask)
+        _results_identical(mono, chunked)
+
+    def test_sweep_bit_identical(self, xs):
+        key = jax.random.PRNGKey(1)
+        dims = [1, 2, 3, 4]
+        mono = sweep_autoencoders(key, xs, CFG, dims)
+        chunked, stats = sweep_autoencoders_chunked(key, xs, CFG, dims)
+        _results_identical(mono, chunked)
+        assert stats.lanes == len(dims)
+
+    def test_early_stop_fixture_bit_identical(self, xs):
+        """The equivalence must hold exactly where the exit actually
+        fires — undispatched epochs are padded with the same NaN/True
+        values the monolithic scan's post-stop masking produces."""
+        key = jax.random.PRNGKey(2)
+        mono = train_autoencoder(key, xs, EARLY_CFG)
+        chunked, stats = train_autoencoder_chunked(key, xs, EARLY_CFG)
+        _results_identical(mono, chunked)
+        assert int(mono.stop_epoch) == EARLY_CFG.patience
+        assert stats.lanes_stopped == 1
+
+    def test_chunk_epochs_zero_is_monolithic_single_dispatch(self, xs):
+        key = jax.random.PRNGKey(0)
+        cfg0 = dataclasses.replace(CFG, chunk_epochs=0)
+        mono = train_autoencoder(key, xs, cfg0)
+        chunked, stats = train_autoencoder_chunked(key, xs, cfg0)
+        _results_identical(mono, chunked)
+        assert stats.chunks_dispatched == 1
+        assert stats.epochs_dispatched == cfg0.epochs
+
+
+# ------------------------------------------------------- early exit
+class TestEarlyExit:
+    def test_dispatch_count_drops_on_early_stop(self, xs):
+        """The acceptance pin: fewer chunks than epochs/chunk_epochs on
+        an early-stopping fixture (all lanes stop at patience + 1 = 6,
+        so ONE 15-epoch chunk covers it)."""
+        _, stats = sweep_autoencoders_chunked(
+            jax.random.PRNGKey(0), xs, EARLY_CFG, [1, 2, 3, 4])
+        full_chunks = -(-EARLY_CFG.epochs // EARLY_CFG.chunk_epochs)
+        assert stats.chunks_dispatched < full_chunks
+        assert stats.chunks_dispatched == 1
+        assert stats.epochs_dispatched == EARLY_CFG.chunk_epochs
+        assert stats.epochs_saved == EARLY_CFG.epochs - EARLY_CFG.chunk_epochs
+        assert stats.lanes_stopped == 4
+
+    def test_no_early_stop_pays_all_chunks(self, xs):
+        _, stats = train_autoencoder_chunked(jax.random.PRNGKey(0), xs, CFG)
+        if int(stats.lanes_stopped) == 0:
+            assert stats.chunks_dispatched == -(-CFG.epochs // CFG.chunk_epochs)
+            assert stats.epochs_saved == 0
+
+    def test_engine_train_chunked_matches_monolithic(self, xs):
+        x = np.asarray(xs)
+        half = x.shape[0] // 2
+        y = x[:, :4]
+        chunked_eng = ReplicationEngine(x[:half], y[:half], x[half:],
+                                        y[half:], CFG)
+        mono_eng = ReplicationEngine(
+            x[:half], y[:half], x[half:], y[half:],
+            dataclasses.replace(CFG, chunk_epochs=0))
+        r_chunked = chunked_eng.train()
+        r_mono = mono_eng.train()
+        _results_identical(r_chunked, r_mono)
+
+
+# --------------------------------------- padded multi-dataset fabric
+class TestPaddedMultiDataset:
+    def test_stack_padded_shapes_and_rows(self, xs):
+        short = xs[:70]
+        stack, rows = stack_padded([xs, short])
+        assert stack.shape == (2, xs.shape[0], xs.shape[1])
+        assert rows.tolist() == [xs.shape[0], 70]
+        # padding rows are exact zeros after the true tail
+        assert float(jnp.abs(stack[1, 70:]).max()) == 0.0
+
+    def test_multi_matches_serial_padded_sweeps(self, xs):
+        """The fused (D, L)-lane program is numerically identical to
+        serially sweeping each padded dataset (the acceptance pin for
+        the cross-dataset fabric)."""
+        key = jax.random.PRNGKey(4)
+        dims = [1, 2, 3]
+        stack, rows = stack_padded([xs, xs[:70]])
+        multi, stats = sweep_autoencoders_multi(key, stack, rows, CFG, dims)
+        assert stats.lanes == 2 * len(dims)
+        dkeys = jax.random.split(key, 2)
+        for d in range(2):
+            serial, _ = sweep_autoencoders_padded(
+                dkeys[d], stack[d], rows[d], CFG, dims)
+            sliced = jax.tree_util.tree_map(lambda a: a[d], multi.params)
+            assert _trees_equal(sliced, serial.params)
+            assert np.array_equal(np.asarray(multi.stop_epoch[d]),
+                                  np.asarray(serial.stop_epoch))
+            assert np.array_equal(np.asarray(multi.val_loss[d]),
+                                  np.asarray(serial.val_loss),
+                                  equal_nan=True)
+
+    def test_padded_full_rows_close_to_dense(self, xs):
+        """With n_rows == T the padded semantics reduce to the dense
+        path up to the weighted-vs-sliced validation mean — same batch
+        stream, numerically close losses."""
+        key = jax.random.PRNGKey(5)
+        dims = [1, 2]
+        dense = sweep_autoencoders(key, xs, CFG, dims)
+        padded, _ = sweep_autoencoders_padded(
+            key, xs, xs.shape[0], CFG, dims)
+        np.testing.assert_allclose(
+            np.asarray(padded.val_loss), np.asarray(dense.val_loss),
+            rtol=1e-4, atol=1e-7)
+
+    def test_run_sweep_multi_structure(self, xs):
+        from hfrep_tpu.experiments.sweep import run_sweep_multi
+
+        x = np.asarray(xs)
+        half = x.shape[0] // 2
+        y = x[:, :4]
+        g = np.random.default_rng(3)
+        extra_x = np.concatenate(
+            [g.normal(size=(12, 6)).astype(np.float32) * 0.02, x[:half]])
+        extra_y = np.concatenate(
+            [g.normal(size=(12, 4)).astype(np.float32) * 0.02, y[:half]])
+        rf = np.abs(g.normal(0.001, 0.0003, (half, 1))).astype(np.float32)
+        multi = run_sweep_multi(
+            [(x[:half], y[:half]), (extra_x, extra_y)],
+            x[half:], y[half:], rf, x, CFG, [1, 2],
+            dataset_names=["real", "gen0"])
+        assert multi.dataset_names == ["real", "gen0"]
+        assert len(multi.results) == 2
+        assert multi.chunk_stats is not None
+        assert multi.chunk_stats.lanes == 4
+        for res in multi.results:
+            assert res.is_r2.shape == (2,)
+            assert res.stop_epoch.shape == (2,)
+            assert np.isfinite(res.sharpe_post).all()
+        # name lookup returns the aligned result
+        assert multi["gen0"] is multi.results[1]
+
+
+# ---------------------------------------------------- obs emissions
+class TestChunkObs:
+    def test_emit_chunk_stats_gauges(self, xs, tmp_path):
+        with obs_pkg.session(tmp_path / "run") as obs:
+            _, stats = train_autoencoder_chunked(
+                jax.random.PRNGKey(0), xs, EARLY_CFG)
+            ae_engine.emit_chunk_stats(stats)
+        events = [json.loads(line) for line in
+                  (tmp_path / "run" / "events.jsonl").open()]
+        gauges = {e["name"]: e["value"] for e in events
+                  if e["type"] == "metric" and e["kind"] == "gauge"}
+        assert gauges["ae/epochs_saved"] == stats.epochs_saved > 0
+        assert gauges["ae/lanes_stopped"] == 1
+        counters = {e["name"]: e["value"] for e in events
+                    if e["type"] == "metric" and e["kind"] == "counter"}
+        assert counters["ae_chunks_dispatched"] == stats.chunks_dispatched
+
+    def test_emit_chunk_stats_noop_when_disabled(self, xs):
+        _, stats = train_autoencoder_chunked(
+            jax.random.PRNGKey(0), xs, EARLY_CFG)
+        ae_engine.emit_chunk_stats(stats)   # no session: must not raise
+        ae_engine.emit_chunk_stats(None)
+
+
+# ------------------------------------------------------ bench probe
+def test_bench_ae_self_test_smoke():
+    """The probe's fast path: runs in seconds, asserts the >=2x win on
+    the early-exit fixture, prints one JSON line, exits 0.  The
+    telemetry env is stripped so a developer's exported HFREP_OBS_DIR
+    cannot make the smoke test ingest into the committed store."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("HFREP_OBS_DIR", "HFREP_HISTORY")}
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "bench_ae.py"),
+         "--self-test"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "ae_sweep_chunk_speedup"
+    assert doc["value"] >= 2.0
+    assert doc["self_check"] == "ok"
+    assert doc["epochs_saved"] > 0
+    assert doc["lanes_stopped"] == doc["lanes"]
+    assert doc["stop_epoch_max"] < 240 // 4
+
+
+def test_augment_training_sets_builds_real_plus_variants():
+    from hfrep_tpu.experiments.augment import AugmentedData, augment_training_sets
+
+    g = np.random.default_rng(0)
+    x = g.normal(size=(20, 6)).astype(np.float32)
+    y = g.normal(size=(20, 4)).astype(np.float32)
+    aug = AugmentedData(
+        factors=jnp.asarray(g.normal(size=(8, 6)), jnp.float32),
+        hf=jnp.asarray(g.normal(size=(8, 4)), jnp.float32),
+        rf=None, raw_windows=jnp.zeros((1, 8, 10)))
+    sets = augment_training_sets(x, y, [aug, aug])
+    assert len(sets) == 3
+    assert sets[0][0].shape == (20, 6)          # real first
+    assert sets[1][0].shape == (28, 6)          # synthetic rows stacked above
+    np.testing.assert_array_equal(np.asarray(sets[1][0][8:]), x)
+
+
+def test_rows_info_exact_validation_boundary():
+    """The padded paths' validation-split boundary must be computed
+    host-side in float64: float32(0.9) * 10 floors to 8 where the dense
+    path's int(10 * 0.9) is 9."""
+    cfg = dataclasses.replace(CFG, val_split=0.1)
+    _, fit = ae_engine._rows_info(cfg, 10)
+    assert int(fit) == int(10 * (1.0 - 0.1)) == 9
+    _, fit_vec = ae_engine._rows_info(cfg, np.asarray([10, 167]))
+    assert fit_vec.tolist() == [9, 150]

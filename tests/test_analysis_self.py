@@ -26,7 +26,7 @@ def test_repo_is_clean_under_static_analysis():
     # can never check different target lists
     proc = subprocess.run(
         ["bash", str(REPO_ROOT / "tools" / "check.sh")],
-        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, (
         "static analysis found non-baselined violations:\n"

@@ -452,3 +452,150 @@ def test_trace_capture_noop_without_dir_or_obs():
     with obs_pkg.trace_capture() as trace_dir:
         pass
     assert trace_dir is None
+
+
+# ------------------------------------------- per-host gauge folding
+def test_merge_folds_gauge_vectors_pod_conservatively():
+    """merge_run_dirs must fold each gauge across hosts (min where
+    higher is better — the slowest host gates the pod), not take the
+    leader's value (ROADMAP open item): proc1's slower bench gauges are
+    the pod's truth even though proc0 is the leader."""
+    merged = hist_mod.merge_run_dirs(FX / "multihost")
+    assert merged["gauges"]["bench/headline_steps_per_sec"] == 537.346
+    assert merged["gauges"]["bench/prod_168x36_steps_per_sec"] == 163.353
+
+
+def test_fold_gauges_direction_rules():
+    summaries = [
+        {"gauges": {"bench/x_steps_per_sec": 100.0, "bench/y_time_ms": 5.0,
+                    "mfu": 0.4}},
+        {"gauges": {"bench/x_steps_per_sec": 90.0, "bench/y_time_ms": 9.0,
+                    "mfu": 0.3, "only_here": 1.0}},
+    ]
+    folded = hist_mod.fold_gauges(summaries)
+    assert folded["bench/x_steps_per_sec"] == 90.0     # rate: min
+    assert folded["bench/y_time_ms"] == 9.0            # cost: max
+    assert folded["mfu"] == 0.3                        # table rule: up -> min
+    assert folded["only_here"] == 1.0                  # single host passes through
+
+
+def test_merged_record_carries_folded_gauges():
+    rec = hist_mod.merged_record(FX / "multihost")
+    assert rec["metrics"]["bench/headline_steps_per_sec"] == 537.346
+
+
+# ------------------------------------------------- trend-slope drift
+def test_trend_slope_math():
+    assert regress.trend_slope([1.0, 2.0]) is None     # two points: no trend
+    assert regress.trend_slope([1.0, 2.0, 3.0]) == pytest.approx(1.0)
+    assert regress.trend_slope([5.0, 5.0, 5.0, 5.0]) == pytest.approx(0.0)
+    assert regress.trend_slope([10.0, 8.0, 6.0, 4.0]) == pytest.approx(-2.0)
+
+
+def test_sustained_drift_warns_without_tripping_level_gate():
+    """The BENCH_r01-r05 pattern: every step inside the 5% level gate,
+    cumulative drift far beyond it — the slope flags, the gate stays
+    green (warn-only), and the verdict carries the drifting metric."""
+    series = [591.6, 585.0, 578.0, 571.0, 565.0]
+    c = regress.check_metric("steps_per_sec", 558.0, series)
+    assert c["status"] == "ok"
+    assert c["drift"] is True
+    assert c["slope_frac"] < 0
+    rec = {"run_id": "r", "key": {}, "metrics": {"steps_per_sec": 558.0}}
+    hist = [{"run_id": f"h{i}", "key": {}, "metrics": {"steps_per_sec": v}}
+            for i, v in enumerate(series)]
+    verdict = regress.check_run(rec, hist)
+    assert verdict["ok"] is True
+    assert verdict["drifts"] == ["steps_per_sec"]
+    rendered = regress.render_verdict(verdict)
+    assert "DRIFT WARNING" in rendered and "slope" in rendered
+
+
+def test_stable_and_improving_series_do_not_drift():
+    stable = regress.check_metric(
+        "steps_per_sec", 589.0, [591.6, 588.0, 592.0, 587.5, 590.0])
+    assert stable["drift"] is False
+    improving = regress.check_metric(
+        "steps_per_sec", 610.0, [580.0, 585.0, 590.0, 600.0, 605.0])
+    assert improving["drift"] is False
+    # a cost metric drifts UP: memory creeping toward the ceiling
+    creep = regress.check_metric(
+        "memory_high_water_bytes", 1.30e9,
+        [1.00e9, 1.07e9, 1.14e9, 1.21e9, 1.27e9])
+    assert creep["status"] == "ok" and creep["drift"] is True
+
+
+def test_drift_never_fires_alongside_regression():
+    """A level regression outranks the warn — the drift flag is defined
+    only for runs the level gate passed."""
+    c = regress.check_metric("steps_per_sec", 400.0,
+                             [591.6, 585.0, 578.0, 571.0, 565.0])
+    assert c["status"] == "regression"
+    assert c.get("drift") is False
+
+
+def test_gate_cli_surfaces_drift_in_json_verdict(tmp_path):
+    """`obs gate --format json` must carry the drifts list (ROADMAP:
+    'obs gate surfacing the slope in its verdict')."""
+    run = FX / "run_d"
+    h = tmp_path / "h.jsonl"
+    base = hist_mod.summarize_run(run)
+    # seed a drifting steps/sec series around the fixture run's own key
+    for i, v in enumerate([600.0, 590.0, 580.0, 570.0, 560.0]):
+        rec = json.loads(json.dumps(base))
+        rec["run_id"] = f"seed{i}"
+        rec["created_unix"] = 1000.0 + i
+        rec["metrics"]["steps_per_sec"] = v
+        assert hist_mod.append_record(h, rec)
+    proc = _gate(str(run), "--history", str(h), "--format", "json")
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True                   # warn-only
+    assert "steps_per_sec" in doc["drifts"]
+    (check,) = [c for c in doc["checks"] if c["metric"] == "steps_per_sec"]
+    assert check["slope"] < 0 and check["drift"] is True
+
+
+# ------------------------------------- repo-default store + gate tail
+def test_default_store_points_at_committed_file():
+    store = hist_mod.default_store()
+    assert store is not None
+    assert store.name == "history.jsonl"
+    assert "_bench_history" in str(store)
+    hist_mod.load_history(store, strict=True)  # committed store parses
+
+
+def test_resolve_history_env_overrides_and_arming(tmp_path, monkeypatch):
+    monkeypatch.setenv("HFREP_HISTORY", str(tmp_path / "h.jsonl"))
+    assert hist_mod.resolve_history("/some/run") == str(tmp_path / "h.jsonl")
+    # env wins even without a run dir (the caller warns separately)
+    assert hist_mod.resolve_history(None) == str(tmp_path / "h.jsonl")
+    monkeypatch.delenv("HFREP_HISTORY")
+    # no run dir recorded -> nothing to gate -> default store stays dark
+    assert hist_mod.resolve_history(None) is None
+    # run dir + committed default store -> armed
+    assert hist_mod.resolve_history("/some/run") == str(
+        hist_mod.default_store())
+
+
+def test_gate_and_ingest_tail(tmp_path, capsys):
+    """The shared bench tail: clean run gates + ingests; a regressed run
+    returns 1 and is NOT ingested; a corrupt store exits 2."""
+    h = tmp_path / "h.jsonl"
+    # insufficient history: passes and ingests
+    assert hist_mod.gate_and_ingest(FX / "run_d", h, 0) == 0
+    assert len(hist_mod.load_history(h)) == 1
+    # an already-failing rc skips the ingest (not a clean run)
+    assert hist_mod.gate_and_ingest(FX / "run_d", h, 1) == 1
+    assert len(hist_mod.load_history(h)) == 1
+    capsys.readouterr()
+    # corrupt store: tooling exit 2 via SystemExit, never a perf code
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"not": "a history record"}\n{"also": "bad"}\n')
+    with pytest.raises(SystemExit) as exc:
+        hist_mod.gate_and_ingest(FX / "run_d", bad, 0)
+    assert exc.value.code == 2
+
+
+def test_gate_and_ingest_flags_regression_against_fixture_history():
+    rc = hist_mod.gate_and_ingest(FX / "regressed", HIST, 0)
+    assert rc == 1

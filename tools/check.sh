@@ -22,3 +22,11 @@ python -m hfrep_tpu.obs report --self-test 1>&2
 # (strict; emits one pure-JSON result doc, routed to stderr here for the
 # same stdout-purity reason).
 python -m hfrep_tpu.obs gate --self-test 1>&2
+# AE chunked-drive probe fast path: trains the early-exit fixture at tiny
+# shapes and asserts the >=2x chunked-vs-monolithic win, so the probe (and
+# the hot path it guards) can't rot.  Pinned to CPU (a self-test of the
+# mechanism, not a measurement of the backend) and stripped of the
+# telemetry env: ambient HFREP_OBS_DIR/HFREP_HISTORY must not make a CI
+# self-test ingest a non-measurement record into the committed store.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
+    python tools/bench_ae.py --self-test 1>&2
