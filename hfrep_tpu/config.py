@@ -57,6 +57,10 @@ class TrainConfig:
     log_every: int = 50
     checkpoint_every: int = 1000   # reference saves only at end (GAN/MTSS_WGAN_GP.py:285-287)
     checkpoint_dir: Optional[str] = None
+    checkpoint_keep: int = 0       # retain only the newest N periodic
+                                   # checkpoints (0 = keep all); retention
+                                   # runs after each atomic save so a
+                                   # 5000-epoch run can't fill the disk
     steps_per_call: int = 50       # host↔device round-trips amortized via lax.scan
                                    # (50 measures ~7% faster than 25 through the
                                    # tunnel's ~4ms dispatch latency)

@@ -151,6 +151,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "early-exit AE training path (AEConfig.chunk_epochs "
                         "override; 0 = monolithic single-scan, results "
                         "bit-identical either way)")
+    s.add_argument("--resume", action="store_true",
+                   help="preemption-safe sweep: snapshot lane state at "
+                        "every chunk boundary under <out>/_resume, drain "
+                        "gracefully on SIGTERM (exit 75), and — when a "
+                        "snapshot from a killed run exists — resume from "
+                        "the last completed chunk with results "
+                        "bit-identical to an uninterrupted run")
     s.add_argument("--plots", action="store_true")
     s.add_argument("--stats", action="store_true",
                    help="full stats battery for the best latent (cell 25): "
@@ -300,8 +307,17 @@ def cmd_train_gan(args) -> int:
     # BEFORE trainer construction — the parallel step builders'
     # instrument_step hook decides at build time
     import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu.resilience import Preempted
     with obs_pkg.session(obs_dir, command="train-gan", preset=args.preset):
-        return _cmd_train_gan_impl(args)
+        try:
+            return _cmd_train_gan_impl(args)
+        except Preempted as e:
+            # graceful drain: the final checkpoint is on disk and the obs
+            # session's run_end still lands; 75 = EX_TEMPFAIL (re-run with
+            # --resume to continue the schedule)
+            print(f"preempted: {e}; re-run with --resume to continue",
+                  file=sys.stderr)
+            return 75
 
 
 def _cmd_train_gan_impl(args) -> int:
@@ -322,8 +338,9 @@ def _cmd_train_gan_impl(args) -> int:
             print("no checkpoint to resume from; training from scratch")
         else:
             # restore failures (e.g. a partial checkpoint) must propagate,
-            # not silently retrain from scratch
-            trainer.restore_checkpoint(path)
+            # not silently retrain from scratch; a corrupt newest
+            # checkpoint falls back, so report the path actually restored
+            path = trainer.restore_checkpoint(path)
             print(f"resumed from {path} (epoch {trainer.epoch})")
             # recovery completes the original schedule, not epochs on top
             target = max(0, target - trainer.epoch)
@@ -425,9 +442,20 @@ def cmd_eval_gan(args) -> int:
 
 def cmd_sweep(args) -> int:
     import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu.resilience import Preempted
     obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
     with obs_pkg.session(obs_dir, command="sweep", latents=args.latents):
-        return _cmd_sweep_impl(args)
+        try:
+            return _cmd_sweep_impl(args)
+        except Preempted as e:
+            # only the --resume path has a snapshot to come back to; a
+            # bare sweep would silently retrain from scratch on re-run
+            hint = ("re-run the same command to resume from the last chunk"
+                    if args.resume else
+                    "no snapshot was kept (run with --resume to make the "
+                    "sweep resumable)")
+            print(f"preempted: {e}; {hint}", file=sys.stderr)
+            return 75
 
 
 def _sample_augmentations(args, panel):
@@ -471,6 +499,7 @@ def _cmd_sweep_impl(args) -> int:
         cfg = dataclasses.replace(cfg, epochs=args.epochs)
     if args.chunk_epochs is not None:
         cfg = dataclasses.replace(cfg, chunk_epochs=args.chunk_epochs)
+    resume_dir = os.path.join(args.out, "_resume") if args.resume else None
 
     augs, gen_names = _sample_augmentations(args, panel)
     if len(augs) > 1:
@@ -482,7 +511,7 @@ def _cmd_sweep_impl(args) -> int:
         multi = run_sweep_multi(
             datasets, x_test, y_test, rf_test, panel.factors, cfg,
             _parse_latents(args.latents), strategy_names=panel.hf_names,
-            dataset_names=["real"] + gen_names)
+            dataset_names=["real"] + gen_names, resume_dir=resume_dir)
         multi.save(args.out)
         doc = {name: res.summary()
                for name, res in zip(multi.dataset_names, multi.results)}
@@ -502,7 +531,7 @@ def _cmd_sweep_impl(args) -> int:
               f"({augs[0].factors.shape[0]} synthetic)")
     result = run_sweep(x_train, y_train, x_test, y_test, rf_test,
                        panel.factors, cfg, _parse_latents(args.latents),
-                       strategy_names=panel.hf_names)
+                       strategy_names=panel.hf_names, resume_dir=resume_dir)
     result.save(args.out)
     print(json.dumps(result.summary(), indent=2, default=str))
     return _sweep_outputs(args, result, args.out, panel, y_test, rf_test)

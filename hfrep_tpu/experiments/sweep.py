@@ -103,19 +103,31 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
               cfg: Optional[AEConfig] = None,
               latent_dims: Sequence[int] = tuple(range(1, 22)),
               key: Optional[jax.Array] = None,
-              strategy_names: Optional[Sequence[str]] = None) -> SweepResult:
+              strategy_names: Optional[Sequence[str]] = None,
+              resume_dir: Optional[str] = None) -> SweepResult:
     """Train all latent dims in one vmapped program, then evaluate each.
 
     ``x_train``/``y_train`` may be GAN-augmented (synthetic rows stacked
     above real rows); ``x_test``/``y_test``/``rf_test`` are always the
     real OOS panels, and ``factor_full`` the full-sample factor panel the
     cost model draws trailing covariance windows from.
+
+    ``resume_dir`` makes the training drive preemption-safe: lane state
+    is snapshotted at every chunk boundary, SIGTERM drains gracefully
+    (:class:`~hfrep_tpu.resilience.Preempted`), and a re-run with the
+    same arguments resumes from the last chunk bit-identically.  Only
+    meaningful on the chunked path — the monolithic single-scan drive
+    (``cfg.chunk_epochs == 0``) has no safe boundary to resume from.
     """
     cfg = cfg or AEConfig()
     key = key if key is not None else jax.random.PRNGKey(cfg.seed)
     latent_dims = list(latent_dims)
     max_latent = max(latent_dims)
     cfg = dataclasses.replace(cfg, latent_dim=max_latent)
+    if resume_dir is not None and not (cfg.chunk_epochs and cfg.chunk_epochs > 0):
+        raise ValueError("resume_dir requires the chunked drive "
+                         "(cfg.chunk_epochs > 0); the monolithic scan has "
+                         "no chunk boundary to resume from")
 
     engine = ReplicationEngine(x_train, y_train, x_test, y_test, cfg)
     if cfg.chunk_epochs and cfg.chunk_epochs > 0:
@@ -123,7 +135,8 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
         # latent lane's early stopping fired — bit-identical results to
         # the monolithic scan (pinned by test), minus the dead epochs
         swept, stats = sweep_autoencoders_chunked(key, engine.x_train, cfg,
-                                                  latent_dims)
+                                                  latent_dims,
+                                                  resume_dir=resume_dir)
         emit_chunk_stats(stats)
     else:
         swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
@@ -191,7 +204,8 @@ def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
                     key: Optional[jax.Array] = None,
                     strategy_names: Optional[Sequence[str]] = None,
                     dataset_names: Optional[Sequence[str]] = None,
-                    mesh=None) -> MultiSweepResult:
+                    mesh=None,
+                    resume_dir: Optional[str] = None) -> MultiSweepResult:
     """The cross-dataset sweep fabric: K+1 training sets × L latent dims
     as ONE vmapped chunked program instead of K+1 serial sweeps.
 
@@ -212,11 +226,19 @@ def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
     jitted chunk program follows its operand shardings (the row-count
     vector stays host-derived: the engine reads it back to compute the
     exact validation boundaries anyway).
+
+    ``resume_dir``: chunk-boundary snapshots + resume for the fused
+    (K+1)×L program, same contract as :func:`run_sweep` — a killed
+    multi-dataset sweep resumes bit-identically (pinned by test).
     """
     cfg = cfg or AEConfig()
     key = key if key is not None else jax.random.PRNGKey(cfg.seed)
     latent_dims = list(latent_dims)
     cfg = dataclasses.replace(cfg, latent_dim=max(latent_dims))
+    if resume_dir is not None and not (cfg.chunk_epochs and cfg.chunk_epochs > 0):
+        raise ValueError("resume_dir requires the chunked drive "
+                         "(cfg.chunk_epochs > 0); the monolithic scan has "
+                         "no chunk boundary to resume from")
     names = (list(dataset_names) if dataset_names is not None
              else [f"dataset_{d}" for d in range(len(datasets))])
     if len(names) != len(datasets):
@@ -230,7 +252,8 @@ def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
         x_stack = jax.device_put(
             x_stack, NamedSharding(mesh, PartitionSpec("dp")))
     swept, stats = sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
-                                            latent_dims)
+                                            latent_dims,
+                                            resume_dir=resume_dir)
     emit_chunk_stats(stats)
 
     results = [

@@ -162,6 +162,19 @@ class Obs:
         self.events_path = self.run_dir / "events.jsonl"
         self._rotate_previous_run()
         self._fh: Optional[IO] = open(self.events_path, "a")
+        # fault-injection hook for the append stream (HFREP_FAULTS
+        # io_fail@obs_append=N): None unless a plan is active at sink
+        # construction, so the per-emit cost stays one `if`.  Only an
+        # ImportError (bootstrap ordering) degrades to no-hook — a
+        # malformed HFREP_FAULTS spec must raise here as loudly as it
+        # does at the first boundary tick, not silently disable every
+        # fault in the plan (active_plan caches the env read).
+        try:
+            from hfrep_tpu.resilience import io_hook
+        except ImportError:
+            self._io_fault = None
+        else:
+            self._io_fault = io_hook("obs_append")
         self._flush_every = max(1, flush_every)
         self._t0 = time.perf_counter()
         self._stack: List[str] = []          # open span names (nesting)
@@ -196,6 +209,8 @@ class Obs:
             return
         rec = {"v": SCHEMA_VERSION, "t": round(self.now(), 6), **rec}
         try:
+            if self._io_fault is not None:
+                self._io_fault()
             self._fh.write(json.dumps(rec, default=str) + "\n")
             self._n_events += 1
             if self._n_events % self._flush_every == 0:

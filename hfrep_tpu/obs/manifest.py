@@ -120,8 +120,22 @@ def write_manifest(run_dir, extra: Optional[dict] = None,
     if extra:
         doc.update(extra)
     path = run_dir / MANIFEST_NAME
-    path.write_text(json.dumps(doc, indent=2, default=str) + "\n")
+    _write_with_retry(path, json.dumps(doc, indent=2, default=str) + "\n")
     return path
+
+
+def _write_with_retry(path: Path, text: str) -> None:
+    """Manifest writes go through the bounded I/O retry policy (ISSUE 5):
+    a flaky-storage blip must not take down ``enable()`` — nor go
+    unrecorded (each retry is an ``io_retry`` event + counter).  The
+    ``manifest`` fault-injection site lives inside the retried call."""
+    from hfrep_tpu import resilience
+
+    def _write():
+        resilience.io_point("manifest")
+        path.write_text(text)
+
+    resilience.retry_io(_write, what="manifest")
 
 
 def _update_manifest(run_dir, mutate) -> None:
@@ -136,7 +150,7 @@ def _update_manifest(run_dir, mutate) -> None:
         doc = {}
     mutate(doc)
     try:
-        path.write_text(json.dumps(doc, indent=2, default=str) + "\n")
+        _write_with_retry(path, json.dumps(doc, indent=2, default=str) + "\n")
     except OSError:
         pass
 

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from hfrep_tpu import resilience
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core import costs
 from hfrep_tpu.core import scaler as mm
@@ -300,26 +301,55 @@ def _rows_info(cfg: AEConfig, n_rows) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
                  lanes: int, n_lanes_init: int = 0,
+                 resume_dir: Optional[str] = None,
                  ) -> Tuple[AEResult, ChunkStats]:
     """The shared drive tail of every chunked public entry point: init
     carry, dispatch chunks until ``all(stopped)``, assemble the
     bit-identical :class:`AEResult` and the :class:`ChunkStats`
-    accounting."""
+    accounting.
+
+    ``resume_dir`` makes the drive preemption-safe: the carry pytree,
+    accumulated traces and chunk counter are persisted there at every
+    chunk boundary (crash-consistent — see
+    :class:`~hfrep_tpu.resilience.snapshot.ChunkSnapshot`), a SIGTERM
+    drains at the boundary instead of dying mid-dispatch
+    (:class:`~hfrep_tpu.resilience.Preempted`), and a re-run against the
+    same (cfg, key, data) resumes from the last completed chunk with
+    bit-identical final results (the snapshot fingerprint refuses
+    foreign state).  The per-chunk snapshot costs one carry
+    ``device_get`` + atomic write per boundary, so it is opt-in.
+    """
+    snap = None
+    if resume_dir is not None:
+        from hfrep_tpu.resilience.snapshot import ChunkSnapshot, digest_arrays
+        snap = ChunkSnapshot(resume_dir, fingerprint={
+            "cfg": list(dataclasses.astuple(cfg)), "kind": kind,
+            "lanes": lanes,
+            "operands": digest_arrays(keys, xs, masks, rows_info)})
     carry, epoch_keys = _init_program(cfg, kind, n_lanes_init)(keys, xs)
     fn = _chunk_fn(cfg, kind)
-    carry, (tl, vl, st), dispatched, chunks = _drive_chunks(
-        lambda c, ks: fn(c, ks, xs, masks, rows_info), carry, epoch_keys,
-        cfg.epochs, cfg.chunk_epochs)
+    with resilience.graceful_drain():
+        carry, (tl, vl, st), dispatched, chunks = _drive_chunks(
+            lambda c, ks: fn(c, ks, xs, masks, rows_info), carry, epoch_keys,
+            cfg.epochs, cfg.chunk_epochs, snapshot=snap)
     res = _ae_result(carry[0], tl, vl, st, cfg.epochs)
     stats = ChunkStats(chunks_dispatched=chunks, epochs_dispatched=dispatched,
                        epochs_total=cfg.epochs,
                        chunk_epochs=cfg.chunk_epochs or cfg.epochs,
                        lanes=lanes,
                        lanes_stopped=_lanes_stopped(res.stop_epoch, cfg.epochs))
+    if snap is not None:
+        snap.clear()
     return res, stats
 
 
-def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int):
+def _concat_traces(traces: list) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    return tuple(jnp.concatenate([t[i] for t in traces], axis=-1)
+                 for i in range(3))
+
+
+def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
+                  snapshot=None):
     """The host side of chunked early-exit training.
 
     Dispatches ``chunk_epochs``-long jitted scans, reading back ONE scalar
@@ -330,23 +360,56 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int):
     therefore :func:`_ae_result` — are bit-identical to the single-scan
     path.  Returns ``(carry, (tl, vl, stop_trace), epochs_dispatched,
     chunks_dispatched)``.
+
+    ``snapshot`` (a :class:`~hfrep_tpu.resilience.snapshot.ChunkSnapshot`)
+    adds the preemption story: resume state is loaded before the loop
+    and persisted after every chunk, and each boundary crossing passes
+    through :func:`hfrep_tpu.resilience.boundary` — where injected
+    faults fire and a requested drain raises
+    :class:`~hfrep_tpu.resilience.Preempted` (state already on disk).
+    The boundary is honored even without a snapshot: a SIGTERM'd
+    un-snapshotted drive still exits cleanly between dispatches rather
+    than mid-write.
     """
     chunk = int(chunk_epochs) if chunk_epochs and chunk_epochs > 0 else epochs
     traces: list = []
     pos = 0
     chunks = 0
-    while pos < epochs:
+    stopped_all = False
+    if snapshot is not None:
+        loaded = snapshot.load(carry)
+        if loaded is not None:
+            carry, tr, pos, chunks, stopped_all = loaded
+            traces.append(tr)
+            from hfrep_tpu.obs import get_obs
+            obs = get_obs()
+            if obs.enabled:
+                obs.counter("resilience/resumes").inc()
+                obs.event("chunk_resume", pos=pos, chunks=chunks,
+                          epochs=epochs, path=str(snapshot.path))
+    while pos < epochs and not stopped_all:
         length = min(chunk, epochs - pos)
         carry, tr = chunk_fn(carry, keys[..., pos:pos + length, :])
         traces.append(tr)
         pos += length
         chunks += 1
         # one scalar device→host sync per chunk decides continue/stop
-        if pos < epochs and bool(jax.device_get(jnp.all(carry[4]))):
-            break
-    tl = jnp.concatenate([t[0] for t in traces], axis=-1)
-    vl = jnp.concatenate([t[1] for t in traces], axis=-1)
-    st = jnp.concatenate([t[2] for t in traces], axis=-1)
+        if pos < epochs:
+            stopped_all = bool(jax.device_get(jnp.all(carry[4])))
+        if snapshot is not None:
+            snapshot.save(carry, _concat_traces(traces), pos, chunks,
+                          stopped_all)
+        try:
+            resilience.boundary("chunk")
+        except resilience.Preempted as e:
+            # re-raise with the drive's context: Preempted renders its
+            # message at construction, so mutating attrs on the caught
+            # one would lose "state persisted at ..." from the operator
+            raise resilience.Preempted(
+                site=e.site, reason=e.reason, epoch=pos,
+                snapshot=(str(snapshot.path)
+                          if snapshot is not None else None)) from None
+    tl, vl, st = _concat_traces(traces)
     if pos < epochs:
         lead = tl.shape[:-1]
         pad = (epochs - pos,)
@@ -365,6 +428,7 @@ def _lanes_stopped(stop_epoch: jnp.ndarray, epochs: int) -> int:
 def train_autoencoder_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
                               cfg: AEConfig,
                               mask: Optional[jnp.ndarray] = None,
+                              resume_dir: Optional[str] = None,
                               ) -> Tuple[AEResult, ChunkStats]:
     """:func:`train_autoencoder` as a chunked early-exit drive.
 
@@ -373,10 +437,11 @@ def train_autoencoder_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
     epoch ~60 executes ~2 chunks instead of the full 1000-epoch scan.
     The returned :class:`AEResult` is bit-identical to the monolithic
     scan's (pinned by test); :class:`ChunkStats` reports what the exit
-    saved.
+    saved.  ``resume_dir`` enables chunk-boundary snapshots + resume
+    (see :func:`_run_chunked`).
     """
     return _run_chunked(cfg, "single", key, x_train_scaled, mask, None,
-                        lanes=1)
+                        lanes=1, resume_dir=resume_dir)
 
 
 def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig,
@@ -393,6 +458,7 @@ def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfi
 
 def sweep_autoencoders_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
                                cfg: AEConfig, latent_dims: Sequence[int],
+                               resume_dir: Optional[str] = None,
                                ) -> Tuple[AEResult, ChunkStats]:
     """:func:`sweep_autoencoders` as a chunked early-exit drive.
 
@@ -400,14 +466,16 @@ def sweep_autoencoders_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
     dispatching until ``all(stopped)`` across the sweep — the slowest lane
     bounds the dispatch count, but nothing pays for the full 1000-epoch
     scan once the last lane has stopped.  Bit-identical results to the
-    monolithic vmapped sweep (pinned by test).
+    monolithic vmapped sweep (pinned by test).  ``resume_dir`` makes the
+    21-lane sweep preemption-safe: killed mid-sweep, a re-run resumes
+    from the last chunk with bit-identical results (pinned by test).
     """
     max_latent = max(latent_dims)
     cfg = dataclasses.replace(cfg, latent_dim=max_latent)
     masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
     lane_keys = jax.random.split(key, len(latent_dims))
     return _run_chunked(cfg, "lanes", lane_keys, x_train_scaled, masks, None,
-                        lanes=len(latent_dims))
+                        lanes=len(latent_dims), resume_dir=resume_dir)
 
 
 # ------------------------------------------- padded multi-dataset sweep
@@ -430,6 +498,7 @@ def stack_padded(x_list: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarra
 def sweep_autoencoders_padded(key: jax.Array, x_pad: jnp.ndarray,
                               n_rows, cfg: AEConfig,
                               latent_dims: Sequence[int],
+                              resume_dir: Optional[str] = None,
                               ) -> Tuple[AEResult, ChunkStats]:
     """One padded dataset's latent sweep — the serial unit
     :func:`sweep_autoencoders_multi` batches across datasets.  ``x_pad``
@@ -442,12 +511,14 @@ def sweep_autoencoders_padded(key: jax.Array, x_pad: jnp.ndarray,
     masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
     lane_keys = jax.random.split(key, len(latent_dims))
     return _run_chunked(cfg, "lanes", lane_keys, x_pad, masks,
-                        _rows_info(cfg, n_rows), lanes=len(latent_dims))
+                        _rows_info(cfg, n_rows), lanes=len(latent_dims),
+                        resume_dir=resume_dir)
 
 
 def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
                              n_rows: jnp.ndarray, cfg: AEConfig,
                              latent_dims: Sequence[int],
+                             resume_dir: Optional[str] = None,
                              ) -> Tuple[AEResult, ChunkStats]:
     """The cross-dataset sweep fabric: every (dataset, latent) pair as one
     vmapped chunked program.
@@ -470,7 +541,7 @@ def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
     return _run_chunked(cfg, "multi", dkeys, x_stack, masks,
                         _rows_info(cfg, n_rows),
                         lanes=int(x_stack.shape[0]) * n_lanes,
-                        n_lanes_init=n_lanes)
+                        n_lanes_init=n_lanes, resume_dir=resume_dir)
 
 
 def emit_chunk_stats(stats: Optional[ChunkStats]) -> None:

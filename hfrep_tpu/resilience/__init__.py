@@ -1,0 +1,250 @@
+"""hfrep_tpu.resilience — fault injection + preemption-safe recovery.
+
+The reference saves only the generator, only once, after the full
+5000-epoch run (``GAN/MTSS_WGAN_GP.py:285-287``) — a crash loses
+everything.  On preemptible accelerator fleets (the Podracer pattern,
+arxiv 2104.06272) a training system is defined by how it survives
+SIGTERM, torn writes and flaky storage.  This package provides the
+machinery and the means to *test* it:
+
+* **fault injection** — a deterministic, env-driven plan
+  (``HFREP_FAULTS``, :mod:`hfrep_tpu.resilience.faults`) that fires
+  SIGTERM/preemption at a chosen chunk/block boundary, fails host-side
+  I/O (checkpoint save, obs append, manifest writes) on the Nth call,
+  and tears/corrupts checkpoint bytes after a save;
+* **graceful drain** — :func:`graceful_drain` installs a SIGTERM handler
+  for the duration of a training drive; the drives poll
+  :func:`drain_requested` at their natural sync points (chunk/block
+  boundaries), persist state, and raise :class:`Preempted` instead of
+  dying mid-write;
+* **bounded I/O retry** — :func:`retry_io` wraps host-side writes
+  (checkpoints, run manifests) in a small exponential-backoff policy,
+  surfaced as ``resilience/io_retries`` counters and ``io_retry``
+  events in the obs stream;
+* **chunk-boundary resume** — :class:`~hfrep_tpu.resilience.snapshot.
+  ChunkSnapshot` persists the chunked AE drives' carry pytree + chunk
+  counter at each boundary so a killed sweep resumes bit-identically
+  (``replication/engine.py``);
+* **selftest** — ``python -m hfrep_tpu.resilience selftest`` drives a
+  real training run through kill→resume and asserts bit-identical
+  results, plus corrupt-checkpoint → fallback-to-previous-good (wired
+  into ``tools/check.sh``).
+
+Everything here is host-side only; nothing runs inside ``jit``, and with
+no plan installed every hook is one ``None`` check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import time
+from typing import Callable, Optional
+
+from hfrep_tpu.resilience.faults import (  # noqa: F401  (public re-exports)
+    Directive,
+    FaultPlan,
+    FaultSpecError,
+)
+
+ENV_FAULTS = "HFREP_FAULTS"
+ENV_RETRIES = "HFREP_IO_RETRIES"
+
+
+class Preempted(RuntimeError):
+    """Graceful preemption: a drive stopped at a safe boundary after
+    persisting its state.  Callers translate this into a resumable exit
+    (the CLIs exit 75 / EX_TEMPFAIL) rather than a crash."""
+
+    def __init__(self, site: str, reason: Optional[str] = None,
+                 epoch: Optional[int] = None, snapshot: Optional[str] = None):
+        self.site, self.reason, self.epoch, self.snapshot = (
+            site, reason, epoch, snapshot)
+        msg = f"preempted at {site} boundary"
+        if epoch is not None:
+            msg += f" (epoch {epoch})"
+        if snapshot:
+            msg += f"; state persisted at {snapshot}"
+        if reason:
+            msg += f" [{reason}]"
+        super().__init__(msg)
+
+
+# ------------------------------------------------------------- fault plan
+_plan: Optional[FaultPlan] = None
+_env_consumed = False
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Activate a fault plan programmatically (tests, selftest)."""
+    global _plan, _env_consumed
+    _plan, _env_consumed = plan, True
+    return plan
+
+
+def clear_plan() -> None:
+    global _plan
+    _plan = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one parsed from ``HFREP_FAULTS`` (read
+    once per process — a plan's counters must persist across hooks)."""
+    global _plan, _env_consumed
+    if _plan is None and not _env_consumed:
+        _env_consumed = True
+        spec = os.environ.get(ENV_FAULTS)
+        if spec:
+            _plan = FaultPlan.parse(spec)
+    return _plan
+
+
+# ---------------------------------------------------------- graceful drain
+class _DrainState:
+    requested = False
+    reason: Optional[str] = None
+    depth = 0
+    installed = False
+    prev = None
+
+
+_DRAIN = _DrainState()
+
+
+def drain_requested() -> bool:
+    return _DRAIN.requested
+
+
+def request_drain(reason: str = "request") -> None:
+    """Ask every active drive to stop at its next safe boundary."""
+    first = not _DRAIN.requested
+    _DRAIN.requested = True
+    _DRAIN.reason = reason
+    if first:
+        try:
+            from hfrep_tpu.obs import get_obs
+            get_obs().event("preempt_requested", reason=reason)
+        except Exception:
+            pass
+
+
+def _sigterm_handler(signum, frame):
+    request_drain(f"signal {signum} (SIGTERM)")
+
+
+@contextlib.contextmanager
+def graceful_drain():
+    """Install the SIGTERM→drain handler while a training drive runs.
+
+    Re-entrant (the trainers and the chunked engine may nest); the
+    outermost exit restores the previous handler and clears the drain
+    flag, so a drained-and-resumed process is not instantly preempted
+    again.  In a non-main thread ``signal.signal`` is unavailable —
+    the drain flag still works via :func:`request_drain` and injected
+    ``preempt`` faults, only the OS signal route is off.
+    """
+    outermost = _DRAIN.depth == 0
+    _DRAIN.depth += 1
+    if outermost:
+        try:
+            _DRAIN.prev = signal.signal(signal.SIGTERM, _sigterm_handler)
+            _DRAIN.installed = True
+        except ValueError:              # not the main thread
+            _DRAIN.installed = False
+    try:
+        yield
+    finally:
+        _DRAIN.depth -= 1
+        if outermost:
+            if _DRAIN.installed:
+                try:
+                    signal.signal(signal.SIGTERM,
+                                  _DRAIN.prev or signal.SIG_DFL)
+                except ValueError:
+                    pass
+                _DRAIN.installed = False
+            _DRAIN.prev = None
+            _DRAIN.requested = False
+            _DRAIN.reason = None
+
+
+# ----------------------------------------------------------------- hooks
+def tick(site: str) -> None:
+    """Cross a boundary ``site`` for fault-injection purposes only — the
+    caller handles its own drain (checkpoint first, then raise)."""
+    plan = active_plan()
+    if plan is not None:
+        plan.boundary(site)
+
+
+def boundary(site: str) -> None:
+    """Cross a boundary: fire any injected faults for ``site``, then
+    raise :class:`Preempted` if a drain was requested.  For drives whose
+    state is already persisted when they cross (the chunked AE engine
+    snapshots *before* the boundary call)."""
+    tick(site)
+    if _DRAIN.requested:
+        raise Preempted(site=site, reason=_DRAIN.reason)
+
+
+def io_point(site: str) -> None:
+    """Fault-injection hook just before a host-side I/O operation."""
+    plan = active_plan()
+    if plan is not None:
+        plan.io(site)
+
+
+def io_hook(site: str) -> Optional[Callable[[], None]]:
+    """:func:`io_point` pre-bound for hot paths: ``None`` when no plan is
+    active at resolve time, so the caller's per-call cost is one ``if``."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return lambda: plan.io(site)
+
+
+def post_save(site: str, path) -> None:
+    """Fault-injection hook after a successful save of ``path``."""
+    plan = active_plan()
+    if plan is not None:
+        plan.post_save(site, path)
+
+
+# ------------------------------------------------------------------ retry
+def io_attempts(default: int = 3) -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_RETRIES, default)))
+    except ValueError:
+        return default
+
+
+def retry_io(fn: Callable, *, what: str, attempts: Optional[int] = None,
+             base_delay: float = 0.05, factor: float = 2.0,
+             sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn`` with a small bounded retry/backoff on ``OSError``.
+
+    The policy for host-side I/O that must survive flaky storage
+    (checkpoint saves, obs manifest writes): ``attempts`` tries total
+    (default 3, env override ``HFREP_IO_RETRIES``), exponential backoff
+    from ``base_delay``.  Each retry lands in the obs stream as an
+    ``io_retry`` event + ``resilience/io_retries`` counter; the final
+    failure propagates — bounded means bounded.
+    """
+    attempts = attempts if attempts is not None else io_attempts()
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt == attempts:
+                raise
+            delay = base_delay * (factor ** (attempt - 1))
+            try:
+                from hfrep_tpu.obs import get_obs
+                obs = get_obs()
+                obs.counter("resilience/io_retries").inc(site=what)
+                obs.event("io_retry", site=what, attempt=attempt,
+                          error=str(e), backoff_s=round(delay, 4))
+            except Exception:
+                pass
+            sleep(delay)

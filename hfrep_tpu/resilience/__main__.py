@@ -1,0 +1,10 @@
+"""``python -m hfrep_tpu.resilience`` — see selftest.py."""
+
+from __future__ import annotations
+
+import sys
+
+from hfrep_tpu.resilience.selftest import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

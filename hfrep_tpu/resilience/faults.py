@@ -1,0 +1,202 @@
+"""Deterministic fault injection: the ``HFREP_FAULTS`` spec.
+
+On preemptible TPU fleets the failure modes that matter — SIGTERM at an
+arbitrary point, torn checkpoint writes, flaky host-side storage — are
+exactly the ones a normal test run never exercises.  This module makes
+them *injectable on purpose*, deterministically, from one env variable,
+so kill→resume and corrupt→fallback paths can be driven end to end by
+``python -m hfrep_tpu.resilience selftest`` and by tier-1 tests.
+
+Spec grammar (semicolon-separated directives)::
+
+    HFREP_FAULTS = directive [';' directive]*
+    directive    = kind '@' site '=' N ['x' COUNT]
+
+``N`` is the 1-based occurrence of ``site`` that triggers the fault;
+``x COUNT`` fires it on that and the next ``COUNT - 1`` occurrences
+(default 1).  Kinds and the sites they apply to:
+
+======== ===================== ==========================================
+kind     sites                 effect at the Nth occurrence
+======== ===================== ==========================================
+sigterm  boundary (``chunk``,  a REAL ``os.kill(getpid(), SIGTERM)`` —
+         ``block``)            caught by the graceful-drain handler
+preempt  boundary              set the drain flag directly (no signal)
+io_fail  io (``ckpt_save``,    raise ``OSError(EIO)`` from that I/O call
+         ``snapshot_save``,
+         ``obs_append``,
+         ``manifest``)
+torn     post-save (``ckpt``,  truncate the just-written payload — a
+         ``snapshot``)         torn write that survived the process
+corrupt  post-save             flip bytes mid-payload (bit rot)
+======== ===================== ==========================================
+
+Examples::
+
+    HFREP_FAULTS='sigterm@chunk=2'            # kill at the 2nd chunk boundary
+    HFREP_FAULTS='io_fail@ckpt_save=1x2'      # first two save calls fail
+    HFREP_FAULTS='torn@ckpt=3;preempt@block=5'
+
+Occurrence counters live on the :class:`FaultPlan` instance, keyed by
+(kind group, site), so a plan's behavior is a pure function of the spec
+and the sequence of hook calls — no randomness, no wall clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import os
+import re
+import signal
+from pathlib import Path
+from typing import Dict, Iterable, Tuple
+
+BOUNDARY_KINDS = ("sigterm", "preempt")
+IO_KINDS = ("io_fail",)
+POST_SAVE_KINDS = ("torn", "corrupt")
+KINDS = BOUNDARY_KINDS + IO_KINDS + POST_SAVE_KINDS
+
+_DIRECTIVE_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<site>[a-z_]+)=(?P<n>[0-9]+)(?:x(?P<count>[0-9]+))?$")
+
+
+class FaultSpecError(ValueError):
+    """An ``HFREP_FAULTS`` spec that does not parse."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Directive:
+    kind: str
+    site: str
+    n: int            # 1-based occurrence that triggers
+    count: int = 1    # consecutive occurrences that fire
+
+    def hits(self, occurrence: int) -> bool:
+        return self.n <= occurrence < self.n + self.count
+
+
+def _group(kind: str) -> str:
+    if kind in BOUNDARY_KINDS:
+        return "boundary"
+    if kind in IO_KINDS:
+        return "io"
+    return "post_save"
+
+
+class FaultPlan:
+    """A parsed spec plus its per-(group, site) occurrence counters."""
+
+    def __init__(self, directives: Iterable[Directive]):
+        self.directives: Tuple[Directive, ...] = tuple(directives)
+        self._counts: Dict[Tuple[str, str], int] = {}
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        directives = []
+        for part in filter(None, (s.strip() for s in spec.split(";"))):
+            m = _DIRECTIVE_RE.match(part)
+            if m is None:
+                raise FaultSpecError(
+                    f"bad fault directive {part!r} (want kind@site=N[xCOUNT])")
+            kind = m.group("kind")
+            if kind not in KINDS:
+                raise FaultSpecError(
+                    f"unknown fault kind {kind!r} (one of {', '.join(KINDS)})")
+            n = int(m.group("n"))
+            if n < 1:
+                raise FaultSpecError(f"{part!r}: N is 1-based, got {n}")
+            directives.append(Directive(kind=kind, site=m.group("site"), n=n,
+                                        count=int(m.group("count") or 1)))
+        return cls(directives)
+
+    def _tick(self, group: str, site: str) -> int:
+        key = (group, site)
+        self._counts[key] = occ = self._counts.get(key, 0) + 1
+        return occ
+
+    def _matching(self, group: str, site: str, occ: int):
+        for d in self.directives:
+            if d.site == site and _group(d.kind) == group and d.hits(occ):
+                yield d
+
+    # ------------------------------------------------------------- hooks
+    def boundary(self, site: str) -> None:
+        """Called by the drives at each ``site`` boundary crossing."""
+        occ = self._tick("boundary", site)
+        for d in self._matching("boundary", site, occ):
+            _note(d, occ)
+            if d.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                from hfrep_tpu import resilience
+                resilience.request_drain(f"injected preempt@{site}={occ}")
+
+    def io(self, site: str) -> None:
+        """Called just before a host-side I/O operation at ``site``."""
+        occ = self._tick("io", site)
+        for d in self._matching("io", site, occ):
+            _note(d, occ)
+            raise OSError(errno.EIO, f"injected io_fail@{site} (call {occ})")
+
+    def post_save(self, site: str, path) -> None:
+        """Called after a successful save of ``path`` — may damage it."""
+        occ = self._tick("post_save", site)
+        for d in self._matching("post_save", site, occ):
+            _note(d, occ)
+            target = _payload_file(Path(path))
+            if target is None:
+                continue
+            if d.kind == "torn":
+                tear_file(target)
+            else:
+                corrupt_file(target)
+
+
+def _note(d: Directive, occ: int) -> None:
+    """Injected faults announce themselves in the telemetry stream (and
+    never anywhere that could mask the fault's own effect)."""
+    try:
+        from hfrep_tpu.obs import get_obs
+        get_obs().event("fault_injected", kind=d.kind, site=d.site,
+                        occurrence=occ)
+    except Exception:
+        pass
+
+
+def _payload_file(path: Path):
+    """The file whose bytes a torn/corrupt directive damages: the largest
+    non-metadata file under a checkpoint dir (or the path itself)."""
+    if path.is_file():
+        return path
+    best, best_size = None, -1
+    try:
+        for f in path.rglob("*"):
+            if f.is_file() and f.name != "meta.json":
+                size = f.stat().st_size
+                if size > best_size:
+                    best, best_size = f, size
+    except OSError:
+        return None
+    return best
+
+
+def tear_file(path: Path) -> None:
+    """Simulate a torn write: keep only the first half of the file."""
+    size = path.stat().st_size
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+def corrupt_file(path: Path) -> None:
+    """Simulate bit rot: XOR a 16-byte run in the middle of the file."""
+    size = path.stat().st_size
+    if size == 0:
+        return
+    start = size // 2
+    length = min(16, size - start) or size
+    with open(path, "r+b") as f:
+        f.seek(start)
+        chunk = f.read(length)
+        f.seek(start)
+        f.write(bytes(b ^ 0xFF for b in chunk))

@@ -1,0 +1,192 @@
+"""``python -m hfrep_tpu.resilience selftest`` — the kill→resume gate.
+
+Drives REAL training through the failure modes the package exists for,
+at fast fixture shapes (seconds on CPU), and asserts the recovery
+contracts hold bitwise:
+
+1. **Checkpoint cycle** — atomic save (embedded checksum'd ``meta.json``),
+   verified restore, injected *corrupt* and *torn* checkpoints detected
+   (:class:`~hfrep_tpu.utils.checkpoint.CheckpointCorrupt`) and
+   ``restore_latest_good`` falling back to the previous good checkpoint;
+   the msgpack (``coordination_free``) format round-trips.
+2. **Kill→resume, 21-lane sweep** — an injected REAL SIGTERM
+   (``sigterm@chunk=2``) lands mid-sweep; the graceful-drain handler
+   turns it into a chunk-boundary :class:`~hfrep_tpu.resilience.
+   Preempted` with state snapshotted; a re-run resumes from the last
+   chunk and must produce results **bit-identical** to an uninterrupted
+   run (params, loss traces, stop epochs).
+3. **Kill→resume, multi-dataset sweep** — same contract for the fused
+   (K+1)×L padded program, via the signal-free ``preempt`` injection.
+
+Exit 0 with one JSON line on stdout; any violated contract raises and
+exits 1.  Wired into ``tools/check.sh`` (env-stripped, CPU-pinned) next
+to the analyzer/obs/bench gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+
+def _fixture_panel(rows: int = 90, feats: int = 6):
+    import jax.numpy as jnp
+    from hfrep_tpu.core import scaler as mm
+    g = np.random.default_rng(11)
+    z = g.normal(size=(rows, 3))
+    x = (z @ g.normal(size=(3, feats))
+         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    return scaled
+
+
+def _assert_results_identical(a, b, what: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    la, lb = (jax.tree_util.tree_leaves(a.params),
+              jax.tree_util.tree_leaves(b.params))
+    assert len(la) == len(lb) and all(
+        bool(jnp.array_equal(x, y)) for x, y in zip(la, lb)), \
+        f"{what}: resumed params differ from the uninterrupted run"
+    for field in ("stop_epoch", "train_loss", "val_loss"):
+        assert np.array_equal(np.asarray(getattr(a, field)),
+                              np.asarray(getattr(b, field)),
+                              equal_nan=True), \
+            f"{what}: resumed {field} differs from the uninterrupted run"
+
+
+def _check_checkpoint_cycle(td: str) -> dict:
+    import jax.numpy as jnp
+    from hfrep_tpu.resilience import faults
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    d = os.path.join(td, "ckpts")
+    t1 = {"w": jnp.arange(8.0), "step": jnp.asarray(3)}
+    t2 = {"w": jnp.arange(8.0) * 2.0, "step": jnp.asarray(6)}
+    ckpt.save(os.path.join(d, "ckpt_1"), t1, metadata={"epoch": 1})
+    p2 = ckpt.save(os.path.join(d, "ckpt_2"), t2, metadata={"epoch": 2})
+    meta = ckpt.read_meta(p2)
+    assert meta and "checksum" in meta and "epoch" in meta, \
+        "meta.json (metadata + checksum) must live inside the checkpoint"
+
+    restored = ckpt.restore(p2, target=t1)
+    assert np.allclose(np.asarray(restored["w"]), np.arange(8.0) * 2.0)
+
+    # corrupt the newest payload → detected, and fallback lands on ckpt_1
+    faults.corrupt_file(faults._payload_file(Path(p2)))
+    try:
+        ckpt.restore(p2, target=t1)
+        raise AssertionError("corrupted checkpoint restored without error")
+    except ckpt.CheckpointCorrupt:
+        pass
+    good, path = ckpt.restore_latest_good(d, target=t1)
+    assert path.endswith("ckpt_1") and np.allclose(
+        np.asarray(good["w"]), np.arange(8.0)), \
+        "fallback must restore the previous good checkpoint"
+
+    # torn msgpack round-trip: coordination-free format + tear detection
+    p3 = ckpt.save(os.path.join(d, "ckpt_3"), t1, coordination_free=True)
+    assert (Path(p3) / "checkpoint.msgpack").exists()
+    r3 = ckpt.restore(p3, target=t2)
+    assert np.allclose(np.asarray(r3["w"]), np.arange(8.0))
+    faults.tear_file(Path(p3) / "checkpoint.msgpack")
+    try:
+        ckpt.restore(p3, target=t2)
+        raise AssertionError("torn checkpoint restored without error")
+    except ckpt.CheckpointCorrupt:
+        pass
+    good, path = ckpt.restore_latest_good(d, target=t1)
+    assert path.endswith("ckpt_1"), "fallback must skip the torn checkpoint"
+    return {"checkpoint_cycle": "ok"}
+
+
+def _kill_resume(td: str, name: str, spec: str, run) -> dict:
+    """``run(resume_dir)`` once uninterrupted (resume_dir=None), once
+    under the fault ``spec`` (must raise Preempted), once resuming —
+    the resumed results must be bit-identical."""
+    import hfrep_tpu.resilience as res
+
+    base, base_stats = run(None)
+    rd = os.path.join(td, name)
+    res.install_plan(res.FaultPlan.parse(spec))
+    try:
+        run(rd)
+        raise AssertionError(f"{name}: injected fault {spec!r} did not "
+                             "preempt the sweep")
+    except res.Preempted as e:
+        assert e.snapshot, f"{name}: drain must report the persisted snapshot"
+    finally:
+        res.clear_plan()
+    resumed, stats = run(rd)
+    _assert_results_identical(base, resumed, name)
+    assert not os.path.exists(os.path.join(rd, "chunk_snapshot")), \
+        f"{name}: snapshot must be cleared after a completed drive"
+    return {name: "ok", f"{name}_chunks": int(stats.chunks_dispatched),
+            f"{name}_lanes": int(stats.lanes)}
+
+
+def run_selftest() -> dict:
+    import dataclasses
+
+    import jax
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication.engine import (
+        stack_padded,
+        sweep_autoencoders_chunked,
+        sweep_autoencoders_multi,
+    )
+
+    xs = _fixture_panel()
+    doc: dict = {}
+    with tempfile.TemporaryDirectory(prefix="hfrep_resilience_") as td:
+        doc.update(_check_checkpoint_cycle(td))
+
+        # the paper's 21-lane latent sweep, shrunk to fixture epochs —
+        # a real vmapped training drive killed by a REAL SIGTERM
+        cfg = AEConfig(n_factors=6, latent_dim=21, epochs=24, batch_size=16,
+                       patience=3, seed=0, chunk_epochs=6)
+        dims = list(range(1, 22))
+        key = jax.random.PRNGKey(0)
+        doc.update(_kill_resume(
+            td, "lanes21", "sigterm@chunk=2",
+            lambda rd: sweep_autoencoders_chunked(key, xs, cfg, dims,
+                                                  resume_dir=rd)))
+
+        # the fused multi-dataset fabric (2 padded datasets × 3 lanes)
+        mcfg = dataclasses.replace(cfg, latent_dim=4)
+        stack, rows = stack_padded([xs, xs[:70]])
+        doc.update(_kill_resume(
+            td, "multi", "preempt@chunk=1",
+            lambda rd: sweep_autoencoders_multi(key, stack, rows, mcfg,
+                                                [1, 2, 3], resume_dir=rd)))
+    return doc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hfrep_tpu.resilience",
+        description="fault injection + recovery subsystem CLI")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("selftest",
+                   help="drive kill→resume + corrupt→fallback end to end "
+                        "and assert bit-identical recovery (fast fixture "
+                        "shapes; wired into tools/check.sh)")
+    ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    try:
+        doc = run_selftest()
+    except Exception as e:
+        print(json.dumps({"selftest": "FAIL", "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    doc["selftest"] = "ok"
+    doc["secs"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(doc))
+    return 0

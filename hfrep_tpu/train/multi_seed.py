@@ -31,13 +31,13 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from hfrep_tpu import resilience
 from hfrep_tpu.config import ExperimentConfig
 from hfrep_tpu.core.data import GanDataset
 from hfrep_tpu.models.registry import build_gan
-from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.states import GanState, init_gan_state
 from hfrep_tpu.train.steps import make_multi_step, make_train_step
 
 
@@ -92,7 +92,13 @@ def _seed_shard(step, mesh, jit: bool = True):
     wrapper is the same for a multi-epoch block and a single epoch (the
     trainer's remainder path must shard the RAW step, not a
     steps_per_call=1 block: the block scan folds the key per epoch,
-    a different stream than the standalone remainder epoch consumes)."""
+    a different stream than the standalone remainder epoch consumes).
+
+    ``jax.shard_map`` is imported here, not at module top: runtimes
+    without it (this image's jax) can still use the vmap path and the
+    checkpoint/resume machinery — only seed-sharded execution needs it.
+    """
+    from jax import shard_map
     (axis,) = mesh.axis_names
 
     def per_device(states, keys):
@@ -113,9 +119,17 @@ class MultiSeedTrainer:
     run key per block — so each member's parameter trajectory equals
     ``GanTrainer`` with ``train.seed = seeds[k]`` (same sample/noise/α
     streams; only reduction-order round-off differs).
-    Deliberately minimal (no checkpoint/logging pipeline): the intended
-    use is throughput-bound multi-seed studies; full training
-    infrastructure remains the single-model trainer's job.
+
+    Since ISSUE 5 this trainer carries the same preemption story as
+    :class:`~hfrep_tpu.train.trainer.GanTrainer` (a K-seed study is K×
+    the work to lose): periodic crash-consistent checkpoints of the
+    stacked state + per-member keys (``train.checkpoint_dir`` /
+    ``checkpoint_every`` / ``checkpoint_keep``), checksum-verified
+    restore with fallback to the previous good checkpoint, and a SIGTERM
+    handler that drains at a block boundary — final checkpoint, then
+    :class:`~hfrep_tpu.resilience.Preempted` — instead of dying
+    mid-write.  The logging pipeline remains the single-model trainer's
+    job.
     """
 
     def __init__(self, cfg: ExperimentConfig, dataset: GanDataset | jnp.ndarray,
@@ -186,19 +200,37 @@ class MultiSeedTrainer:
     def train(self, epochs: Optional[int] = None):
         from hfrep_tpu.obs import get_obs, mesh_attrs
         obs = get_obs()
-        spc = self.cfg.train.steps_per_call
-        epochs = epochs if epochs is not None else self.cfg.train.epochs
+        tcfg = self.cfg.train
+        spc = tcfg.steps_per_call
+        epochs = epochs if epochs is not None else tcfg.epochs
         n_full, remainder = divmod(epochs, spc)
         if obs.enabled:
             obs.event("multi_seed_train_start", members=self.n_seeds,
                       epochs=epochs, mesh=mesh_attrs(self.mesh),
                       mode="seed_sharded" if self.mesh is not None else "vmap")
         blocks = obs.counter("multi_seed_blocks")    # no-op when disabled
-        with obs.span("multi_seed_train", members=self.n_seeds, epochs=epochs):
+
+        def maybe_checkpoint(block_epochs: int) -> None:
+            # the modulo only under the full guard: checkpoint_every=0
+            # with no checkpoint_dir must keep training, not divide by 0
+            if (tcfg.checkpoint_dir and tcfg.checkpoint_every > 0
+                    and self.epoch % tcfg.checkpoint_every < block_epochs):
+                self.save_checkpoint()
+            resilience.tick("block")        # injected faults fire here
+            if resilience.drain_requested():
+                path = (self.save_checkpoint()
+                        if tcfg.checkpoint_dir else None)
+                obs.event("preempt_drain", epoch=self.epoch, checkpoint=path)
+                raise resilience.Preempted(site="block", epoch=self.epoch,
+                                           snapshot=path)
+
+        with resilience.graceful_drain(), \
+             obs.span("multi_seed_train", members=self.n_seeds, epochs=epochs):
             for _ in range(n_full):
                 self.states, _ = self._multi(self.states, self._split_keys())
                 self.epoch += spc
                 blocks.inc(member_epochs=self.n_seeds * spc)
+                maybe_checkpoint(spc)
             if remainder:
                 if self._one is None:
                     step = make_train_step(self.pair, self.cfg.train, self.windows)
@@ -209,6 +241,7 @@ class MultiSeedTrainer:
                 for _ in range(remainder):
                     self.states, _ = self._one(self.states, self._split_keys())
                     self.epoch += 1
+                    maybe_checkpoint(1)
             if obs.enabled:
                 # sync before the span closes so it times compute, not the
                 # async dispatches the loop queued
@@ -216,6 +249,67 @@ class MultiSeedTrainer:
         if obs.enabled:
             obs.memory_snapshot(phase="multi_seed_train_end")
         return self.states
+
+    # ---------------------------------------------------------- checkpoint
+    def _ckpt_tree(self):
+        import numpy as np
+        return {"states": self.states, "keys": self.keys,
+                "epoch": jnp.asarray(self.epoch),
+                "seeds": jnp.asarray(np.asarray(self.seeds, np.int64))}
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Atomic full-state checkpoint (stacked members + per-member run
+        keys + epoch), same crash-consistency contract as the
+        single-model trainer's."""
+        from hfrep_tpu.obs import get_obs
+        from hfrep_tpu.utils import checkpoint as ckpt
+        path = path or f"{self.cfg.train.checkpoint_dir}/ckpt_{self.epoch}"
+        obs = get_obs()
+        with obs.span("checkpoint", epoch=self.epoch, path=str(path)):
+            ckpt.save(path, self._ckpt_tree(),
+                      metadata={"family": self.cfg.model.family,
+                                "epoch": self.epoch, "members": self.n_seeds,
+                                "seeds": list(self.seeds)},
+                      keep=self.cfg.train.checkpoint_keep)
+        obs.counter("checkpoints").inc()
+        return path
+
+    def restore_checkpoint(self, path: Optional[str] = None) -> str:
+        """Restore ``path`` or the newest good checkpoint in the
+        configured dir (corrupt ones are skipped, like the single-model
+        trainer); refuses a checkpoint taken with different seeds — the
+        member axis would silently mean something else.  Returns the
+        path actually restored (≠ the requested one on fallback)."""
+        import numpy as np
+        from hfrep_tpu.utils import checkpoint as ckpt
+        ckpt_dir = self.cfg.train.checkpoint_dir
+        if path is not None:
+            try:
+                restored = ckpt.restore(path, target=self._ckpt_tree())
+            except ckpt.CheckpointCorrupt:
+                if not ckpt_dir:
+                    raise
+                restored, path = ckpt.restore_latest_good(
+                    ckpt_dir, target=self._ckpt_tree())
+        else:
+            if not ckpt_dir:
+                raise FileNotFoundError("no checkpoint found")
+            restored, path = ckpt.restore_latest_good(
+                ckpt_dir, target=self._ckpt_tree())
+        saved_seeds = tuple(int(s) for s in np.asarray(restored["seeds"]))
+        if saved_seeds != tuple(int(s) for s in self.seeds):
+            raise ValueError(
+                f"checkpoint {path} holds seeds {saved_seeds}, trainer was "
+                f"built with {tuple(self.seeds)}")
+        states = jax.tree_util.tree_map(jnp.asarray, restored["states"])
+        if not isinstance(states, GanState):
+            states = GanState(**{f: restored["states"][f] for f in
+                                 ("g_params", "d_params", "g_opt", "d_opt",
+                                  "step")})
+        self.states = states
+        self.keys = jnp.asarray(restored["keys"])
+        self.epoch = int(restored["epoch"])
+        return str(path)
 
     def generate(self, key: jax.Array, n_samples: int,
                  unscale: bool = True) -> jnp.ndarray:

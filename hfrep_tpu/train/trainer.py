@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hfrep_tpu import resilience
 from hfrep_tpu.config import ExperimentConfig
 from hfrep_tpu.core.data import GanDataset
 from hfrep_tpu.models.registry import build_gan
@@ -137,6 +138,12 @@ class GanTrainer:
         return state
 
     def _train_impl(self, epochs: Optional[int] = None) -> GanState:
+        # SIGTERM drains at a block boundary (final checkpoint + clean
+        # metrics) instead of killing the process mid-write
+        with resilience.graceful_drain():
+            return self._train_loop(epochs)
+
+    def _train_loop(self, epochs: Optional[int] = None) -> GanState:
         tcfg = self.cfg.train
         spc = tcfg.steps_per_call
         epochs = epochs if epochs is not None else tcfg.epochs
@@ -190,10 +197,16 @@ class GanTrainer:
                     steady_steps += spc
                 self.epoch += spc
                 done += 1
-                if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every < spc:
+                if (tcfg.checkpoint_dir and tcfg.checkpoint_every > 0
+                        and self.epoch % tcfg.checkpoint_every < spc):
                     close_steady()  # sync first: keep host logging out of the window
                     flush_pending()
                     self.save_checkpoint()
+                resilience.tick("block")        # injected faults fire here
+                if resilience.drain_requested():
+                    close_steady()
+                    flush_pending()
+                    self._drain_now()
             close_steady()
             flush_pending()
             pipeline_ok = True
@@ -233,10 +246,30 @@ class GanTrainer:
                 1, self.epoch)
             self.epoch += 1
             done += 1
-            if tcfg.checkpoint_dir and self.epoch % tcfg.checkpoint_every == 0:
+            if (tcfg.checkpoint_dir and tcfg.checkpoint_every > 0
+                    and self.epoch % tcfg.checkpoint_every == 0):
                 self.save_checkpoint()
+            resilience.tick("block")
+            if resilience.drain_requested():
+                self._drain_now()
         self.logger.flush()
         return self.state
+
+    def _drain_now(self) -> None:
+        """Graceful preemption at a block boundary: persist a final
+        checkpoint (when a checkpoint dir is configured), flush the
+        metric log, announce the drain in the obs stream, and raise
+        :class:`~hfrep_tpu.resilience.Preempted` — the CLI translates it
+        into a resumable exit instead of a mid-write death."""
+        path = (self.save_checkpoint()
+                if self.cfg.train.checkpoint_dir else None)
+        try:
+            self.logger.flush()
+        except Exception:
+            pass
+        get_obs().event("preempt_drain", epoch=self.epoch, checkpoint=path)
+        raise resilience.Preempted(site="block", epoch=self.epoch,
+                                   snapshot=path)
 
     def _guarded(self, fn, key):
         """Run one block; on non-finite metrics roll back and reseed.
@@ -344,16 +377,33 @@ class GanTrainer:
         with obs.span("checkpoint", epoch=self.epoch, path=str(path)):
             ckpt.save(path, self._ckpt_tree(),
                       metadata={"family": self.cfg.model.family, "epoch": self.epoch},
-                      coordination_free=multihost)
+                      coordination_free=multihost,
+                      keep=self.cfg.train.checkpoint_keep)
         obs.counter("checkpoints").inc()
         return path
 
-    def restore_checkpoint(self, path: Optional[str] = None) -> None:
+    def restore_checkpoint(self, path: Optional[str] = None) -> str:
+        """Restore ``path``, or the newest checkpoint in the configured
+        checkpoint dir that passes checksum verification — a torn or
+        corrupted checkpoint (preemption mid-save on a pre-atomic layout,
+        bit rot) falls back to the previous good one instead of raising
+        (``utils.checkpoint.restore_latest_good``).  Returns the path
+        actually restored, which on the fallback path is NOT the one
+        asked for — callers reporting "resumed from X" must use it."""
         ckpt_dir = self.cfg.train.checkpoint_dir
-        path = path or (ckpt.latest(ckpt_dir) if ckpt_dir else None)
-        if path is None:
-            raise FileNotFoundError("no checkpoint found")
-        restored = ckpt.restore(path, target=self._ckpt_tree())
+        if path is not None:
+            try:
+                restored = ckpt.restore(path, target=self._ckpt_tree())
+            except ckpt.CheckpointCorrupt:
+                if not ckpt_dir:
+                    raise
+                restored, path = ckpt.restore_latest_good(
+                    ckpt_dir, target=self._ckpt_tree())
+        else:
+            if not ckpt_dir:
+                raise FileNotFoundError("no checkpoint found")
+            restored, path = ckpt.restore_latest_good(
+                ckpt_dir, target=self._ckpt_tree())
         self.state = jax.tree_util.tree_map(jnp.asarray, restored["state"])
         if not isinstance(self.state, GanState):
             self.state = GanState(**{f: restored["state"][f] for f in
@@ -366,6 +416,7 @@ class GanTrainer:
             from hfrep_tpu.parallel.mesh import replicate_to_global
             self.state = replicate_to_global(self.state, self.mesh)
             self.key = replicate_to_global(self.key, self.mesh)
+        return str(path)
 
     # ------------------------------------------------------------ sampling
     def generate(self, key: jax.Array, n_samples: int,

@@ -1,4 +1,4 @@
-"""Checkpoint / resume (SURVEY §5.3-5.4 — near-absent in the reference).
+"""Crash-consistent checkpoint / resume (SURVEY §5.3-5.4).
 
 The reference saves only the generator, only once, after the full
 5000-epoch run (``GAN/MTSS_WGAN_GP.py:285-287``) — a crash loses
@@ -7,19 +7,54 @@ discarded.  Here a checkpoint is the complete training pytree: G and D
 params, both optimizer states, the step counter, the PRNG key, and the
 MinMax scaler params needed to inverse-transform generated samples.
 
+Durability model (ISSUE 5):
+
+* **Atomic publication** — every save materializes into a hidden tmp
+  directory next to the destination, fsyncs the payload, and becomes
+  visible in ONE ``rename``.  ``meta.json`` (caller metadata + a
+  sha256 content checksum over every payload file) lives INSIDE the
+  directory, so payload and metadata commit together — a crash can
+  leave a stale tmp dir, never a half-published checkpoint.
+* **Verified restore** — :func:`restore` recomputes the checksum before
+  decoding and raises :class:`CheckpointCorrupt` on a torn/rotted
+  checkpoint; :func:`restore_latest_good` walks a checkpoint directory
+  newest-first and falls back to the previous good one instead of
+  crashing (the fallback is announced in the obs stream).
+* **Bounded I/O retry** — the write path runs under
+  :func:`hfrep_tpu.resilience.retry_io` (flaky-storage policy; retries
+  surface as ``resilience/io_retries`` counters).
+* **Retention** — ``save(..., keep=N)`` prunes all but the newest N
+  numbered siblings (``ckpt_<n>``), so periodic checkpointing on a
+  long run cannot fill the disk.
+
 Backed by orbax's PyTree checkpointer (async-capable, TPU-sharding
 aware); falls back to msgpack via flax.serialization if orbax is
-unavailable at runtime.
+unavailable at runtime.  ``coordination_free=True`` forces the msgpack
+format — required for leader-only multi-host checkpointing of
+replicated state, where orbax's internal cross-process barrier would
+deadlock a single-process save.  Pre-ISSUE-5 checkpoints (no embedded
+``meta.json``) restore unchanged, just without verification.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
+import shutil
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
-import numpy as np
+
+from hfrep_tpu import resilience
+
+META_NAME = "meta.json"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed checksum verification or cannot be decoded
+    (torn write, bit rot, truncation)."""
 
 
 def _ocp():
@@ -27,53 +62,287 @@ def _ocp():
     return ocp
 
 
+# ---------------------------------------------------------------- checksum
+def compute_checksum(path) -> dict:
+    """sha256 per payload file (sorted relative paths, ``meta.json``
+    excluded) plus one aggregate digest over the file list."""
+    p = Path(path)
+    files = {}
+    for f in sorted(p.rglob("*")):
+        if f.is_file() and f.name != META_NAME:
+            files[f.relative_to(p).as_posix()] = hashlib.sha256(
+                f.read_bytes()).hexdigest()
+    agg = hashlib.sha256("\n".join(
+        f"{k}:{v}" for k, v in sorted(files.items())).encode()).hexdigest()
+    return {"algo": "sha256", "digest": agg, "files": files}
+
+
+def read_meta(path) -> Optional[dict]:
+    """The embedded ``meta.json``; None for legacy checkpoints without
+    one; :class:`CheckpointCorrupt` when present but unparseable."""
+    f = Path(path) / META_NAME
+    if not f.exists():
+        return None
+    try:
+        return json.loads(f.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorrupt(f"{path}: unreadable {META_NAME}: {e}") from e
+
+
+def verify(path) -> Optional[dict]:
+    """Checksum-verify a checkpoint directory.
+
+    Returns its metadata (None for legacy no-meta checkpoints, which
+    cannot be verified); raises :class:`CheckpointCorrupt` on mismatch.
+    """
+    meta = read_meta(path)
+    if meta is None or "checksum" not in meta:
+        return meta
+    want = meta["checksum"]
+    have = compute_checksum(path)
+    if have["digest"] != want.get("digest"):
+        missing = sorted(set(want.get("files", {})) - set(have["files"]))
+        detail = f" (missing files: {missing})" if missing else ""
+        raise CheckpointCorrupt(f"{path}: checksum mismatch{detail}")
+    return meta
+
+
+# ------------------------------------------------------------ atomic write
+def _fsync_path(p: Path) -> None:
+    fd = os.open(p, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def prev_path(dst) -> Path:
+    """Where :func:`_atomic_publish` parks the previous payload while
+    overwriting ``dst`` (and leaves it, under ``keep_prev=True``)."""
+    dst = Path(dst)
+    return dst.parent / f".{dst.name}.prev"
+
+
+def _atomic_publish(tmp: Path, dst: Path, keep_prev: bool = False) -> None:
+    """fsync the tree, then swap ``tmp`` into ``dst``.
+
+    A fresh publish is ONE rename.  Overwriting an existing ``dst``
+    cannot be a single rename on POSIX (directories don't replace), so
+    the previous payload is first parked at a *deterministic* sibling
+    (:func:`prev_path`) — a crash between the two renames leaves the
+    last complete payload there, where recovery-aware readers
+    (``ChunkSnapshot.load``) find it instead of nothing.  With
+    ``keep_prev=True`` the parked copy is retained even on success (one
+    bounded extra copy), closing the window entirely for payloads that
+    are overwritten at every boundary.
+    """
+    for f in tmp.rglob("*"):
+        if f.is_file():
+            _fsync_path(f)
+    for d in (tmp, *(x for x in tmp.rglob("*") if x.is_dir())):
+        try:
+            _fsync_path(d)              # not all filesystems fsync dirs
+        except OSError:
+            pass
+    if dst.exists():
+        prev = prev_path(dst)
+        if prev.exists():
+            shutil.rmtree(prev)
+        dst.rename(prev)
+        tmp.rename(dst)
+        if not keep_prev:
+            shutil.rmtree(prev, ignore_errors=True)
+    else:
+        tmp.rename(dst)
+    try:
+        _fsync_path(dst.parent)
+    except OSError:
+        pass
+
+
+def write_atomic(path, writer: Callable[[Path], Optional[dict]],
+                 metadata: Optional[dict] = None, *,
+                 io_site: str = "ckpt_save", fault_site: str = "ckpt",
+                 retry: bool = True, keep_prev: bool = False) -> Path:
+    """The one crash-consistent directory writer (checkpoints AND the
+    engine's chunk snapshots).
+
+    ``writer(tmp_dir)`` materializes the payload (its optional dict
+    return merges into the metadata); the checksum'd ``meta.json`` is
+    written beside it and the whole directory published atomically.
+    The write runs under the bounded I/O retry policy and passes
+    through the fault-injection hooks (``io_site`` before the write,
+    ``fault_site`` after success — where injected torn/corrupt
+    directives bite).
+    """
+    dst = Path(path).absolute()
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dst.parent / f".{dst.name}.tmp-{os.getpid()}"
+
+    def _write():
+        resilience.io_point(io_site)
+        if tmp.exists():                # a failed earlier attempt
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        meta = dict(metadata or {})
+        extra = writer(tmp)
+        if extra:
+            meta.update(extra)
+        meta["checksum"] = compute_checksum(tmp)
+        (tmp / META_NAME).write_text(json.dumps(meta, indent=2, default=str))
+        _atomic_publish(tmp, dst, keep_prev=keep_prev)
+
+    try:
+        if retry:
+            resilience.retry_io(_write, what=io_site)
+        else:
+            _write()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    resilience.post_save(fault_site, dst)
+    return dst
+
+
+# ------------------------------------------------------------- save/restore
+def _write_msgpack(dst: Path, pytree: Any) -> None:
+    import flax.serialization as ser
+    (dst / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
+
+
 def save(path: str, pytree: Any, metadata: Optional[dict] = None,
-         coordination_free: bool = False) -> None:
-    """``coordination_free=True`` writes the msgpack format directly —
+         coordination_free: bool = False, keep: int = 0) -> str:
+    """Atomically write ``pytree`` (and ``metadata``) as a checkpoint.
+
+    ``coordination_free=True`` writes the msgpack format directly —
     required for leader-only multi-host checkpointing of replicated
     state, where orbax's internal cross-process barrier would deadlock a
-    single-process save (the other processes never reach it)."""
+    single-process save (the other processes never reach it).
+
+    ``keep > 0`` prunes all but the newest ``keep`` sibling checkpoints
+    sharing this one's numbered naming scheme (``ckpt_<epoch>``).
+    """
     p = Path(path).absolute()
-    p.parent.mkdir(parents=True, exist_ok=True)
     pytree = jax.device_get(pytree)
 
-    def _msgpack():
-        import flax.serialization as ser
-        p.mkdir(parents=True, exist_ok=True)
-        (p / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
-
-    if coordination_free:
-        _msgpack()
-    else:
+    def writer(tmp: Path) -> dict:
+        if coordination_free:
+            _write_msgpack(tmp, pytree)
+            return {"format": "msgpack"}
         try:
             ckptr = _ocp().PyTreeCheckpointer()
-            ckptr.save(p, pytree, force=True)
+            ckptr.save(tmp / "tree", pytree, force=True)
+            return {"format": "orbax"}
         except Exception:
-            _msgpack()
-    if metadata is not None:
-        (p.parent / (p.name + ".meta.json")).write_text(json.dumps(metadata))
+            shutil.rmtree(tmp / "tree", ignore_errors=True)
+            _write_msgpack(tmp, pytree)
+            return {"format": "msgpack"}
+
+    write_atomic(p, writer, metadata)
+    if keep > 0:
+        prefix, digits = _split_numbered(p.name)
+        if digits is not None:
+            retain(p.parent, keep, prefix=prefix)
+    return str(p)
 
 
-def restore(path: str, target: Any = None) -> Any:
+def restore(path: str, target: Any = None, verify_checksum: bool = True) -> Any:
+    """Restore one checkpoint, checksum-verified when it carries a
+    checksum; decode failures surface as :class:`CheckpointCorrupt` so
+    callers (:func:`restore_latest_good`) can fall back."""
     p = Path(path).absolute()
+    if not p.exists():
+        raise FileNotFoundError(str(p))
+    if verify_checksum:
+        verify(p)
     msgpack = p / "checkpoint.msgpack"
     if msgpack.exists():
         import flax.serialization as ser
         if target is None:
             raise ValueError("msgpack restore requires a target pytree")
-        return ser.from_bytes(target, msgpack.read_bytes())
-    ckptr = _ocp().PyTreeCheckpointer()
-    restored = ckptr.restore(p, item=jax.device_get(target) if target is not None else None)
-    return restored
+        try:
+            return ser.from_bytes(target, msgpack.read_bytes())
+        except Exception as e:
+            raise CheckpointCorrupt(f"{p}: msgpack decode failed: {e}") from e
+    tree = p / "tree" if (p / "tree").exists() else p
+    try:
+        ckptr = _ocp().PyTreeCheckpointer()
+        return ckptr.restore(
+            tree, item=jax.device_get(target) if target is not None else None)
+    except ImportError:
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(f"{p}: orbax restore failed: {e}") from e
+
+
+def restore_latest_good(dirpath: str, target: Any = None,
+                        prefix: str = "ckpt_") -> Tuple[Any, str]:
+    """Restore the newest checkpoint that verifies and decodes, falling
+    back past torn/corrupted ones instead of crashing.
+
+    Returns ``(pytree, path)``.  Each skipped checkpoint lands in the
+    obs stream as a ``ckpt_fallback`` event (+ counter); raises
+    :class:`FileNotFoundError` when the directory holds no candidates
+    and :class:`CheckpointCorrupt` when none of them restores.
+    """
+    cands = _numbered(dirpath, prefix)
+    if not cands:
+        raise FileNotFoundError(f"no {prefix}* checkpoints under {dirpath}")
+    errors: List[str] = []
+    for cand in reversed(cands):
+        try:
+            out = restore(str(cand), target)
+        except (CheckpointCorrupt, FileNotFoundError) as e:
+            errors.append(f"{cand.name}: {e}")
+            try:
+                from hfrep_tpu.obs import get_obs
+                obs = get_obs()
+                obs.counter("resilience/ckpt_fallbacks").inc()
+                obs.event("ckpt_fallback", skipped=cand.name, error=str(e))
+            except Exception:
+                pass
+            continue
+        return out, str(cand)
+    raise CheckpointCorrupt(
+        f"no restorable checkpoint under {dirpath}: " + "; ".join(errors))
+
+
+# --------------------------------------------------------------- retention
+def _split_numbered(name: str) -> Tuple[str, Optional[str]]:
+    """``'ckpt_120' -> ('ckpt_', '120')``; non-numbered names get
+    ``(name, None)`` and are exempt from retention."""
+    i = len(name)
+    while i > 0 and name[i - 1].isdigit():
+        i -= 1
+    digits = name[i:]
+    return (name[:i], digits) if digits else (name, None)
+
+
+def _numbered(dirpath, prefix: str) -> List[Path]:
+    """Numbered checkpoint dirs under ``dirpath``, oldest first."""
+    d = Path(dirpath)
+    if not d.exists():
+        return []
+    cands = [
+        p for p in d.iterdir()
+        if p.is_dir() and p.name.startswith(prefix)
+        and p.name[len(prefix):].isdigit()
+    ]
+    cands.sort(key=lambda p: int(p.name[len(prefix):]))
+    return cands
+
+
+def retain(dirpath: str, keep: int, prefix: str = "ckpt_") -> List[str]:
+    """Delete all but the newest ``keep`` numbered checkpoints; returns
+    the removed paths (best-effort — retention must never fail a save)."""
+    if keep <= 0:
+        return []
+    removed = []
+    for doomed in _numbered(dirpath, prefix)[:-keep]:
+        shutil.rmtree(doomed, ignore_errors=True)
+        removed.append(str(doomed))
+    return removed
 
 
 def latest(dirpath: str, prefix: str = "ckpt_") -> Optional[str]:
-    d = Path(dirpath)
-    if not d.exists():
-        return None
-    cands = [
-        p for p in d.iterdir()
-        if p.is_dir() and p.name.startswith(prefix) and p.name[len(prefix):].isdigit()
-    ]
-    cands.sort(key=lambda p: int(p.name[len(prefix):]))
+    cands = _numbered(dirpath, prefix)
     return str(cands[-1]) if cands else None

@@ -1,0 +1,552 @@
+"""Preemption-safe training fabric (ISSUE 5 acceptance): deterministic
+fault injection, crash-consistent checkpoints (atomic publish, checksum
+verify, fallback-to-previous-good), chunk-boundary resume bit-identical
+to the uninterrupted run (21-lane + multi-dataset AE sweeps), graceful
+SIGTERM drain in every trainer, and the bounded I/O retry policy."""
+
+import dataclasses
+import json
+import os
+import signal
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+import hfrep_tpu.resilience as res
+from hfrep_tpu.config import AEConfig, ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.replication.engine import (
+    stack_padded,
+    sweep_autoencoders_chunked,
+    sweep_autoencoders_multi,
+)
+from hfrep_tpu.resilience import FaultPlan, FaultSpecError, Preempted, faults
+from hfrep_tpu.resilience.snapshot import ChunkSnapshot
+from hfrep_tpu.utils import checkpoint as ckpt
+
+CFG = AEConfig(n_factors=6, latent_dim=4, epochs=40, batch_size=16,
+               patience=3, seed=0, chunk_epochs=8)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends without an installed fault plan."""
+    res.clear_plan()
+    yield
+    res.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def xs():
+    g = np.random.default_rng(11)
+    z = g.normal(size=(90, 3))
+    x = (z @ g.normal(size=(3, 6))
+         + 0.05 * g.normal(size=(90, 6))).astype(np.float32) * 0.02
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    return scaled
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool(jnp.array_equal(x, y)) for x, y in zip(la, lb))
+
+
+def _results_identical(a, b) -> None:
+    assert _trees_equal(a.params, b.params)
+    for field in ("stop_epoch", "train_loss", "val_loss"):
+        assert np.array_equal(np.asarray(getattr(a, field)),
+                              np.asarray(getattr(b, field)), equal_nan=True)
+
+
+# ------------------------------------------------------------ fault spec
+class TestFaultSpec:
+    def test_parse_directives(self):
+        plan = FaultPlan.parse("sigterm@chunk=2;io_fail@ckpt_save=1x3; "
+                               "torn@ckpt=4")
+        kinds = [(d.kind, d.site, d.n, d.count) for d in plan.directives]
+        assert kinds == [("sigterm", "chunk", 2, 1),
+                         ("io_fail", "ckpt_save", 1, 3),
+                         ("torn", "ckpt", 4, 1)]
+
+    @pytest.mark.parametrize("bad", ["sigterm@chunk", "what@chunk=1",
+                                     "sigterm@chunk=0", "io_fail=3"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.parse(bad)
+
+    def test_io_fail_fires_on_nth_call_only(self):
+        plan = res.install_plan(FaultPlan.parse("io_fail@ckpt_save=2"))
+        plan.io("ckpt_save")                      # call 1: clean
+        with pytest.raises(OSError):
+            plan.io("ckpt_save")                  # call 2: injected
+        plan.io("ckpt_save")                      # call 3: clean again
+
+    def test_preempt_directive_sets_drain_flag(self):
+        res.install_plan(FaultPlan.parse("preempt@block=1"))
+        with res.graceful_drain():
+            res.tick("block")
+            assert res.drain_requested()
+        assert not res.drain_requested()          # cleared on context exit
+
+    def test_env_plan_is_read_once(self, monkeypatch):
+        monkeypatch.setenv("HFREP_FAULTS", "preempt@chunk=1")
+        monkeypatch.setattr(res, "_plan", None)
+        monkeypatch.setattr(res, "_env_consumed", False)
+        plan = res.active_plan()
+        assert plan is not None and plan.directives[0].kind == "preempt"
+        assert res.active_plan() is plan
+
+
+# ------------------------------------------------------------- I/O retry
+class TestRetry:
+    def test_retry_recovers_and_counts(self, tmp_path):
+        res.install_plan(FaultPlan.parse("io_fail@manifest=1"))
+        calls = []
+        with obs_pkg.session(tmp_path / "run"):
+            out = res.retry_io(
+                lambda: (res.io_point("manifest"), calls.append(1), "ok")[-1],
+                what="manifest", sleep=lambda s: None)
+        assert out == "ok"
+        events = [json.loads(line) for line in
+                  (tmp_path / "run" / "events.jsonl").open()]
+        retries = [e for e in events if e.get("name") == "io_retry"]
+        assert len(retries) == 1 and retries[0]["site"] == "manifest"
+        counters = {e["name"]: e["value"] for e in events
+                    if e.get("kind") == "counter"}
+        assert counters["resilience/io_retries"] == 1
+
+    def test_retry_is_bounded(self):
+        res.install_plan(FaultPlan.parse("io_fail@ckpt_save=1x99"))
+        with pytest.raises(OSError):
+            res.retry_io(lambda: res.io_point("ckpt_save"),
+                         what="ckpt_save", attempts=3, sleep=lambda s: None)
+
+    def test_manifest_write_retried_through_enable(self, tmp_path):
+        # the 1st manifest write fails; enable() must still succeed and
+        # record the retry in the stream it just opened
+        res.install_plan(FaultPlan.parse("io_fail@manifest=1"))
+        with obs_pkg.session(tmp_path / "run"):
+            pass
+        assert (tmp_path / "run" / "run.json").exists()
+        events = [json.loads(line) for line in
+                  (tmp_path / "run" / "events.jsonl").open()]
+        assert any(e.get("name") == "io_retry" for e in events)
+
+    def test_obs_append_fault_never_kills_the_run(self, tmp_path):
+        # telemetry swallows injected append failures exactly like real
+        # ones: the faulted event is dropped, the stream stays alive
+        # (run_start is append call 1, so call 2 = the "first" event)
+        res.install_plan(FaultPlan.parse("io_fail@obs_append=2"))
+        with obs_pkg.session(tmp_path / "run") as obs:
+            obs.event("first")
+            obs.event("second")
+        names = [json.loads(line).get("name") for line in
+                 (tmp_path / "run" / "events.jsonl").open()]
+        assert "first" not in names                # the injected drop
+        assert "second" in names and "run_end" in names
+
+
+# ------------------------------------------------- checkpoint durability
+class TestCheckpoint:
+    def test_meta_folded_into_checkpoint_dir(self, tmp_path):
+        tree = {"w": jnp.arange(4.0)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree, metadata={"epoch": 1})
+        meta = ckpt.read_meta(p)
+        assert meta["epoch"] == 1
+        assert meta["checksum"]["algo"] == "sha256"
+        assert meta["format"] in ("orbax", "msgpack")
+        # no non-atomic sidecar, no leftover tmp/trash dirs
+        leftovers = [q.name for q in tmp_path.iterdir() if q.name != "ckpt_1"]
+        assert leftovers == []
+
+    def test_corrupt_restore_raises_and_falls_back(self, tmp_path):
+        t1 = {"w": jnp.arange(4.0)}
+        t2 = {"w": jnp.arange(4.0) * 2}
+        ckpt.save(str(tmp_path / "ckpt_1"), t1)
+        p2 = ckpt.save(str(tmp_path / "ckpt_2"), t2)
+        faults.corrupt_file(faults._payload_file(Path(p2)))
+        with pytest.raises(ckpt.CheckpointCorrupt):
+            ckpt.restore(p2, target=t1)
+        out, path = ckpt.restore_latest_good(str(tmp_path), target=t1)
+        assert path.endswith("ckpt_1")
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+
+    def test_torn_msgpack_detected(self, tmp_path):
+        tree = {"w": jnp.arange(6.0)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree, coordination_free=True)
+        faults.tear_file(Path(p) / "checkpoint.msgpack")
+        with pytest.raises(ckpt.CheckpointCorrupt):
+            ckpt.restore(p, target=tree)
+
+    def test_injected_torn_directive_bites_the_saved_checkpoint(self, tmp_path):
+        res.install_plan(FaultPlan.parse("torn@ckpt=1"))
+        tree = {"w": jnp.arange(6.0)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree)
+        with pytest.raises(ckpt.CheckpointCorrupt):
+            ckpt.restore(p, target=tree)
+
+    def test_msgpack_fallback_when_orbax_unavailable(self, tmp_path, monkeypatch):
+        def no_orbax():
+            raise ImportError("orbax not in this container")
+        monkeypatch.setattr(ckpt, "_ocp", no_orbax)
+        tree = {"w": jnp.arange(4.0), "n": jnp.asarray(3)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree)
+        assert (Path(p) / "checkpoint.msgpack").exists()
+        assert ckpt.read_meta(p)["format"] == "msgpack"
+        out = ckpt.restore(p, target={"w": jnp.zeros(4), "n": jnp.asarray(0)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+        assert int(out["n"]) == 3
+
+    def test_msgpack_restore_requires_target(self, tmp_path):
+        tree = {"w": jnp.arange(4.0)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree, coordination_free=True)
+        with pytest.raises(ValueError, match="target"):
+            ckpt.restore(p)
+
+    def test_save_failure_retried_via_policy(self, tmp_path):
+        res.install_plan(FaultPlan.parse("io_fail@ckpt_save=1"))
+        tree = {"w": jnp.arange(4.0)}
+        p = ckpt.save(str(tmp_path / "ckpt_1"), tree)   # retry absorbs call 1
+        out = ckpt.restore(p, target=tree)
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+
+    def test_retention_keeps_newest_n(self, tmp_path):
+        tree = {"w": jnp.arange(2.0)}
+        for e in (1, 2, 3, 4):
+            ckpt.save(str(tmp_path / f"ckpt_{e}"), tree, keep=2)
+        names = sorted(q.name for q in tmp_path.iterdir())
+        assert names == ["ckpt_3", "ckpt_4"]
+
+    def test_legacy_checkpoint_without_meta_still_restores(self, tmp_path):
+        # pre-ISSUE-5 layout: orbax/msgpack payload, no embedded meta.json
+        import flax.serialization as ser
+        tree = {"w": jnp.arange(4.0)}
+        legacy = tmp_path / "ckpt_1"
+        legacy.mkdir()
+        (legacy / "checkpoint.msgpack").write_bytes(ser.to_bytes(tree))
+        out = ckpt.restore(str(legacy), target={"w": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+
+
+# ------------------------------------------------- chunk-boundary resume
+class TestChunkResume:
+    def test_sigterm_mid_sweep_then_resume_bit_identical_21_lanes(self, xs):
+        """The acceptance pin: a REAL SIGTERM (delivered through the
+        graceful-drain handler) mid-21-lane-sweep, then resume, equals
+        the uninterrupted run bitwise."""
+        cfg = dataclasses.replace(CFG, latent_dim=21, epochs=24,
+                                  chunk_epochs=6)
+        dims = list(range(1, 22))
+        key = jax.random.PRNGKey(0)
+        base, base_stats = sweep_autoencoders_chunked(key, xs, cfg, dims)
+        rd = None
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            rd = td
+            res.install_plan(FaultPlan.parse("sigterm@chunk=2"))
+            try:
+                with pytest.raises(Preempted) as ei:
+                    sweep_autoencoders_chunked(key, xs, cfg, dims,
+                                               resume_dir=rd)
+            finally:
+                res.clear_plan()
+            assert ei.value.site == "chunk"
+            assert ei.value.snapshot and os.path.exists(ei.value.snapshot)
+            resumed, stats = sweep_autoencoders_chunked(key, xs, cfg, dims,
+                                                        resume_dir=rd)
+            _results_identical(base, resumed)
+            assert stats.chunks_dispatched == base_stats.chunks_dispatched
+            assert not os.path.exists(os.path.join(rd, "chunk_snapshot"))
+
+    def test_preempt_mid_multi_sweep_then_resume_bit_identical(self, xs,
+                                                               tmp_path):
+        """The acceptance pin for the fused multi-dataset fabric."""
+        key = jax.random.PRNGKey(4)
+        dims = [1, 2, 3]
+        stack, rows = stack_padded([xs, xs[:70]])
+        base, _ = sweep_autoencoders_multi(key, stack, rows, CFG, dims)
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(Preempted):
+                sweep_autoencoders_multi(key, stack, rows, CFG, dims,
+                                         resume_dir=str(tmp_path))
+        finally:
+            res.clear_plan()
+        resumed, _ = sweep_autoencoders_multi(key, stack, rows, CFG, dims,
+                                              resume_dir=str(tmp_path))
+        _results_identical(base, resumed)
+
+    def test_resume_emits_obs_event(self, xs, tmp_path):
+        key = jax.random.PRNGKey(0)
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(Preempted):
+                sweep_autoencoders_chunked(key, xs, CFG, [1, 2],
+                                           resume_dir=str(tmp_path / "rd"))
+        finally:
+            res.clear_plan()
+        with obs_pkg.session(tmp_path / "run"):
+            sweep_autoencoders_chunked(key, xs, CFG, [1, 2],
+                                       resume_dir=str(tmp_path / "rd"))
+        events = [json.loads(line) for line in
+                  (tmp_path / "run" / "events.jsonl").open()]
+        resumes = [e for e in events if e.get("name") == "chunk_resume"]
+        assert len(resumes) == 1 and resumes[0]["chunks"] == 1
+
+    def test_foreign_snapshot_is_refused(self, xs, tmp_path):
+        # a snapshot from key A must not contaminate a key-B run
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(Preempted):
+                sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                           [1, 2], resume_dir=str(tmp_path))
+        finally:
+            res.clear_plan()
+        fresh = sweep_autoencoders_chunked(jax.random.PRNGKey(9), xs, CFG,
+                                           [1, 2])[0]
+        other = sweep_autoencoders_chunked(jax.random.PRNGKey(9), xs, CFG,
+                                           [1, 2],
+                                           resume_dir=str(tmp_path))[0]
+        _results_identical(fresh, other)
+
+    def test_corrupt_snapshot_degrades_to_fresh_start(self, xs, tmp_path):
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(Preempted):
+                sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                           [1, 2], resume_dir=str(tmp_path))
+        finally:
+            res.clear_plan()
+        snap = tmp_path / "chunk_snapshot"
+        faults.corrupt_file(faults._payload_file(snap))
+        base = sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                          [1, 2])[0]
+        resumed = sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                             [1, 2],
+                                             resume_dir=str(tmp_path))[0]
+        _results_identical(base, resumed)
+
+    def test_crash_mid_overwrite_falls_back_one_chunk(self, xs, tmp_path):
+        """The overwrite publish can't be one rename (POSIX dirs): a
+        crash between the two renames leaves the previous boundary's
+        payload at the deterministic .prev sibling, and load() resumes
+        from there — one chunk of progress lost, never the drive."""
+        res.install_plan(FaultPlan.parse("preempt@chunk=2"))
+        try:
+            with pytest.raises(Preempted):
+                sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                           [1, 2], resume_dir=str(tmp_path))
+        finally:
+            res.clear_plan()
+        # simulate the torn overwrite: the live snapshot vanished mid-swap,
+        # only the parked previous (chunk-1) payload survives
+        live = tmp_path / "chunk_snapshot"
+        prev = ckpt.prev_path(live)
+        assert prev.exists()            # retained by keep_prev
+        import shutil
+        shutil.rmtree(live)
+        base = sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                          [1, 2])[0]
+        resumed, stats = sweep_autoencoders_chunked(
+            jax.random.PRNGKey(0), xs, CFG, [1, 2], resume_dir=str(tmp_path))
+        _results_identical(base, resumed)
+        assert not prev.exists()        # clear() removes both twins
+
+    def test_preempted_message_names_snapshot_and_epoch(self, xs, tmp_path):
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(Preempted) as ei:
+                sweep_autoencoders_chunked(jax.random.PRNGKey(0), xs, CFG,
+                                           [1, 2], resume_dir=str(tmp_path))
+        finally:
+            res.clear_plan()
+        msg = str(ei.value)
+        assert "chunk_snapshot" in msg and "epoch" in msg
+
+    def test_snapshot_roundtrip_unit(self, tmp_path):
+        carry = ({"k": jnp.arange(3.0)}, jnp.asarray(2), jnp.asarray(True))
+        traces = (jnp.ones((2, 4)), jnp.zeros((2, 4)),
+                  jnp.ones((2, 4), bool))
+        snap = ChunkSnapshot(tmp_path, fingerprint={"cfg": [1, 2]})
+        snap.save(carry, traces, pos=4, chunks=1, stopped_all=False)
+        out = snap.load(carry)
+        assert out is not None
+        carry2, traces2, pos, chunks, stopped = out
+        assert _trees_equal(carry, carry2)
+        assert all(bool(jnp.array_equal(a, b))
+                   for a, b in zip(traces, traces2))
+        assert (pos, chunks, stopped) == (4, 1, False)
+        # a different fingerprint refuses the same bytes
+        assert ChunkSnapshot(tmp_path,
+                             fingerprint={"cfg": [9]}).load(carry) is None
+
+    def test_run_sweep_rejects_resume_on_monolithic_drive(self, xs):
+        from hfrep_tpu.experiments.sweep import run_sweep
+        x = np.asarray(xs)
+        y = x[:, :4]
+        cfg0 = dataclasses.replace(CFG, chunk_epochs=0)
+        with pytest.raises(ValueError, match="chunk"):
+            run_sweep(x[:45], y[:45], x[45:], y[45:],
+                      np.abs(x[45:, :1]) * 0.01, x, cfg0, [1, 2],
+                      resume_dir="/tmp/nope")
+
+
+# ------------------------------------------------------- graceful drain
+class TestGracefulDrain:
+    def test_handler_installed_and_restored(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with res.graceful_drain():
+            assert signal.getsignal(signal.SIGTERM) is res._sigterm_handler
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert res.drain_requested()
+        assert signal.getsignal(signal.SIGTERM) == before
+        assert not res.drain_requested()
+
+    def test_nested_drains_share_one_handler(self):
+        with res.graceful_drain():
+            outer = signal.getsignal(signal.SIGTERM)
+            with res.graceful_drain():
+                assert signal.getsignal(signal.SIGTERM) is outer
+            # inner exit must not tear down the outer handler
+            assert signal.getsignal(signal.SIGTERM) is outer
+
+    def test_boundary_raises_only_when_drain_requested(self):
+        with res.graceful_drain():
+            res.boundary("chunk")                 # clean crossing
+            res.request_drain("test")
+            with pytest.raises(Preempted):
+                res.boundary("chunk")
+
+
+# ------------------------------------------------------- trainer drains
+MCFG = ModelConfig(family="wgan_gp", window=8, features=5, hidden=8)
+TCFG = TrainConfig(epochs=6, batch_size=8, n_critic=1, steps_per_call=2,
+                   log_every=100)
+
+
+@pytest.fixture(scope="module")
+def gan_dataset(rng):
+    return jnp.asarray(rng.normal(size=(24, 8, 5)).astype(np.float32))
+
+
+class TestTrainerDrain:
+    def _cfg(self, tmp_path, **train_kw):
+        return ExperimentConfig(
+            model=MCFG,
+            train=dataclasses.replace(TCFG, checkpoint_dir=str(tmp_path),
+                                      **train_kw))
+
+    def test_gan_trainer_drains_with_final_checkpoint(self, tmp_path,
+                                                      gan_dataset):
+        from hfrep_tpu.train.trainer import GanTrainer
+        cfg = self._cfg(tmp_path / "a")
+        res.install_plan(FaultPlan.parse("preempt@block=2"))
+        tr = GanTrainer(cfg, gan_dataset)
+        with pytest.raises(Preempted) as ei:
+            tr.train()
+        assert ei.value.epoch == 4                 # 2 blocks × spc 2
+        assert ei.value.snapshot and ckpt.latest(str(tmp_path / "a"))
+
+    def test_gan_trainer_kill_resume_matches_uninterrupted(self, tmp_path,
+                                                           gan_dataset):
+        from hfrep_tpu.train.trainer import GanTrainer
+        base = GanTrainer(self._cfg(tmp_path / "base"), gan_dataset)
+        base.train()
+
+        cfg = self._cfg(tmp_path / "b")
+        res.install_plan(FaultPlan.parse("sigterm@block=1"))
+        tr = GanTrainer(cfg, gan_dataset)
+        with pytest.raises(Preempted):
+            tr.train()
+        res.clear_plan()
+
+        tr2 = GanTrainer(cfg, gan_dataset)
+        tr2.restore_checkpoint()                   # newest good checkpoint
+        assert tr2.epoch == 2
+        tr2.train(epochs=TCFG.epochs - tr2.epoch)
+        for la, lb in zip(jax.tree_util.tree_leaves(base.state.g_params),
+                          jax.tree_util.tree_leaves(tr2.state.g_params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_gan_trainer_restore_falls_back_past_corrupt(self, tmp_path,
+                                                         gan_dataset):
+        from hfrep_tpu.train.trainer import GanTrainer
+        cfg = self._cfg(tmp_path / "c", checkpoint_every=2)
+        tr = GanTrainer(cfg, gan_dataset)
+        tr.train()                                 # ckpts at 2, 4, 6
+        newest = ckpt.latest(str(tmp_path / "c"))
+        faults.corrupt_file(faults._payload_file(Path(newest)))
+        tr2 = GanTrainer(cfg, gan_dataset)
+        tr2.restore_checkpoint()
+        assert tr2.epoch == 4                      # fell back past epoch-6
+
+    def test_multi_seed_checkpoint_resume_roundtrip(self, tmp_path,
+                                                    gan_dataset):
+        from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+        cfg = ExperimentConfig(model=MCFG, train=dataclasses.replace(
+            TCFG, checkpoint_dir=str(tmp_path / "ms"), checkpoint_every=2))
+        base = MultiSeedTrainer(cfg, gan_dataset, seeds=(0, 1))
+        base.train()                               # saves at 2, 4, 6
+
+        resumed = MultiSeedTrainer(cfg, gan_dataset, seeds=(0, 1))
+        resumed.restore_checkpoint(str(tmp_path / "ms" / "ckpt_4"))
+        assert resumed.epoch == 4
+        resumed.train(epochs=2)
+        for la, lb in zip(jax.tree_util.tree_leaves(base.states.g_params),
+                          jax.tree_util.tree_leaves(resumed.states.g_params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_multi_seed_refuses_foreign_seeds(self, tmp_path, gan_dataset):
+        from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+        cfg = ExperimentConfig(model=MCFG, train=dataclasses.replace(
+            TCFG, checkpoint_dir=str(tmp_path / "ms2")))
+        tr = MultiSeedTrainer(cfg, gan_dataset, seeds=(0, 1))
+        path = tr.save_checkpoint()
+        other = MultiSeedTrainer(cfg, gan_dataset, seeds=(5, 6))
+        with pytest.raises(ValueError, match="seeds"):
+            other.restore_checkpoint(path)
+
+    def test_multi_seed_checkpoint_every_zero_is_inert(self, gan_dataset):
+        # checkpoint_every=0 with no checkpoint_dir trained fine before
+        # the checkpoint machinery existed here — it must keep doing so
+        from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+        cfg = ExperimentConfig(model=MCFG, train=dataclasses.replace(
+            TCFG, checkpoint_every=0))
+        tr = MultiSeedTrainer(cfg, gan_dataset, seeds=(0, 1))
+        tr.train(epochs=2)
+        assert tr.epoch == 2
+
+    def test_multi_seed_drains_gracefully(self, tmp_path, gan_dataset):
+        from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+        cfg = ExperimentConfig(model=MCFG, train=dataclasses.replace(
+            TCFG, checkpoint_dir=str(tmp_path / "ms3")))
+        res.install_plan(FaultPlan.parse("preempt@block=1"))
+        tr = MultiSeedTrainer(cfg, gan_dataset, seeds=(0, 1))
+        with pytest.raises(Preempted) as ei:
+            tr.train()
+        assert ei.value.epoch == 2
+        assert ckpt.latest(str(tmp_path / "ms3")) is not None
+
+
+# ------------------------------------------------------------ selftest
+def test_resilience_selftest_smoke():
+    """The check.sh gate end to end: kill→resume bit-identical + corrupt
+    fallback, env-stripped like the wiring in tools/check.sh."""
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("HFREP_OBS_DIR", "HFREP_HISTORY", "HFREP_FAULTS")}
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.resilience", "selftest"],
+        cwd=repo, capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["selftest"] == "ok"
+    assert doc["lanes21"] == "ok" and doc["multi"] == "ok"
+    assert doc["lanes21_lanes"] == 21

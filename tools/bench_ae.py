@@ -80,25 +80,36 @@ def _block(x) -> None:
     jax.block_until_ready(x)
 
 
-def time_monolithic(key, xs, cfg, latent_dims) -> float:
+def time_monolithic(key, xs, cfg, latent_dims, repeats: int = 1) -> float:
     """Wall clock of the full-``epochs`` vmapped sweep (one warmed,
-    jitted program — compile excluded, like every bench here)."""
+    jitted program — compile excluded, like every bench here).
+    ``repeats > 1`` takes the min — the standard noise-robust wall-clock
+    estimator; the self-test's tiny single-shot timings otherwise flake
+    under host load on a shared CI machine."""
     fn = jax.jit(lambda k: ae.sweep_autoencoders(k, xs, cfg, latent_dims))
     _block(fn(key).params)                        # compile + warm
-    t0 = time.perf_counter()
-    _block(fn(key).params)
-    return time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        _block(fn(key).params)
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
-def time_chunked(key, xs, cfg, latent_dims):
+def time_chunked(key, xs, cfg, latent_dims, repeats: int = 1):
     """Wall clock of the chunked early-exit drive (chunk program warmed
     by a first full drive; the timed drive pays dispatches + the one
-    scalar sync per chunk, which IS the mechanism under test)."""
+    scalar sync per chunk, which IS the mechanism under test).  Min over
+    ``repeats`` like :func:`time_monolithic`; the drive is deterministic,
+    so res/stats are identical across repeats."""
     ae.sweep_autoencoders_chunked(key, xs, cfg, latent_dims)
-    t0 = time.perf_counter()
-    res, stats = ae.sweep_autoencoders_chunked(key, xs, cfg, latent_dims)
-    _block(res.params)
-    return time.perf_counter() - t0, res, stats
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        res, stats = ae.sweep_autoencoders_chunked(key, xs, cfg, latent_dims)
+        _block(res.params)
+        best = min(best, time.perf_counter() - t0)
+    return best, res, stats
 
 
 def time_multi(key, x_stack, n_rows, cfg, latent_dims):
@@ -129,8 +140,11 @@ def run_probe(obs, self_test: bool) -> int:
         # small enough for seconds on CPU, big enough that per-epoch
         # work (not dispatch overhead) dominates the monolithic scan —
         # measured ~7x at this shape, comfortably above the 2x floor
+        # (360 full-scan epochs against an exit in the first 30-epoch
+        # chunk keeps the structural margin wide enough that host-load
+        # noise on a shared CI machine cannot eat it)
         rows, feats, latents = 120, 16, list(range(1, 9))
-        epochs, chunk = 240, 30
+        epochs, chunk = 360, 30
         learn_epochs = 60
     else:
         rows, feats, latents = 167, 22, list(range(1, 22))
@@ -149,9 +163,14 @@ def run_probe(obs, self_test: bool) -> int:
     key = jax.random.PRNGKey(0)
 
     # --- early-exit fixture: lr=0 pins the stop at patience+1 << epochs/4
+    # Self-test timings are single-digit milliseconds: best-of-5 keeps a
+    # loaded CI host from flaking the >=2x floor (chip-shape runs stay
+    # single-shot — their programs are long enough to swamp the noise).
+    repeats = 5 if self_test else 1
     early = dataclasses.replace(base, lr=0.0)
-    full_s = time_monolithic(key, xs, early, latents)
-    chunked_s, res, stats = time_chunked(key, xs, early, latents)
+    full_s = time_monolithic(key, xs, early, latents, repeats=repeats)
+    chunked_s, res, stats = time_chunked(key, xs, early, latents,
+                                         repeats=repeats)
     obs.record_span("bench", full_s, steps=epochs * len(latents),
                     synced=True, config="ae_full_scan")
     obs.record_span("bench", chunked_s,

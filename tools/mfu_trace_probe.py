@@ -43,6 +43,12 @@ from hfrep_tpu.models.registry import build_gan
 from hfrep_tpu.train.states import init_gan_state
 from hfrep_tpu.train.steps import make_multi_step, make_train_step
 
+# resolved via the repo-root sys.path entry above; imported at module top
+# so a broken shim fails BEFORE the expensive traced run, not after (the
+# old late `from flops_accounting import ...` also only resolved when
+# launched as `python tools/...`)
+from tools.flops_accounting import HP, epoch_flops
+
 
 def _latest_trace(log_dir: str):
     paths = glob.glob(os.path.join(log_dir, "plugins/profile/*/*.trace.json.gz"))
@@ -173,7 +179,6 @@ def main():
 
     out = {"calibration": calibrate(os.path.join(args.log_dir, "cal"))}
     ep = epoch_trace(os.path.join(args.log_dir, "epoch"))
-    from flops_accounting import HP, epoch_flops
     ex, lo = epoch_flops(48, 35, HP), epoch_flops(48, 35, 100)
     ep["analytic_executed_gflops"] = ex / 1e9
     ep["analytic_model_gflops"] = lo / 1e9

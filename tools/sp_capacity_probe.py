@@ -57,6 +57,22 @@ F, H, B = 36, 100, 32
 HBM_BYTES = 16 * 1024**3        # v5e: 16 GiB per chip
 
 
+def _is_oom(e: BaseException) -> bool:
+    """True only for XLA's RESOURCE_EXHAUSTED compile/runtime failure.
+
+    The search loops must treat ONLY out-of-memory as "doesn't fit":
+    swallowing every exception as fits=False silently biased the located
+    memory wall downward whenever the probe hit a genuine bug (ADVICE
+    round 5) — those must propagate.  Matched structurally (class name +
+    status string) because ``XlaRuntimeError``'s import path moves
+    between jaxlib versions.
+    """
+    for cls in type(e).__mro__:
+        if cls.__name__ == "XlaRuntimeError":
+            return "RESOURCE_EXHAUSTED" in str(e)
+    return False
+
+
 def _build(w: int):
     mcfg = ModelConfig(family="mtss_wgan_gp", window=w, features=F, hidden=H)
     tcfg = TrainConfig(batch_size=B, steps_per_call=1)
@@ -93,7 +109,9 @@ def cmd_search() -> int:
         try:
             m = plain_step_memory(w)
         except Exception as e:
-            print(f"W={w}: compile failed ({type(e).__name__})", flush=True)
+            if not _is_oom(e):
+                raise               # a genuine bug must not end the sweep
+            print(f"W={w}: compile failed (RESOURCE_EXHAUSTED)", flush=True)
             break
         fits = m["total_bytes"] < HBM_BYTES * 0.95
         print(f"W={w}: temp={m['temp_bytes']/2**30:.2f} GiB "
@@ -120,8 +138,12 @@ def cmd_search() -> int:
                   flush=True)
             pts.append(m)
         except Exception as e:
+            # ONLY RESOURCE_EXHAUSTED means "doesn't fit"; anything else
+            # is a bug that would bias the refined wall downward
+            if not _is_oom(e):
+                raise
             fits = False
-            print(f"W={mid}: compile failed ({type(e).__name__}) fits=False",
+            print(f"W={mid}: compile failed (RESOURCE_EXHAUSTED) fits=False",
                   flush=True)
         if fits:
             lo = mid
