@@ -37,8 +37,14 @@ class ModelConfig:
     leaky_slope: float = 0.2
     features: int = 35
     window: int = 48
-    dtype: str = "float32"         # compute dtype; "bfloat16" for MXU throughput
-    param_dtype: str = "float32"
+    dtype: str = "float32"         # compute dtype; "bfloat16" runs matmuls/
+                                   # activations at MXU bf16 rate behind the
+                                   # fp32-master-weight Policy
+                                   # (hfrep_tpu/core/precision.py) — README
+                                   # "Mixed precision" for when that is safe
+    param_dtype: str = "float32"   # master weights + optimizer slots; keep
+                                   # float32 (loss reductions and gradients
+                                   # accumulate here regardless of dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +78,15 @@ class TrainConfig:
                                    # recommendation at shipped shapes is M=1
                                    # (latency-bound regime —
                                    # parallel/sequence.py::sp_microbatch_plan)
+    fuse_gd: bool = True           # at n_critic == 1, emit the critic and
+                                   # generator updates as ONE straight-line
+                                   # XLA computation instead of a size-1
+                                   # while-loop + sequel: the loop op is a
+                                   # scheduling barrier XLA cannot fuse or
+                                   # software-pipeline across.  Numerically
+                                   # identical to the alternating form
+                                   # (pinned); n_critic > 1 keeps the loop
+                                   # (the carry chain is inherently serial)
     sp_remat: bool = False         # rematerialize each sp superstep in the
                                    # backward pass (jax.checkpoint around the
                                    # pipeline's scan body): trades recompute
@@ -118,6 +133,12 @@ class AEConfig:
                                    # behavior); results are bit-identical
                                    # either way (pinned by test)
     seed: int = 123
+    dtype: str = "float32"         # AE compute dtype ("bfloat16" runs the
+                                   # encoder/decoder matmuls at MXU rate);
+                                   # params and loss accumulation stay
+                                   # float32 (core/precision.py Policy
+                                   # semantics).  float32 is bit-identical
+                                   # to the pre-policy engine (pinned)
     beta_mode: str = "first"       # "first" replicates ante()'s use of ae_ols_beta[0]
                                    # for every window (Autoencoder_encapsulate.py:167);
                                    # "rolling" is the corrected per-window beta.

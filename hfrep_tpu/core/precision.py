@@ -1,0 +1,85 @@
+"""Mixed-precision policy: compute / param / output dtypes as one object.
+
+The repo's MXU-throughput posture follows the highly-parallel-GAN and
+Gemma-on-TPU references (PAPERS.md, arXiv 2111.04628 / 2605.25645):
+**bf16 compute with fp32 master weights**.  Parameters and optimizer
+state live in ``param_dtype`` (float32) on device; every layer casts its
+weights and inputs to ``compute_dtype`` at use (the jit-boundary cast —
+flax's ``dtype``/``param_dtype`` pair and the KerasLSTM's explicit
+``astype`` both implement it), and everything that *accumulates* — loss
+reductions, the gradient-penalty norm, metrics — is cast back to
+``output_dtype`` (float32) first via :meth:`Policy.accum`.  Gradients
+arrive in fp32 automatically (they are cotangents of the fp32 master
+weights), so optax state never leaves fp32.
+
+The one hard invariant, pinned by tests/test_precision.py: on the
+**fp32 policy every method is the literal identity** — ``accum`` /
+``compute`` return their argument unchanged, so the traced graph is
+bit-identical to a build that never heard of policies.  bf16 is a
+measured opt-in (``ModelConfig.dtype="bfloat16"``), never a default
+drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """What dtype each role runs in.
+
+    ``compute_dtype`` — matmuls/activations inside the step;
+    ``param_dtype`` — master weights + optimizer slots (fp32 unless you
+    really mean it); ``output_dtype`` — accumulations and everything
+    that leaves the jit boundary (losses, metrics).
+    """
+
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @property
+    def mixed(self) -> bool:
+        """True when compute runs below the output/accumulation width."""
+        return jnp.dtype(self.compute_dtype) != jnp.dtype(self.output_dtype)
+
+    # Each cast helper is the literal identity on the fp32 policy (no
+    # convert_element_type enters the jaxpr), which is what keeps the
+    # fp32 trajectories bit-identical to the pre-policy programs.
+    def compute(self, tree):
+        """Cast array leaves to the compute dtype (jit-boundary cast)."""
+        if not self.mixed:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype), tree)
+
+    def accum(self, tree):
+        """Cast array leaves up to the output dtype — call this on
+        logits/scores/grad-norms *before* any mean/sum so reductions
+        accumulate in fp32, not bf16."""
+        if not self.mixed:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.output_dtype), tree)
+
+    def describe(self) -> dict:
+        """Plain-data form for run manifests / obs annotations."""
+        return {"compute": jnp.dtype(self.compute_dtype).name,
+                "param": jnp.dtype(self.param_dtype).name,
+                "output": jnp.dtype(self.output_dtype).name}
+
+
+def policy_from(dtype: str | None, param_dtype: str | None = None) -> Policy:
+    """Config strings -> :class:`Policy` (``ModelConfig.dtype`` /
+    ``param_dtype``; ``AEConfig.dtype`` uses the one-arg form).  ``None``
+    means float32."""
+    return Policy(
+        compute_dtype=jnp.dtype(dtype) if dtype else jnp.float32,
+        param_dtype=jnp.dtype(param_dtype) if param_dtype else jnp.float32,
+        output_dtype=jnp.float32,
+    )

@@ -83,6 +83,12 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--num-processes", type=int, default=None)
     t.add_argument("--process-id", type=int, default=None)
     t.add_argument("--quiet", action="store_true")
+    t.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="precision policy for the hot loop: bfloat16 = "
+                        "bf16 compute over fp32 master weights (README "
+                        "'Mixed precision'); default is the preset's "
+                        "(float32, reproduction-exact)")
     t.add_argument("--nan-guard", action="store_true",
                    help="failure detection: roll back a block whose metrics "
                         "go non-finite, reseed and retry (the reference's "
@@ -146,6 +152,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="preset the checkpoint was trained with")
     s.add_argument("--n-gen-windows", type=int, default=10)
     s.add_argument("--epochs", type=int, default=None, help="AE epochs override")
+    s.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="AE precision policy (AEConfig.dtype): bfloat16 "
+                        "runs the sweep's matmuls at MXU rate with fp32 "
+                        "master weights + fp32 loss accumulation")
     s.add_argument("--chunk-epochs", type=int, default=None,
                    help="epochs per jitted dispatch on the chunked "
                         "early-exit AE training path (AEConfig.chunk_epochs "
@@ -199,7 +210,8 @@ def cmd_clean(args) -> int:
 def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
                   mesh=False, quiet=False, nan_guard=False, max_recoveries=3,
                   sp_mesh=False, dp_sp=None, tp_mesh=None, dp_tp=None,
-                  dp_sp_tp=None, sp_microbatches=None, sp_remat=False):
+                  dp_sp_tp=None, sp_microbatches=None, sp_remat=False,
+                  dtype=None):
     if sum(map(bool, (mesh, sp_mesh, dp_sp, tp_mesh is not None, dp_tp,
                       dp_sp_tp))) > 1:
         raise SystemExit("--mesh, --sp-mesh, --dp-sp, --tp-mesh, --dp-tp and "
@@ -257,6 +269,9 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
         device_mesh = make_mesh_3d(n_dp, n_sp, n_tp)
 
     cfg = get_preset(preset)
+    if dtype:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, dtype=dtype))
     if checkpoint_dir:
         cfg = dataclasses.replace(
             cfg, train=dataclasses.replace(cfg.train, checkpoint_dir=checkpoint_dir))
@@ -329,7 +344,8 @@ def _cmd_train_gan_impl(args) -> int:
         max_recoveries=args.max_recoveries,
         sp_mesh=args.sp_mesh, dp_sp=args.dp_sp,
         tp_mesh=args.tp_mesh, dp_tp=args.dp_tp, dp_sp_tp=args.dp_sp_tp,
-        sp_microbatches=args.sp_microbatches, sp_remat=args.sp_remat)
+        sp_microbatches=args.sp_microbatches, sp_remat=args.sp_remat,
+        dtype=args.dtype)
     target = args.epochs if args.epochs is not None else cfg.train.epochs
     if args.resume:
         from hfrep_tpu.utils.checkpoint import latest
@@ -495,6 +511,8 @@ def _cmd_sweep_impl(args) -> int:
     rf_test = panel.rf[x_train.shape[0]:]
 
     cfg = AEConfig()
+    if args.dtype:
+        cfg = dataclasses.replace(cfg, dtype=args.dtype)
     if args.epochs:
         cfg = dataclasses.replace(cfg, epochs=args.epochs)
     if args.chunk_epochs is not None:

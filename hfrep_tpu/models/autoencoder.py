@@ -29,6 +29,12 @@ class Autoencoder(nn.Module):
     n_features: int = 22
     latent_dim: int = 21
     slope: float = 0.2
+    #: compute dtype for the two matmuls (``None`` = operand dtype, the
+    #: pre-policy behavior); parameters are always float32 master weights
+    #: and the engine's MSE accumulates in float32 regardless (the
+    #: reconstruction error subtracts a float32 panel, which promotes) —
+    #: Policy semantics, hfrep_tpu/core/precision.py
+    dtype: Optional[jnp.dtype] = None
 
     def setup(self):
         self.encoder_kernel = self.param(
@@ -38,14 +44,21 @@ class Autoencoder(nn.Module):
             "decoder_kernel", nn.initializers.glorot_uniform(),
             (self.latent_dim, self.n_features))
 
+    def _cast(self, x):
+        # identity when dtype is None: the float32 path's graph carries
+        # no convert ops and stays bit-identical (pinned)
+        return x if self.dtype is None else x.astype(self.dtype)
+
     def encode(self, x, latent_mask: Optional[jnp.ndarray] = None):
-        z = leaky_relu(x @ self.encoder_kernel, self.slope)
+        z = leaky_relu(self._cast(x) @ self._cast(self.encoder_kernel),
+                       self.slope)
         if latent_mask is not None:
-            z = z * latent_mask
+            z = z * latent_mask.astype(z.dtype)
         return z
 
     def decode(self, z):
-        return leaky_relu(z @ self.decoder_kernel, self.slope)
+        return leaky_relu(self._cast(z) @ self._cast(self.decoder_kernel),
+                          self.slope)
 
     def __call__(self, x, latent_mask: Optional[jnp.ndarray] = None):
         return self.decode(self.encode(x, latent_mask))

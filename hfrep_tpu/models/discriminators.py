@@ -41,12 +41,13 @@ class DenseDiscriminator(nn.Module):
 
     hidden: int = 100
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
-        return KerasDense(1, dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
 class DenseCritic(nn.Module):
@@ -55,16 +56,17 @@ class DenseCritic(nn.Module):
     hidden: int = 100
     slope: float = 0.2
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        return KerasDense(1, dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
 class DenseFlatCritic(nn.Module):
@@ -72,16 +74,18 @@ class DenseFlatCritic(nn.Module):
 
     hidden: int = 100
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
-        x = KerasDense(self.hidden, dtype=self.dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasDense(self.hidden, dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = x.reshape(x.shape[0], -1)
-        return KerasDense(1, dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
-def _plain_stack(parent_dtype, hidden, x, backend):
+def _plain_stack(parent_dtype, hidden, x, backend,
+                 param_dtype=jnp.float32):
     """Two stacked default-activation KerasLSTMs; on the pallas backend
     the pair runs as ONE fused kernel chain (ops/pallas_lstm_stack) —
     exactly the plain-stack topology of the MTSS critics
@@ -89,8 +93,10 @@ def _plain_stack(parent_dtype, hidden, x, backend):
     both branches share parameters."""
     from hfrep_tpu.ops.pallas_lstm import kernel_eligible
 
-    l1 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_0")
-    l2 = KerasLSTM(hidden, dtype=parent_dtype, name="KerasLSTM_1")
+    l1 = KerasLSTM(hidden, dtype=parent_dtype, param_dtype=param_dtype,
+                   name="KerasLSTM_0")
+    l2 = KerasLSTM(hidden, dtype=parent_dtype, param_dtype=param_dtype,
+                   name="KerasLSTM_1")
     # layers=2: the FUSED stack's adjoint holds both layers' matrices
     # resident, so its VMEM ceiling is lower than two single-layer
     # kernels' — an ineligible width falls through to the chained
@@ -114,11 +120,13 @@ class LSTMDiscriminator(nn.Module):
 
     hidden: int = 100
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = _plain_stack(self.dtype, self.hidden, x, backend)
-        return KerasDense(1, dtype=self.dtype)(x)
+        x = _plain_stack(self.dtype, self.hidden, x, backend,
+                         param_dtype=self.param_dtype)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
 class LSTMCritic(nn.Module):
@@ -127,16 +135,17 @@ class LSTMCritic(nn.Module):
     hidden: int = 100
     slope: float = 0.2
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x, backend=backend)
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype, param_dtype=self.param_dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype)(x, backend=backend)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasLSTM(self.hidden, activation=None, dtype=self.dtype, param_dtype=self.param_dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        return KerasDense(1, dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
 class LSTMFlatCritic(nn.Module):
@@ -144,9 +153,11 @@ class LSTMFlatCritic(nn.Module):
 
     hidden: int = 100
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, backend=None):
-        x = _plain_stack(self.dtype, self.hidden, x, backend)
+        x = _plain_stack(self.dtype, self.hidden, x, backend,
+                         param_dtype=self.param_dtype)
         x = x.reshape(x.shape[0], -1)
-        return KerasDense(1, dtype=self.dtype)(x)
+        return KerasDense(1, dtype=self.dtype, param_dtype=self.param_dtype)(x)

@@ -35,16 +35,17 @@ class DenseGenerator(nn.Module):
     hidden: int = 100
     slope: float = 0.2
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, z: jnp.ndarray, backend=None) -> jnp.ndarray:
-        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype)(z)
+        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype, param_dtype=self.param_dtype)(z)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasDense(self.hidden, activation="sigmoid", dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        return KerasDense(self.features, dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return KerasDense(self.features, dtype=self.dtype, param_dtype=self.param_dtype)(x)
 
 
 class LSTMGenerator(nn.Module):
@@ -52,12 +53,13 @@ class LSTMGenerator(nn.Module):
     hidden: int = 100
     slope: float = 0.2
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, z: jnp.ndarray, backend=None) -> jnp.ndarray:
-        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(z, backend=backend)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype)(x, backend=backend)
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype, param_dtype=self.param_dtype)(z, backend=backend)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = KerasLSTM(self.hidden, activation="sigmoid", dtype=self.dtype, param_dtype=self.param_dtype)(x, backend=backend)
         x = leaky_relu(x, self.slope)
-        x = KerasLayerNorm(dtype=self.dtype)(x)
-        return KerasDense(self.features, dtype=self.dtype)(x)
+        x = KerasLayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return KerasDense(self.features, dtype=self.dtype, param_dtype=self.param_dtype)(x)

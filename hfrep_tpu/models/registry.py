@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.core.precision import Policy, policy_from
 from hfrep_tpu.models.discriminators import (
     DenseCritic, DenseDiscriminator, DenseFlatCritic,
     LSTMCritic, LSTMDiscriminator, LSTMFlatCritic,
@@ -29,6 +30,10 @@ class GanPair:
     discriminator: nn.Module
     loss: str            # "bce" | "wgan_clip" | "wgan_gp"
     family: str
+    #: the precision posture the pair was built under — the train steps
+    #: read it for their fp32-accumulation casts (identity on the
+    #: default fp32 policy)
+    policy: Policy = Policy()
 
 
 FAMILIES = {
@@ -46,10 +51,15 @@ def build_gan(cfg: ModelConfig) -> GanPair:
     if cfg.family not in FAMILIES:
         raise KeyError(f"unknown GAN family {cfg.family!r}; available: {sorted(FAMILIES)}")
     g_cls, d_cls, loss = FAMILIES[cfg.family]
+    policy = policy_from(cfg.dtype, cfg.param_dtype)
     dtype: Optional[jnp.dtype] = jnp.dtype(cfg.dtype) if cfg.dtype else None
-    gen = g_cls(features=cfg.features, hidden=cfg.hidden, slope=cfg.leaky_slope, dtype=dtype)
+    pd = policy.param_dtype
+    gen = g_cls(features=cfg.features, hidden=cfg.hidden, slope=cfg.leaky_slope,
+                dtype=dtype, param_dtype=pd)
     if d_cls in (DenseCritic, LSTMCritic):
-        disc = d_cls(hidden=cfg.hidden, slope=cfg.leaky_slope, dtype=dtype)
+        disc = d_cls(hidden=cfg.hidden, slope=cfg.leaky_slope, dtype=dtype,
+                     param_dtype=pd)
     else:
-        disc = d_cls(hidden=cfg.hidden, dtype=dtype)
-    return GanPair(generator=gen, discriminator=disc, loss=loss, family=cfg.family)
+        disc = d_cls(hidden=cfg.hidden, dtype=dtype, param_dtype=pd)
+    return GanPair(generator=gen, discriminator=disc, loss=loss,
+                   family=cfg.family, policy=policy)

@@ -43,6 +43,7 @@ class KerasDense(nn.Module):
     activation: Optional[str] = None
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32   # master weights (Policy.param_dtype)
 
     @nn.compact
     def __call__(self, x):
@@ -52,6 +53,7 @@ class KerasDense(nn.Module):
             kernel_init=nn.initializers.glorot_uniform(),
             bias_init=nn.initializers.zeros,
             dtype=self.dtype,
+            param_dtype=self.param_dtype,
         )(x)
         return ACTIVATIONS[self.activation](y)
 
@@ -61,7 +63,9 @@ class KerasLayerNorm(nn.Module):
 
     epsilon: float = 1e-3
     dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        return nn.LayerNorm(epsilon=self.epsilon, dtype=self.dtype)(x)
+        return nn.LayerNorm(epsilon=self.epsilon, dtype=self.dtype,
+                            param_dtype=self.param_dtype)(x)

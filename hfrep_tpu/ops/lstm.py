@@ -79,7 +79,9 @@ class KerasLSTM(nn.Module):
     activation: Optional[str] = "tanh"            # candidate/output transform
     recurrent_activation: str = "sigmoid"          # gates
     dtype: Optional[jnp.dtype] = None
-    backend: str = "xla"
+    param_dtype: jnp.dtype = jnp.float32           # master weights; the
+    backend: str = "xla"                           # per-use astype below is
+                                                   # the compute-dtype cast
 
     @nn.compact
     def __call__(self, x: Optional[jnp.ndarray] = None,
@@ -94,9 +96,11 @@ class KerasLSTM(nn.Module):
         """
         h = self.features
         f = materialize if materialize is not None else x.shape[-1]
-        kernel = self.param("kernel", nn.initializers.glorot_uniform(), (f, 4 * h))
-        recurrent = self.param("recurrent_kernel", nn.initializers.orthogonal(), (h, 4 * h))
-        bias = self.param("bias", _unit_forget_bias, (4 * h,))
+        kernel = self.param("kernel", nn.initializers.glorot_uniform(),
+                            (f, 4 * h), self.param_dtype)
+        recurrent = self.param("recurrent_kernel", nn.initializers.orthogonal(),
+                               (h, 4 * h), self.param_dtype)
+        bias = self.param("bias", _unit_forget_bias, (4 * h,), self.param_dtype)
         if materialize is not None:
             return {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}
         b, w, _ = x.shape

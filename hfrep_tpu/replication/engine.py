@@ -71,8 +71,14 @@ def _epoch_batches(n_train: int, batch_size: int) -> Tuple[int, int]:
 
 
 def _ae_model(cfg: AEConfig) -> Autoencoder:
+    # dtype="float32" maps to module dtype None — the no-cast graph the
+    # pre-policy engine traced, so the fp32 pins hold by construction;
+    # "bfloat16" runs the two matmuls at MXU rate with fp32 master
+    # weights, and the MSE below still accumulates in float32 (the
+    # subtraction against the float32 panel promotes before reduction)
+    dt = None if cfg.dtype in (None, "float32") else jnp.dtype(cfg.dtype)
     return Autoencoder(n_features=cfg.n_factors, latent_dim=cfg.latent_dim,
-                       slope=cfg.leaky_slope)
+                       slope=cfg.leaky_slope, dtype=dt)
 
 
 def _ae_init(cfg: AEConfig, x_train_scaled: jnp.ndarray, key: jax.Array):
@@ -598,6 +604,11 @@ def ante_weights(model: Autoencoder, cfg: AEConfig, params: dict,
     rf = jnp.asarray(rf, jnp.float32).reshape(-1, 1)
     factors = model.apply({"params": params}, x_test, mask,
                           method=Autoencoder.encode)            # raw-input encode, :140
+    # Policy output boundary: a bf16-policy model emits bf16 factors, but
+    # everything downstream is evaluation — the rolling OLS in particular
+    # is a lapack least-squares with no bf16 kernel (hard NotImplemented,
+    # found driving the bf16 sweep end-to-end).  Identity on fp32.
+    factors = factors.astype(jnp.float32)
     betas = rolling_ols_beta(y_test, factors, window)           # (T-w+1, L, S)
     n_windows = x_test.shape[0] - window                        # :148 range
     betas = betas[:n_windows]
@@ -699,9 +710,7 @@ class ReplicationEngine:
         self.y_train = jnp.asarray(y_train, jnp.float32)
         self.y_test = jnp.asarray(y_test, jnp.float32)
         self.train_scale, self.x_train = mm.fit_transform(self.x_train_raw)
-        self.model = Autoencoder(n_features=self.cfg.n_factors,
-                                 latent_dim=self.cfg.latent_dim,
-                                 slope=self.cfg.leaky_slope)
+        self.model = _ae_model(self.cfg)   # honors cfg.dtype (precision policy)
         self.result: Optional[AEResult] = None
         self.mask: Optional[jnp.ndarray] = None
         self._ante = None

@@ -207,7 +207,8 @@ class MultiSeedTrainer:
         if obs.enabled:
             obs.event("multi_seed_train_start", members=self.n_seeds,
                       epochs=epochs, mesh=mesh_attrs(self.mesh),
-                      mode="seed_sharded" if self.mesh is not None else "vmap")
+                      mode="seed_sharded" if self.mesh is not None else "vmap",
+                      precision=self.pair.policy.describe())
         blocks = obs.counter("multi_seed_blocks")    # no-op when disabled
 
         def maybe_checkpoint(block_epochs: int) -> None:

@@ -124,6 +124,14 @@ def gradient_penalty(d_apply: Callable, d_params, interp: jnp.ndarray) -> jnp.nd
     (``GAN/MTSS_WGAN_GP.py:201-216``): per-sample L2 norm over all
     non-batch axes of the critic's input gradient at x̂.
 
+    The norm and its reduction accumulate in float32 regardless of the
+    critic's compute dtype: the score sum driving the input gradient and
+    the gradient itself are cast up before any reduction.  Both casts
+    are identities on the fp32 policy (``convert_element_type`` to the
+    operand's own dtype inserts nothing), so the fp32 graph is unchanged
+    — on a bf16 policy they are what keeps the penalty's second-order
+    signal out of bf16's 8-bit mantissa.
+
     Works unchanged inside the manual dp×sp region
     (:mod:`hfrep_tpu.parallel.dp_sp`): there ``d_apply`` slices its own
     window chunk from the sp-invariant interpolates, and the transpose
@@ -133,7 +141,9 @@ def gradient_penalty(d_apply: Callable, d_params, interp: jnp.ndarray) -> jnp.nd
     (why the manual generator reassembles windows via masked psum, not
     all_gather: see :func:`hfrep_tpu.parallel.sequence.sp_generate`).
     """
-    grads = jax.grad(lambda x: jnp.sum(d_apply(d_params, x)))(interp)
+    grads = jax.grad(
+        lambda x: jnp.sum(d_apply(d_params, x).astype(jnp.float32)))(interp)
+    grads = grads.astype(jnp.float32)
     norms = jnp.sqrt(jnp.sum(grads**2, axis=tuple(range(1, grads.ndim))) + 1e-12)
     return jnp.mean((1.0 - norms) ** 2)
 
@@ -172,6 +182,14 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     granularity, no duplicated sampling work.
     """
     g_tx, d_tx = make_optimizers(pair, tcfg)
+    # Mixed-precision posture (hfrep_tpu/core/precision.py): modules cast
+    # fp32 master weights + inputs to the compute dtype internally; here
+    # `acc` lifts critic scores/logits back to float32 BEFORE any loss
+    # reduction so means/sums never accumulate in bf16, which also makes
+    # every gradient a float32 cotangent of float32 params — optimizer
+    # state stays fp32 end to end.  On the default fp32 policy `acc` is
+    # the literal identity and the traced graph is unchanged (pinned).
+    acc = pair.policy.accum
     # Every site — including the gradient penalty's second-order
     # ∂/∂θ ∇_x c path — runs the resolved backend: the pallas LSTM is
     # twice-differentiable end to end (nested custom_vjps with a
@@ -241,11 +259,11 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         fake = g_apply(state.g_params, _noise(k_z1))
 
         def loss_real(p):
-            logits = d_apply(p, real)
+            logits = acc(d_apply(p, real))
             return _bce_logits(logits, 1.0), jnp.mean((logits > 0).astype(jnp.float32))
 
         def loss_fake(p):
-            logits = d_apply(p, lax.stop_gradient(fake))
+            logits = acc(d_apply(p, lax.stop_gradient(fake)))
             return _bce_logits(logits, 0.0), jnp.mean((logits <= 0).astype(jnp.float32))
 
         d_params, d_opt, l_real, acc_r = d_update(state.d_params, state.d_opt, loss_real)
@@ -253,7 +271,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
-            return _bce_logits(d_apply(state.d_params, g_apply(p, _noise(k_z2))), 1.0), None
+            return _bce_logits(acc(d_apply(state.d_params, g_apply(p, _noise(k_z2)))), 1.0), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": 0.5 * (l_real + l_fake),
@@ -288,6 +306,27 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         alphas = jnp.stack([_alpha(k[2]) for k in ks]) if with_alpha else None
         return k_idx, noises, fakes, alphas
 
+    # A size-1 critic "loop" lowers to an XLA while op — a scheduling
+    # barrier the compiler can neither fuse nor software-pipeline across,
+    # for a loop that cannot iterate.  With ``tcfg.fuse_gd`` (default)
+    # the n_critic == 1 step instead emits the critic update and the
+    # generator update as ONE straight-line computation: identical ops in
+    # identical order (the loop body inlined at i=0), pinned equivalent
+    # by tests/test_precision.py.  n_critic > 1 keeps the loop — the
+    # d_params carry chain is inherently serial.
+    fuse_single = tcfg.n_critic == 1 and tcfg.fuse_gd
+
+    def _critic_phase(state: GanState, key, critic_iter):
+        """d-phase dispatch shared by the two Wasserstein steps: the
+        straight-line fused form when n_critic allows, the fori_loop
+        otherwise.  ``critic_iter(i, (d_params, d_opt, d_loss))`` is the
+        unchanged per-iteration body."""
+        if fuse_single:
+            return critic_iter(0, (state.d_params, state.d_opt,
+                                   _loop_init(key)))
+        return lax.fori_loop(0, tcfg.n_critic, critic_iter,
+                             (state.d_params, state.d_opt, _loop_init(key)))
+
     def wgan_step(state: GanState, key: jax.Array):
         k_idx, noises, fakes, _ = _critic_loop_inputs(key, state.g_params, False)
 
@@ -297,24 +336,22 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             fake = fakes[i]
 
             def loss_real(p):
-                return jnp.mean(-d_apply(p, real)), None
+                return jnp.mean(-acc(d_apply(p, real))), None
 
             def loss_fake(p):
-                return jnp.mean(d_apply(p, fake)), None
+                return jnp.mean(acc(d_apply(p, fake))), None
 
             d_params, d_opt, l_real, _ = d_update(d_params, d_opt, loss_real)
             d_params, d_opt, l_fake, _ = d_update(d_params, d_opt, loss_fake)
             d_params = jax.tree_util.tree_map(lambda w: jnp.clip(w, -clip, clip), d_params)
             return d_params, d_opt, 0.5 * (l_real + l_fake)
 
-        d_params, d_opt, d_loss = lax.fori_loop(
-            0, tcfg.n_critic, critic_iter,
-            (state.d_params, state.d_opt, _loop_init(key)))
+        d_params, d_opt, d_loss = _critic_phase(state, key, critic_iter)
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/WGAN.py:203)
-            return jnp.mean(-d_apply(state.d_params, g_apply(p, noises[-1]))), None
+            return jnp.mean(-acc(d_apply(state.d_params, g_apply(p, noises[-1])))), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}
@@ -333,7 +370,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         # interp into the batch too would widen the *second-order* path
         # (outer grad through the GP input-grad) to 3B and measures
         # slower on the chip than the scan it saves.
-        scores = d_apply(d_params, jnp.concatenate([real, fake], axis=0))
+        scores = acc(d_apply(d_params, jnp.concatenate([real, fake], axis=0)))
         gp = gradient_penalty(d_apply, d_params, interp)
         w_loss = jnp.mean(-scores[:b]) + jnp.mean(scores[b:])
         return w_loss + gp_w * gp, (w_loss, gp)
@@ -350,14 +387,12 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             d_params, d_opt, loss, _ = d_update(d_params, d_opt, loss_fn)
             return d_params, d_opt, loss
 
-        d_params, d_opt, d_loss = lax.fori_loop(
-            0, tcfg.n_critic, critic_iter,
-            (state.d_params, state.d_opt, _loop_init(key)))
+        d_params, d_opt, d_loss = _critic_phase(state, key, critic_iter)
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/MTSS_WGAN_GP.py:281)
-            return jnp.mean(-d_apply(state.d_params, g_apply(p, noises[-1]))), None
+            return jnp.mean(-acc(d_apply(state.d_params, g_apply(p, noises[-1])))), None
 
         state, g_loss = g_update(state, loss_g)
         return state, {"d_loss": d_loss, "g_loss": g_loss}

@@ -52,8 +52,19 @@ class GanTrainer:
             #   ('dp', 'sp')  batch + window, one 2-D mesh (dp_sp.py)
             #   ('dp', 'tp')  batch + width, one 2-D mesh  (tensor.py)
             #   ('dp', 'sp', 'tp')  all three, one 3-D mesh (dp_sp_tp.py)
-            from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
             names = tuple(mesh.axis_names)
+            if names not in (("dp",), ("sp",), ("tp",), ("dp", "sp"),
+                             ("dp", "tp"), ("dp", "sp", "tp")):
+                # validate BEFORE any hfrep_tpu.parallel import: the
+                # rejection must not depend on whether a runtime without
+                # jax.shard_map can finish importing the parallel package
+                # (it raised ImportError or ValueError by sys.modules
+                # residue — the order-dependent test_train failure)
+                raise ValueError(
+                    f"mesh axis names {names} not recognized; use ('dp',), "
+                    "('sp',), ('tp',), ('dp', 'sp'), ('dp', 'tp'), or "
+                    "('dp', 'sp', 'tp')")
+            from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
             if names == ("dp",):
                 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
                 self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
@@ -71,14 +82,9 @@ class GanTrainer:
             elif names == ("dp", "tp"):
                 from hfrep_tpu.parallel.tensor import make_dp_tp_multi_step
                 self._multi = make_dp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            elif names == ("dp", "sp", "tp"):
+            else:
                 from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_multi_step
                 self._multi = make_dp_sp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            else:
-                raise ValueError(
-                    f"mesh axis names {names} not recognized; use ('dp',), "
-                    "('sp',), ('tp',), ('dp', 'sp'), ('dp', 'tp'), or "
-                    "('dp', 'sp', 'tp')")
             if spans_processes(mesh):
                 # multi-host: promote the (identically-seeded) state and
                 # key to replicated global arrays for the pod-wide jit
@@ -332,7 +338,12 @@ class GanTrainer:
                     self.pair, self.cfg.train, self.windows, self.mesh)
             else:
                 from hfrep_tpu.train.steps import make_train_step
-                self._single_step = jax.jit(make_train_step(self.pair, self.cfg.train, self.windows))
+                # donate the state like the multi-step does: the remainder
+                # epochs rebind `self.state` from the return value, so the
+                # input buffers are dead the moment the call is issued
+                self._single_step = jax.jit(
+                    make_train_step(self.pair, self.cfg.train, self.windows),
+                    donate_argnums=(0,))
         return self._single_step(state, key)
 
     def _log_block(self, metrics: dict, n: int, base_epoch: int) -> None:

@@ -599,3 +599,105 @@ def test_gate_and_ingest_tail(tmp_path, capsys):
 def test_gate_and_ingest_flags_regression_against_fixture_history():
     rc = hist_mod.gate_and_ingest(FX / "regressed", HIST, 0)
     assert rc == 1
+
+
+# ------------------------- bench.py end-to-end gate+ingest (ISSUE 6)
+def test_committed_store_is_populated():
+    """PR-4 gap, closed: the committed default store carries the
+    BENCH_r01-r05 seed series, so the drift detector has history from
+    day one (not an empty file that gates nothing)."""
+    records = hist_mod.load_history(hist_mod.default_store(), strict=True)
+    assert len(records) >= 5
+    # value bounds apply ONLY to the back-filled seed records — later
+    # legitimately ingested rounds (e.g. a bf16 headline >700) must not
+    # retroactively fail this test
+    seeded = [r for r in records if str(r["run_id"]).startswith("BENCH_r0")]
+    assert len(seeded) == 5
+    vals = [r["metrics"]["bench/headline_steps_per_sec"] for r in seeded]
+    assert all(500.0 < v < 700.0 for v in vals)
+    series = regress.comparable_series(
+        records, seeded[0]["key"], "bench/headline_steps_per_sec")
+    assert len(series) >= 5
+
+
+def test_bench_gate_ingest_appends_to_store(tmp_path, monkeypatch, capsys):
+    """A real `bench.py` run (measurement loops stubbed — this is the
+    plumbing under test, not the chip) under HFREP_OBS_DIR gates against
+    the default store and APPENDS its record on a clean pass."""
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    store = tmp_path / "store" / "history.jsonl"
+    store.parent.mkdir()
+    shutil.copy(hist_mod.default_store(), store)
+    before = len(hist_mod.load_history(store))
+
+    rates = {"headline": 600.0, "headline_f32": 560.0, "prod_168x36": 200.0}
+    monkeypatch.setattr(
+        bench, "measure",
+        lambda mcfg, rf, n_calls, label="bench", tcfg=None: rates[label])
+    monkeypatch.setattr(bench, "measure_dp", lambda n_calls: 540.0)
+    monkeypatch.setattr(bench, "measure_sp", lambda n_calls: 140.0)
+    # BENCH_DTYPE is baked at bench-module import from ambient
+    # HFREP_BENCH_DTYPE; pin it so an exported override can't skew the
+    # dtype assertions below
+    monkeypatch.setattr(bench, "BENCH_DTYPE", "bfloat16")
+    monkeypatch.setattr(hist_mod, "default_store", lambda: store)
+    monkeypatch.setenv("HFREP_OBS_DIR", str(tmp_path / "run"))
+    monkeypatch.delenv("HFREP_HISTORY", raising=False)
+
+    bench.main()          # floors pass + gate passes -> rc 0, no SystemExit
+
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "stdout single-JSON-line contract broken"
+    doc = json.loads(out[0])
+    assert doc["value"] == 600.0
+    assert doc["dtype"] == "bfloat16"
+    assert doc["headline_f32_steps_per_sec"] == 560.0
+
+    after = hist_mod.load_history(store)
+    assert len(after) == before + 1, "clean bench run did not ingest"
+    new = after[-1]
+    m = new["metrics"]
+    assert m["bench/headline_steps_per_sec"] == 600.0
+    assert m["bench/headline_f32_steps_per_sec"] == 560.0
+    assert m["bench/prod_168x36_steps_per_sec"] == 200.0
+    assert m["bench/bf16_headline_speedup"] == pytest.approx(600.0 / 560.0)
+    # manifest records the precision policy (obs/README.md dtype field)
+    manifest = read_manifest(tmp_path / "run")
+    assert manifest["config"]["model"]["dtype"] == "bfloat16"
+    assert manifest["config"]["model"]["param_dtype"] == "float32"
+
+
+def test_bench_records_even_without_obs_dir(tmp_path, monkeypatch, capsys):
+    """HFREP_OBS_DIR unset: bench records into a throwaway run dir so
+    the default-store sentinel still arms (the driver invokes bench
+    bare — exactly how the store stayed empty for five rounds)."""
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    store = tmp_path / "h.jsonl"
+    rates = {"headline": 600.0, "headline_f32": 560.0, "prod_168x36": 200.0}
+    monkeypatch.setattr(
+        bench, "measure",
+        lambda mcfg, rf, n_calls, label="bench", tcfg=None: rates[label])
+    monkeypatch.setattr(bench, "measure_dp", lambda n_calls: 540.0)
+    monkeypatch.setattr(bench, "measure_sp", lambda n_calls: 140.0)
+    monkeypatch.setattr(bench, "BENCH_DTYPE", "bfloat16")
+    shutil.copy(REPO_ROOT / "hfrep_tpu/obs/_bench_history/history.jsonl",
+                store)
+    monkeypatch.setattr(hist_mod, "default_store", lambda: store)
+    before = len(hist_mod.load_history(store))
+    monkeypatch.delenv("HFREP_OBS_DIR", raising=False)
+    monkeypatch.delenv("HFREP_HISTORY", raising=False)
+
+    bench.main()
+
+    assert len(json.loads(capsys.readouterr().out.strip())) > 0
+    assert len(hist_mod.load_history(store)) == before + 1

@@ -1,0 +1,235 @@
+"""Mixed-precision policy: fp32 identity, bf16 tolerance, fused G/D,
+donation discipline (ISSUE 6).
+
+The load-bearing pin is (1): the fp32 policy must be the *literal
+identity* — same objects out of the cast helpers, no convert ops in the
+step's jaxpr — which is what guarantees every pre-policy fp32 trajectory
+in the suite (train, parity, resilience, chunked-AE) is unchanged
+without re-pinning each one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hfrep_tpu.config import AEConfig, ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.core.precision import Policy, policy_from
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_multi_step, make_train_step
+
+MCFG = ModelConfig(family="mtss_wgan_gp", features=5, window=8, hidden=8)
+TCFG = TrainConfig(epochs=6, batch_size=4, n_critic=2, steps_per_call=3)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(11)
+    return jnp.asarray(g.uniform(0, 1, (64, 8, 5)).astype(np.float32))
+
+
+# ------------------------------------------------------------ the Policy
+class TestPolicy:
+    def test_fp32_policy_is_the_identity(self):
+        pol = policy_from("float32")
+        x = jnp.ones((4, 3))
+        tree = {"a": x, "b": jnp.zeros((2,))}
+        assert not pol.mixed
+        assert pol.accum(x) is x
+        assert pol.compute(x) is x
+        assert pol.accum(tree) is tree
+
+    def test_bf16_policy_casts(self):
+        pol = policy_from("bfloat16")
+        assert pol.mixed
+        x = jnp.ones((4,), jnp.float32)
+        assert pol.compute(x).dtype == jnp.bfloat16
+        assert pol.accum(x.astype(jnp.bfloat16)).dtype == jnp.float32
+        assert pol.describe() == {"compute": "bfloat16", "param": "float32",
+                                  "output": "float32"}
+
+    def test_registry_attaches_policy(self):
+        assert not build_gan(MCFG).policy.mixed
+        pair = build_gan(dataclasses.replace(MCFG, dtype="bfloat16"))
+        assert pair.policy.mixed
+        assert pair.generator.param_dtype == jnp.float32
+
+    def test_fp32_step_jaxpr_carries_no_bf16(self, dataset):
+        """Graph-level pin of the bit-identity claim: the fp32 policy's
+        step traces to a jaxpr with no bfloat16 anywhere — the policy
+        left no residue for XLA to even see."""
+        pair = build_gan(MCFG)
+        state = init_gan_state(jax.random.PRNGKey(0), MCFG, TCFG, pair)
+        jaxpr = jax.make_jaxpr(make_train_step(pair, TCFG, dataset))(
+            state, jax.random.PRNGKey(1))
+        assert "bf16" not in str(jaxpr)
+
+    def test_bf16_step_computes_in_bf16_keeps_fp32_state(self, dataset):
+        mcfg = dataclasses.replace(MCFG, dtype="bfloat16")
+        pair = build_gan(mcfg)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, TCFG, pair)
+        step = jax.jit(make_train_step(pair, TCFG, dataset))
+        assert "bf16" in str(jax.make_jaxpr(
+            make_train_step(pair, TCFG, dataset))(state, jax.random.PRNGKey(1)))
+        new_state, metrics = step(state, jax.random.PRNGKey(1))
+        # fp32 master weights + optimizer slots, fp32 loss outputs
+        for leaf in jax.tree_util.tree_leaves(new_state):
+            assert leaf.dtype in (jnp.float32, jnp.int32), leaf.dtype
+        assert metrics["d_loss"].dtype == jnp.float32
+        assert np.isfinite(float(metrics["d_loss"]))
+
+
+# ----------------------------------------------- bf16 vs fp32 trajectory
+@pytest.mark.parametrize("family", ["gan", "wgan", "mtss_wgan_gp"])
+def test_bf16_tracks_fp32_trajectory(family, dataset):
+    """3-epoch fixture: identical master-weight init (param init never
+    runs in compute dtype), losses within the documented tolerance
+    (README "Mixed precision": low-1e-2 relative at fixture scale)."""
+    losses = {}
+    for dtype in ("float32", "bfloat16"):
+        mcfg = dataclasses.replace(MCFG, family=family, dtype=dtype)
+        pair = build_gan(mcfg)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, TCFG, pair)
+        multi = make_multi_step(pair, TCFG, dataset)
+        state, m = multi(state, jax.random.PRNGKey(7))
+        losses[dtype] = np.asarray(m["d_loss"])
+        if dtype == "bfloat16":   # same seeds -> bitwise-equal fp32 init
+            ref = init_gan_state(jax.random.PRNGKey(0),
+                                 dataclasses.replace(mcfg, dtype="float32"),
+                                 TCFG, pair)
+            for a, b in zip(jax.tree_util.tree_leaves(ref.g_params),
+                            jax.tree_util.tree_leaves(
+                                init_gan_state(jax.random.PRNGKey(0), mcfg,
+                                               TCFG, pair).g_params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(losses["bfloat16"]).all()
+    np.testing.assert_allclose(losses["bfloat16"], losses["float32"],
+                               rtol=5e-2, atol=5e-2)
+
+
+# ------------------------------------------------------- fused G/D step
+class TestFusedGD:
+    def _run(self, dataset, family, fuse, dtype="float32"):
+        mcfg = dataclasses.replace(MCFG, family=family, dtype=dtype)
+        tcfg = dataclasses.replace(TCFG, n_critic=1, fuse_gd=fuse)
+        pair = build_gan(mcfg)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+        multi = make_multi_step(pair, tcfg, dataset)
+        state, m = multi(state, jax.random.PRNGKey(3))
+        return state, m
+
+    @pytest.mark.parametrize("family", ["wgan", "wgan_gp", "mtss_wgan_gp"])
+    def test_fused_equals_alternating_at_n_critic_1(self, family, dataset):
+        sf, mf = self._run(dataset, family, fuse=True)
+        sl, ml = self._run(dataset, family, fuse=False)
+        for a, b in zip(jax.tree_util.tree_leaves(sf),
+                        jax.tree_util.tree_leaves(sl)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(mf["d_loss"]),
+                                      np.asarray(ml["d_loss"]))
+        np.testing.assert_array_equal(np.asarray(mf["g_loss"]),
+                                      np.asarray(ml["g_loss"]))
+
+    def test_fused_step_has_no_loop_op(self, dataset):
+        """The point of the fusion: no loop op left on the critical path
+        at n_critic=1.  ``fori_loop`` traces to a ``scan`` in the jaxpr;
+        the Dense wgan_gp family has no other scan (the LSTM families
+        do — their recurrence), so the count isolates the critic loop."""
+        mcfg = dataclasses.replace(MCFG, family="wgan_gp")
+        for fuse, expect in ((True, 0), (False, 1)):
+            tcfg = dataclasses.replace(TCFG, n_critic=1, fuse_gd=fuse)
+            pair = build_gan(mcfg)
+            state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+            jaxpr = str(jax.make_jaxpr(make_train_step(pair, tcfg, dataset))(
+                state, jax.random.PRNGKey(1)))
+            assert jaxpr.count("scan[") == expect, (fuse, expect)
+
+    def test_n_critic_gt_1_keeps_the_loop(self, dataset):
+        mcfg = dataclasses.replace(MCFG, family="wgan_gp")
+        pair = build_gan(mcfg)
+        state = init_gan_state(jax.random.PRNGKey(0), mcfg, TCFG, pair)
+        jaxpr = str(jax.make_jaxpr(make_train_step(pair, TCFG, dataset))(
+            state, jax.random.PRNGKey(1)))
+        assert jaxpr.count("scan[") == 1
+
+
+# ---------------------------------------------------------- AE precision
+class TestAEPrecision:
+    def _panel(self):
+        g = np.random.default_rng(5)
+        return jnp.asarray(g.normal(0, 0.05, (40, 6)).astype(np.float32))
+
+    def test_ae_fp32_policy_is_prepolicy_module(self):
+        """cfg.dtype="float32" builds the module with dtype=None — the
+        exact no-cast graph the pre-policy engine traced."""
+        from hfrep_tpu.replication.engine import _ae_model
+        cfg = AEConfig(n_factors=6, latent_dim=4, dtype="float32")
+        assert _ae_model(cfg).dtype is None
+
+    def test_ae_bf16_tracks_fp32(self):
+        from hfrep_tpu.replication.engine import train_autoencoder
+        x = self._panel()
+        out = {}
+        for dtype in ("float32", "bfloat16"):
+            cfg = AEConfig(n_factors=6, latent_dim=4, epochs=12,
+                           batch_size=16, seed=0, dtype=dtype)
+            res = jax.jit(lambda k, c=cfg: train_autoencoder(k, x, c))(
+                jax.random.PRNGKey(0))
+            out[dtype] = np.asarray(res.val_loss)
+        # master weights seeded identically; val-loss accumulates fp32
+        finite = np.isfinite(out["float32"])
+        np.testing.assert_allclose(out["bfloat16"][finite],
+                                   out["float32"][finite],
+                                   rtol=5e-2, atol=1e-4)
+
+
+# -------------------------------------------- donation rebind discipline
+class TestDonation:
+    def test_trainer_remainder_step_donates_and_rebinds(self, dataset):
+        """The remainder epochs run on the donated single-epoch step; the
+        trainer must stay usable afterwards (state was rebound, never
+        read through the donated reference)."""
+        from hfrep_tpu.train.trainer import GanTrainer
+        cfg = ExperimentConfig(
+            model=MCFG, train=dataclasses.replace(TCFG, epochs=4))
+        tr = GanTrainer(cfg, dataset)     # 4 = 1 full block of 3 + 1 remainder
+        tr.train()
+        assert tr.epoch == 4
+        out = tr.generate(jax.random.PRNGKey(2), 2)   # reads tr.state
+        assert out.shape == (2, 8, 5)
+
+    def test_multi_step_donation_rebind_pattern_is_clean(self):
+        """JAX004 fixture for the donated step signatures this PR
+        completes: the sanctioned rebind passes, a read-after-donation
+        of the same signature is flagged."""
+        import textwrap
+        from hfrep_tpu.analysis import analyze_source
+        from hfrep_tpu.analysis.rules import RULES_BY_ID
+
+        def run(src):
+            return analyze_source(textwrap.dedent(src), path="snippet.py",
+                                  rules=[RULES_BY_ID["JAX004"]])
+
+        clean = run("""
+            import jax
+            multi = jax.jit(step_fn, donate_argnums=(0,))
+            def train(state, key):
+                for i in range(10):
+                    key, sub = jax.random.split(key)
+                    state, metrics = multi(state, sub)
+                return state, metrics
+            """)
+        assert clean == []
+        flagged = run("""
+            import jax
+            multi = jax.jit(step_fn, donate_argnums=(0,))
+            def train(state, key):
+                new_state, metrics = multi(state, key)
+                return new_state, state.g_params
+            """)
+        assert [f.rule for f in flagged] == ["JAX004"]

@@ -1,74 +1,15 @@
-"""Round-4 decision probe: bf16 operand streams through the FULL kernel
-path (fwd/bwd/adjoint, single-layer + fused stack) vs the round-3 rows.
-
-Extends the round-3 crossover table (RESULTS.md "bf16: measured
-decision") with the bf16/pallas column that round 3 called "an essay
-rather than a feature", and records where the shape-aware
-`kernel_eligible` routes each config (H=512 f32 now falls back to scan
-instead of the round-3 VMEM OOM).  Same state-threaded end-to-end
-methodology: 50-epoch scanned blocks, TWO warmups (compile + the
-donated-state retrace), distinct keys per call.
-
-Usage: python tools/bench_bf16_kernel_probe.py [h1,h2,...]
+"""Shim: the round-4 kernel probe folded into the consolidated
+policy-aware probe (ISSUE 6) — one instrument, the production ``Policy``
+path instead of hand-rolled casts.  Kept so RESULTS.md's historical
+command lines keep working; use ``tools/bench_bf16_probe.py`` directly.
 """
 
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-
-
-def probe(cases, n_calls=6):
-    from hfrep_tpu.config import ModelConfig, TrainConfig
-    from hfrep_tpu.models.registry import build_gan
-    from hfrep_tpu.train.states import init_gan_state
-    from hfrep_tpu.train.steps import make_multi_step
-
-    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35), jnp.float32)
-    for h, dtype, backend in cases:
-        t_build = time.perf_counter()
-        mcfg = ModelConfig(family="mtss_wgan_gp", hidden=h, dtype=dtype)
-        tcfg = TrainConfig(steps_per_call=50, lstm_backend=backend)
-        pair = build_gan(mcfg)
-        state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
-        step = make_multi_step(pair, tcfg, data)
-        # keys salted by (h, dtype, backend) so no (program, inputs) pair
-        # repeats across configs (server-side execution dedup); the fence
-        # is a device_get of the final metrics — block_until_ready does
-        # not reliably fence on this backend (RESULTS.md measurement
-        # traps), but the calls are state-threaded so materializing the
-        # last loss forces the whole chain.
-        salt = hash((h, dtype, backend)) % (2**31)
-        try:
-            state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(1), salt))
-            float(jax.device_get(m["d_loss"])[-1])
-            state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(99), salt))
-            float(jax.device_get(m["d_loss"])[-1])
-        except Exception as e:  # noqa: BLE001 - report any compile/run failure
-            print(f"h={h} {dtype}/{backend}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:140]}", flush=True)
-            continue
-        t0 = time.perf_counter()
-        for i in range(n_calls):
-            state, m = step(state, jax.random.fold_in(
-                jax.random.PRNGKey(2 + salt), i))
-        float(jax.device_get(m["d_loss"])[-1])
-        rate = n_calls * 50 / (time.perf_counter() - t0)
-        fin = bool(jnp.isfinite(m["d_loss"]).all())
-        print(f"h={h} {dtype}/{backend}: {rate:.1f} steps/s finite={fin} "
-              f"(total {time.perf_counter() - t_build:.0f}s incl. compile)",
-              flush=True)
-
+from bench_bf16_probe import main  # noqa: E402
 
 if __name__ == "__main__":
-    hiddens = ([int(v) for v in sys.argv[1].split(",")] if len(sys.argv) > 1
-               else [100, 256, 384, 512])
-    cases = []
-    for h in hiddens:
-        cases += [(h, "bfloat16", "pallas"), (h, "float32", "pallas")]
-    probe(cases)
-    print("probe done", flush=True)
+    raise SystemExit(main())

@@ -1,130 +1,198 @@
-"""Decision probe for the bf16 kernel question (VERDICT r1 item 3).
+"""Consolidated mixed-precision probe: the production ``Policy`` paths,
+measured end to end.
 
-Times the pallas LSTM forward traversal with f32 vs bf16 operand
-streams at the two real shapes, plus the end-to-end MTSS-WGAN-GP train
-step in f32-pallas vs bf16-scan, on the real chip.  The outcome decides
-whether the full bf16 backward/adjoint kernel path is worth building or
-whether f32 is already optimal at these shapes (documented either way in
-RESULTS.md).
+Supersedes the round-1/round-4 pair (``bench_bf16_probe.py`` +
+``bench_bf16_kernel_probe.py``), which predated the precision policy and
+hand-rolled their dtype casts — including a raw ``_lstm_seq_fwd_impl``
+micro-bench RESULTS.md later documented as unmeasurable through the
+tunnel (identical-execution dedup, non-fencing readiness, 0.1-0.9 s
+dispatch jitter).  This probe exercises exactly what production runs:
+``ModelConfig.dtype`` → :func:`hfrep_tpu.models.registry.build_gan` →
+``GanPair.policy`` → the train step's fp32-accumulation casts, through
+the same shape-aware ``kernel_eligible`` dispatch, so a number here is a
+number the trainer will reproduce.
+
+Methodology is the one every RESULTS.md round converged on: 50-epoch
+scanned blocks, state-threaded calls (nothing to dedup or reorder), TWO
+warmups (compile + donated-state retrace), keys salted per config, and a
+``device_get`` of the final loss as the fence.
+
+Telemetry: each measured cell lands as a ``bench/bf16_*`` gauge when
+``HFREP_OBS_DIR`` is set (``obs.session_or_off`` degrade-to-off
+contract), so the dtype crossover table is a first-class run-history
+series the sentinel can baseline.
+
+Usage:
+    python tools/bench_bf16_probe.py [h1,h2,...]       # chip probe
+    python tools/bench_bf16_probe.py --self-test       # fast CPU gate
 """
 
-import sys, os
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import os
+import sys
 
-import time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
-from hfrep_tpu.ops.pallas_lstm import LANE, _lstm_seq_fwd_impl, pad_keras_params
 
-
-def time_fn(fn, *args, iters=50):
-    out = jax.block_until_ready(fn(*args))          # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters, out
-
-
-def _probe_hidden_sizes(hiddens=(100, 256, 384, 512), n_calls=6):
-    from hfrep_tpu.config import ModelConfig, TrainConfig
+def _build(mcfg, tcfg, data, seed=0):
     from hfrep_tpu.models.registry import build_gan
     from hfrep_tpu.train.states import init_gan_state
     from hfrep_tpu.train.steps import make_multi_step
 
-    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35), jnp.float32)
-    for h in hiddens:
-        rates = {}
-        for label, dtype, backend in [("f32/pallas", "float32", "pallas"),
-                                      ("bf16/pallas", "bfloat16", "pallas"),
-                                      ("bf16/scan", "bfloat16", "xla"),
-                                      ("f32/scan", "float32", "xla")]:
-            mcfg = ModelConfig(family="mtss_wgan_gp", hidden=h, dtype=dtype)
-            tcfg = TrainConfig(steps_per_call=50, lstm_backend=backend)
-            pair = build_gan(mcfg)
-            state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
-            step = make_multi_step(pair, tcfg, data)
-            try:
-                state, m = step(state, jax.random.PRNGKey(1))
-                jax.block_until_ready(m)
-            except Exception as e:                    # e.g. VMEM OOM at large H
-                rates[label] = None
-                print(f"  hidden={h} {label}: FAILED "
-                      f"({type(e).__name__}: {str(e)[:120]}...)")
-                continue
-            t0 = time.perf_counter()
-            for i in range(n_calls):
-                state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(2), i))
-            jax.block_until_ready(m)
-            rates[label] = n_calls * 50 / (time.perf_counter() - t0)
-            assert jnp.isfinite(m["d_loss"]).all()
-        ok = {k: v for k, v in rates.items() if v}
-        best16 = max((v for k, v in ok.items() if k.startswith("bf16")),
-                     default=None)
-        best32 = max((v for k, v in ok.items() if k.startswith("f32")), default=None)
-        ratio = (f"  -> best-bf16 vs best-f32: {best16/best32:.2f}x"
-                 if best16 and best32 else "")
-        print(f"hidden={h}: " + "  ".join(
-            f"{k} {v:.1f}/s" if v else f"{k} n/a" for k, v in rates.items()) + ratio)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(seed), mcfg, tcfg, pair)
+    step = make_multi_step(pair, tcfg, data)
+    return pair, state, step
 
 
-def main():
-    print("backend:", jax.default_backend())
-    fwd = jax.jit(lambda xz, rec: _lstm_seq_fwd_impl(xz, rec, "sigmoid",
-                                                     with_cs=False))
-    for (b, w, h) in [(32, 48, 100), (32, 168, 100)]:
-        hp = ((h + LANE - 1) // LANE) * LANE
-        k_xz, k_rec = jax.random.split(jax.random.PRNGKey(0))
-        xz32 = jax.random.normal(k_xz, (w, b, 4 * hp), jnp.float32)
-        rec32 = jax.random.normal(k_rec, (hp, 4 * hp), jnp.float32) * 0.05
-        t32, h32 = time_fn(fwd, xz32, rec32)
-        t16, h16 = time_fn(fwd, xz32.astype(jnp.bfloat16), rec32.astype(jnp.bfloat16))
-        err = float(jnp.abs(h32 - h16).max())
-        print(f"fwd traversal (B={b}, W={w}, Hp={hp}): "
-              f"f32 {t32*1e6:.1f}us  bf16-operands {t16*1e6:.1f}us "
-              f"({t32/t16:.2f}x)  max|Δh|={err:.2e}")
+def measure_cell(mcfg, tcfg, data, n_calls: int = 6):
+    """One (config, backend) cell: steps/sec through the production
+    policy path, or ``None`` on a compile/run failure (e.g. VMEM OOM at
+    widths the eligibility model rejects on other backends) or a
+    diverged loss — a failed cell must not abort the rest of the table.
 
-    # Larger-model probe (VERDICT r2 item 7): the forward kernel accepts
-    # bf16 operand streams "for larger-model reuse" — measure where (if
-    # anywhere) that actually pays.  Isolated traversal timings through
-    # the tunnel proved unmeasurable in BOTH directions (identical-
-    # execution dedup, non-fencing readiness, 0.1-0.9 s latency jitter —
-    # even a reps=300 vs reps=3000 slope method returns negative slopes),
-    # so the instrument is the same state-threaded end-to-end loop
-    # bench.py uses: each dispatch consumes the previous dispatch's
-    # state, which nothing can dedup or reorder, and 50 epochs/dispatch
-    # dwarf the jitter.  Scaling `hidden` scales the recurrent matmul
-    # (the op whose operand width bf16 halves) quadratically.
-    print("--- larger-model probe: end-to-end train epochs at hidden=H ---")
-    _probe_hidden_sizes()
+    The timing itself is :func:`bench._timed_multi` — the ONE
+    state-threaded warmup/fence harness every measurement shares — so
+    this probe can never drift methodologically from the bench it
+    corroborates (n_warmups=2: compile + the donated-state retrace).
+    """
+    from bench import _timed_multi
 
-    # End-to-end: one flagship train epoch, f32+pallas vs bf16+scan.
+    label = f"h={mcfg.hidden} {mcfg.dtype}/{tcfg.lstm_backend}"
+    salt = hash((mcfg.hidden, mcfg.dtype, tcfg.lstm_backend)) % (2**31)
+    try:
+        pair, state, step = _build(mcfg, tcfg, data)
+        rate = _timed_multi(step, state,
+                            jax.random.fold_in(jax.random.PRNGKey(1), salt),
+                            2, n_calls, tcfg.steps_per_call,
+                            label=f"bf16_probe_h{mcfg.hidden}")
+    except AssertionError:
+        # _timed_multi's finiteness fence tripped: a diverged cell
+        print(f"{label}: NON-FINITE loss after {n_calls} blocks", flush=True)
+        return None
+    except Exception as e:  # noqa: BLE001 - report any compile/run failure
+        print(f"{label}: FAILED {type(e).__name__}: {str(e)[:140]}",
+              flush=True)
+        return None
+    print(f"{label}: {rate:.1f} steps/s", flush=True)
+    return rate
+
+
+def probe(hiddens, n_calls: int = 6) -> int:
+    """The crossover table (RESULTS.md rounds 3/4), through the policy:
+    for each width, f32 and bf16 over both the pallas and scan backends
+    — ``kernel_eligible`` decides per (width, dtype) whether the pallas
+    request actually lands on kernels, exactly as in production."""
+    import hfrep_tpu.obs as obs_pkg
     from hfrep_tpu.config import ModelConfig, TrainConfig
-    from hfrep_tpu.models.registry import build_gan
-    from hfrep_tpu.train.states import init_gan_state
-    from hfrep_tpu.train.steps import make_multi_step
 
-    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35), jnp.float32)
-    for label, dtype, backend in [("f32/pallas", "float32", "pallas"),
-                                  ("bf16/scan", "bfloat16", "xla"),
-                                  ("f32/scan", "float32", "xla")]:
-        mcfg = ModelConfig(family="mtss_wgan_gp", dtype=dtype)
-        tcfg = TrainConfig(steps_per_call=50, lstm_backend=backend)
-        pair = build_gan(mcfg)
-        state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
-        step = make_multi_step(pair, tcfg, data)
-        state, m = step(state, jax.random.PRNGKey(1))
-        jax.block_until_ready(m)
-        t0 = time.perf_counter()
-        for i in range(4):
-            state, m = step(state, jax.random.fold_in(jax.random.PRNGKey(2), i))
-        jax.block_until_ready(m)
-        dt = time.perf_counter() - t0
-        print(f"train epoch {label}: {200/dt:.1f} steps/s "
-              f"(d_loss {float(m['d_loss'][-1]):.3f})")
+    data = jax.random.uniform(jax.random.PRNGKey(1), (1000, 48, 35),
+                              jnp.float32)
+    measured = 0
+    with obs_pkg.session_or_off(os.environ.get("HFREP_OBS_DIR"),
+                                "bench_bf16", command="bench_bf16") as obs:
+        print("backend:", jax.default_backend(), flush=True)
+        for h in hiddens:
+            rates = {}
+            for dtype in ("float32", "bfloat16"):
+                for backend in ("pallas", "xla"):
+                    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=h,
+                                       dtype=dtype)
+                    tcfg = TrainConfig(steps_per_call=50,
+                                       lstm_backend=backend)
+                    rates[(dtype, backend)] = measure_cell(
+                        mcfg, tcfg, data, n_calls)
+            for (dtype, backend), rate in rates.items():
+                if rate is not None:
+                    measured += 1
+                    tag = "bf16" if dtype == "bfloat16" else "f32"
+                    obs.gauge(
+                        f"bench/bf16_probe_h{h}_{tag}_{backend}"
+                    ).set(float(rate))
+            best16 = max((v for (d, _), v in rates.items()
+                          if v and d == "bfloat16"), default=None)
+            best32 = max((v for (d, _), v in rates.items()
+                          if v and d == "float32"), default=None)
+            if best16 and best32:
+                obs.gauge(f"bench/bf16_speedup_h{h}").set(best16 / best32)
+                print(f"h={h}: best-bf16 vs best-f32 = "
+                      f"{best16 / best32:.2f}x", flush=True)
+    if not measured:
+        # every cell failed or diverged: an empty table must not exit 0
+        # (a driver would read success with zero evidence)
+        print("probe FAILED: no cell measured", flush=True)
+        return 1
+    print(f"probe done ({measured} cells)", flush=True)
+    return 0
+
+
+def self_test() -> int:
+    """Fast CPU gate for tools/check.sh: the policy plumbing end to end
+    at fixture shapes — (1) the fp32 policy's step is BIT-identical to a
+    policy-free trace (graph-level pin: identical jaxprs), (2) the bf16
+    policy trains finite and tracks the f32 trajectory within the
+    documented tolerance, with fp32 master weights throughout, (3) the
+    fused n_critic=1 G/D step matches the alternating form exactly."""
+    import numpy as np
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.core.precision import Policy, policy_from
+
+    data = jax.random.uniform(jax.random.PRNGKey(1), (64, 8, 5), jnp.float32)
+
+    def run(dtype, n_critic=2, fuse=True, seed=0):
+        mcfg = ModelConfig(family="mtss_wgan_gp", features=5, window=8,
+                           hidden=8, dtype=dtype)
+        tcfg = TrainConfig(steps_per_call=3, batch_size=4,
+                           n_critic=n_critic, fuse_gd=fuse)
+        pair, state, step = _build(mcfg, tcfg, data, seed)
+        state, m = step(state, jax.random.PRNGKey(2))
+        return pair, state, {k: np.asarray(v) for k, v in m.items()}
+
+    # (1) fp32 policy is the identity: Policy.accum/compute return their
+    # argument unchanged, so the fp32 step's jaxpr carries no policy
+    # residue at all
+    pol = policy_from("float32")
+    x = jnp.ones((3,))
+    assert pol.accum(x) is x and pol.compute(x) is x and not pol.mixed
+    assert policy_from("bfloat16").mixed
+    assert Policy().describe()["param"] == "float32"
+
+    # (2) bf16 vs f32: same init (master weights are seeded identically —
+    # param init never runs in compute dtype), trajectories within the
+    # documented tolerance (README "Mixed precision": ~1e-2 relative on
+    # W-GAN losses at fixture scale), params stay fp32
+    pair16, s16, m16 = run("bfloat16")
+    _, s32, m32 = run("float32")
+    assert pair16.policy.mixed
+    for leaf in jax.tree_util.tree_leaves((s16.g_params, s16.d_params)):
+        assert leaf.dtype == jnp.float32, f"master weight leaked: {leaf.dtype}"
+    assert np.isfinite(m16["d_loss"]).all() and np.isfinite(m16["g_loss"]).all()
+    np.testing.assert_allclose(m16["d_loss"], m32["d_loss"], rtol=2e-2,
+                               err_msg="bf16 d_loss diverged from f32")
+
+    # (3) fused single-critic step == alternating form, bitwise
+    _, sf, mf = run("float32", n_critic=1, fuse=True)
+    _, sl, ml = run("float32", n_critic=1, fuse=False)
+    for a, b in zip(jax.tree_util.tree_leaves(sf), jax.tree_util.tree_leaves(sl)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(mf["d_loss"], ml["d_loss"])
+
+    print("bench_bf16 self-test ok: fp32-policy identity, bf16 tolerance "
+          f"(max d_loss delta {np.abs(m16['d_loss'] - m32['d_loss']).max():.4f}), "
+          "fp32 master weights, fused==alternating", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--self-test" in argv:
+        return self_test()
+    hiddens = ([int(v) for v in argv[0].split(",")] if argv
+               else [100, 256, 384, 512])
+    return probe(hiddens)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

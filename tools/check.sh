@@ -38,3 +38,9 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
 # store, and an ambient HFREP_FAULTS plan must not fire inside the gate.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
     python -m hfrep_tpu.resilience selftest 1>&2
+# mixed-precision gate: the production Policy path end to end at fixture
+# shapes — fp32-policy identity (bit-identical graphs), bf16-vs-f32
+# trajectory tolerance with fp32 master weights, fused==alternating G/D
+# at n_critic=1.  CPU-pinned + env-stripped like the other self-tests.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
+    python tools/bench_bf16_probe.py --self-test 1>&2
