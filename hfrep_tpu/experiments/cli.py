@@ -180,6 +180,68 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="enable hfrep_tpu.obs telemetry for the sweep "
                         "(AE training/eval spans, memory snapshots)")
 
+    pl = sub.add_parser(
+        "pipeline",
+        help="async actor fabric: GAN synthesis streaming into AE sweep "
+             "consumers over a bounded queue (Podracer-style; survives "
+             "losing any member, drains pod-wide on SIGTERM → exit 75)")
+    pl.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    pl.add_argument("--preset", default="mtss_wgan_gp_prod",
+                    help="preset the --gan-checkpoint was trained with")
+    plsrc = pl.add_mutually_exclusive_group(required=True)
+    plsrc.add_argument("--gan-checkpoint", action="append", default=None,
+                       help="generator checkpoint; repeatable — one "
+                            "generator actor per checkpoint, each "
+                            "streaming --blocks sample blocks; consumers "
+                            "run the GAN-augmented sweep per block")
+    plsrc.add_argument("--fixture-sources", type=int, default=None,
+                       metavar="K",
+                       help="K deterministic synthetic generator actors "
+                            "(no cleaned data or checkpoint needed) — "
+                            "drills and benches the fabric itself")
+    pl.add_argument("--blocks", type=int, default=4,
+                    help="sample blocks per generator actor; the block is "
+                         "streamed item-wise with a sub-block snapshot "
+                         "after every item, so a killed member rejoins "
+                         "mid-block")
+    pl.add_argument("--n-gen-windows", type=int, default=10,
+                    help="windows per sample block (gan sources)")
+    pl.add_argument("--latents", default="1:21",
+                    help="'lo:hi' inclusive, or comma list")
+    pl.add_argument("--consumers", type=int, default=1,
+                    help="AE sweep consumer actors pulling from the queue")
+    pl.add_argument("--queue-capacity", type=int, default=4,
+                    help="spool bound: generators block (backpressure) "
+                         "while this many items are unclaimed")
+    pl.add_argument("--epochs", type=int, default=None,
+                    help="AE epochs override")
+    pl.add_argument("--chunk-epochs", type=int, default=None,
+                    help="AEConfig.chunk_epochs override")
+    pl.add_argument("--fixture-rows", type=int, default=120,
+                    help="panel rows per fixture item")
+    pl.add_argument("--fixture-feats", type=int, default=16,
+                    help="panel features per fixture item (sets the AE "
+                         "input width in fixture mode)")
+    pl.add_argument("--stream-seed", type=int, default=0,
+                    help="seed of the deterministic item streams — every "
+                         "item is a pure function of (seed, source, seq)")
+    pl.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="seconds the coordinated drain barrier waits for "
+                         "every member before escalating stragglers with "
+                         "SIGKILL (their durable state precedes the "
+                         "barrier, so escalation is resume-safe)")
+    pl.add_argument("--out", required=True)
+    pl.add_argument("--resume", action="store_true",
+                    help="continue a killed/drained pipeline: orphaned "
+                         "queue claims are requeued, generators fast-"
+                         "forward via their sub-block snapshots, "
+                         "consumers skip published results — final "
+                         "artifacts bit-identical to an undisturbed run")
+    pl.add_argument("--obs-dir", default=None,
+                    help="telemetry run dir: actor lifecycle events, "
+                         "queue depth gauge, restart counters (each actor "
+                         "additionally streams into <dir>/actors/<name>)")
+
     h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
                                          "into an inverse-scaled cube (.npy)")
     h.add_argument("--h5", required=True, help="trained_generator/*.h5 artifact")
@@ -605,6 +667,70 @@ def _sweep_outputs(args, result, out_dir, panel, y_test, rf_test) -> int:
     return 0
 
 
+def cmd_pipeline(args) -> int:
+    import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu.resilience import Preempted
+    obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session(obs_dir, command="pipeline"):
+        try:
+            return _cmd_pipeline_impl(args)
+        except Preempted as e:
+            print(f"preempted: {e}; re-run with --resume to continue "
+                  "from the drained state", file=sys.stderr)
+            return 75
+
+
+def _cmd_pipeline_impl(args) -> int:
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.orchestrate import (
+        PipelinePlan,
+        PipelineStateError,
+        SourceSpec,
+        run_pipeline,
+    )
+
+    cfg = AEConfig()
+    if args.epochs:
+        cfg = dataclasses.replace(cfg, epochs=args.epochs)
+    if args.chunk_epochs is not None:
+        cfg = dataclasses.replace(cfg, chunk_epochs=args.chunk_epochs)
+    if args.gan_checkpoint:
+        sources = [
+            SourceSpec(name=f"g{i}", mode="gan",
+                       params={"preset": args.preset, "checkpoint": ck,
+                               "n_gen_windows": args.n_gen_windows})
+            for i, ck in enumerate(args.gan_checkpoint)]
+        consume_mode = "augment"
+    else:
+        cfg = dataclasses.replace(cfg, n_factors=args.fixture_feats,
+                                  latent_dim=min(cfg.latent_dim,
+                                                 args.fixture_feats))
+        sources = [
+            SourceSpec(name=f"f{i}", mode="fixture",
+                       params={"rows": args.fixture_rows,
+                               "feats": args.fixture_feats})
+            for i in range(args.fixture_sources)]
+        consume_mode = "direct"
+    latents = _parse_latents(args.latents)
+    plan = PipelinePlan(
+        out_dir=args.out, sources=sources, blocks=args.blocks,
+        consumers=args.consumers, capacity=args.queue_capacity,
+        ae_cfg=cfg, latent_dims=latents, consume_mode=consume_mode,
+        cleaned_dir=args.cleaned_dir, stream_seed=args.stream_seed,
+        drain_timeout=args.drain_timeout, timeout=None)
+    try:
+        out = run_pipeline(plan, resume=args.resume)
+    except PipelineStateError as e:
+        print(f"pipeline: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps({"sources": sorted(out["summary"]["sources"]),
+                      "blocks": args.blocks,
+                      "consumers": args.consumers,
+                      **out["stats"]}, indent=2))
+    print(f"assembled: {os.path.join(args.out, 'pipeline.json')}")
+    return 0
+
+
 def cmd_sample_h5(args) -> int:
     import jax
     from hfrep_tpu.core.data import load_panel
@@ -618,27 +744,6 @@ def cmd_sample_h5(args) -> int:
     return 0
 
 
-def _enable_compilation_cache() -> None:
-    """Persist XLA compilations across CLI invocations.
-
-    The sweep/train programs cost ~2 min of compiles per fresh process
-    (expanding-window OOS batch, rolling-OLS ante, 21-latent vmapped
-    trainer); with the on-disk cache a repeat run on a directly-attached
-    backend skips them.  (On this image's tunneled single-chip 'axon'
-    platform compilation happens on the far side of the tunnel, so the
-    local cache cannot shortcut it — measured no-op there, effective on
-    standard CPU/TPU backends.)  Disable with HFREP_COMPILATION_CACHE=''.
-    """
-    cache = os.environ.get("HFREP_COMPILATION_CACHE",
-                           os.path.expanduser("~/.cache/hfrep_tpu_xla"))
-    if not cache:
-        return
-    import jax
-    os.makedirs(cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # HFREP_PLATFORM overrides the backend before jax initializes — the
@@ -650,16 +755,19 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", platform)
     if args.cmd != "clean":            # clean is jax-free; keep startup light
-        _enable_compilation_cache()
-        if args.cmd not in ("train-gan", "sweep"):
+        from hfrep_tpu.utils.xla_cache import enable_compilation_cache
+        enable_compilation_cache()
+        if args.cmd not in ("train-gan", "sweep", "pipeline"):
             # HFREP_OBS_DIR opt-in for the commands without an --obs-dir
-            # flag; train-gan/sweep manage their own lifecycle (multi-host
-            # ordering + per-process dirs + run_end on the error path)
+            # flag; train-gan/sweep/pipeline manage their own lifecycle
+            # (multi-host ordering + per-process dirs + run_end on the
+            # error path)
             from hfrep_tpu.obs import maybe_enable_from_env
             maybe_enable_from_env()
     try:
         return {"clean": cmd_clean, "train-gan": cmd_train_gan,
                 "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
+                "pipeline": cmd_pipeline,
                 "sample-h5": cmd_sample_h5}[args.cmd](args)
     finally:
         from hfrep_tpu.obs import disable

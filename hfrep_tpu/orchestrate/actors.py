@@ -1,0 +1,288 @@
+"""Actor worker processes: the code that runs inside a fabric member.
+
+Each member is an OS process (``multiprocessing`` spawn context — a
+fresh interpreter, no forked JAX runtime) executing :func:`actor_main`
+with a role and a picklable payload dict:
+
+* **generator** — streams its block of ``(source, seq)`` items into the
+  spool queue.  Every item is a pure function of
+  ``(stream_seed, source_idx, seq)``, so a restarted member regenerates
+  exactly what the killed one would have produced; after every put it
+  persists a sub-block :class:`~hfrep_tpu.resilience.snapshot.
+  ProgressSnapshot`, so the restart *resumes mid-block* instead of
+  replaying delivered items.
+* **consumer** — claims items, runs the AE sweep for each, publishes
+  the result artifact atomically under ``results/<source>_<seq>``, then
+  acks.  Results are keyed by ``(source, seq)`` and the computation is
+  a pure function of the item, so reprocessing after a crash (or a
+  duplicate delivery) skips work it finds already published —
+  idempotence is what turns at-least-once delivery into exactly-once
+  results.
+
+Drain contract: SIGTERM (forwarded member-wise by the supervisor's
+barrier) sets the drain flag via the member's own
+:func:`~hfrep_tpu.resilience.graceful_drain` handler; the loops honor
+it at their **item boundary** — the fabric-wide common checkpoint
+boundary — then cross the ``drain_barrier`` fault site (where an
+injected ``stall`` simulates a member that hangs instead of draining)
+and exit :data:`EXIT_DRAINED` (75).  A consumer that proves the stream
+complete-with-gaps exits :data:`EXIT_GAP` so the supervisor can abort
+loudly instead of assembling a silently incomplete run.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+EXIT_DRAINED = 75        # EX_TEMPFAIL: drained at a safe boundary, resumable
+EXIT_GAP = 3             # stream complete but items are missing — fatal
+
+RESULT_PREFIX = "r_"
+
+
+def result_name(source: str, seq: int) -> str:
+    return f"{RESULT_PREFIX}{source}_{seq:05d}"
+
+
+class QueueGap(RuntimeError):
+    """Every source hit eof and the spool is empty, yet results for some
+    ``(source, seq)`` pairs are missing — a dropped (e.g. corrupt,
+    discarded) item nobody can regenerate at this layer."""
+
+
+# --------------------------------------------------------------- payloads
+def _fixture_panel(stream_seed: int, source_idx: int, seq: int,
+                   rows: int, feats: int, rank: int = 3) -> np.ndarray:
+    """Deterministic low-rank scaled panel for the fixture source — the
+    selftest/bench stand-in for GAN synthesis.  Seeded by the full
+    (stream, source, seq) coordinate so every item is unique yet
+    reproducible on any member."""
+    g = np.random.default_rng((stream_seed, source_idx, seq))
+    z = g.normal(size=(rows, rank))
+    x = (z @ g.normal(size=(rank, feats))
+         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    scale = np.where(hi - lo == 0.0, 1.0, hi - lo)
+    return ((x - lo) / scale).astype(np.float32)
+
+
+def _make_generator(payload: dict):
+    """``fn(seq) -> {name: array}`` for the payload's source mode."""
+    mode = payload["mode"]
+    stream_seed = int(payload.get("stream_seed", 0))
+    source_idx = int(payload["source_idx"])
+    if mode == "fixture":
+        import time
+
+        rows, feats = int(payload["rows"]), int(payload["feats"])
+        # models the latency of real GAN sampling (tools/bench_async.py's
+        # overlap measurement): pure wall clock, never touches the bytes
+        gen_delay = float(payload.get("gen_delay", 0.0))
+
+        def gen(seq: int) -> Dict[str, np.ndarray]:
+            if gen_delay > 0.0:
+                time.sleep(gen_delay)
+            return {"panel": _fixture_panel(stream_seed, source_idx, seq,
+                                            rows, feats)}
+        return gen
+    if mode == "gan":
+        # build once per process: a restart pays one rebuild, items after
+        # that stream at generate() cost
+        from hfrep_tpu.experiments.cli import _make_trainer
+        trainer, _, _, _ = _make_trainer(payload["preset"],
+                                         payload["cleaned_dir"], quiet=True)
+        trainer.restore_checkpoint(payload["checkpoint"])
+        n_windows = int(payload["n_gen_windows"])
+
+        def gen(seq: int) -> Dict[str, np.ndarray]:
+            cube = trainer.generate_block(seq, n_windows,
+                                          stream_seed=stream_seed
+                                          + 1009 * source_idx)
+            return {"cube": np.asarray(cube)}
+        return gen
+    raise ValueError(f"unknown generator mode {mode!r}")
+
+
+def _make_consumer(payload: dict):
+    """``fn(source_idx, seq, arrays, tmp_dir) -> None`` writing the item's
+    result artifact into ``tmp_dir`` (published atomically around it)."""
+    import jax
+
+    from hfrep_tpu.replication import engine as eng
+
+    cfg = payload["ae_cfg"]
+    latent_dims = list(payload["latent_dims"])
+    mode = payload["consume_mode"]
+    if mode == "direct":
+
+        def consume(source_idx: int, seq: int, arrays, tmp_dir: Path) -> None:
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), source_idx),
+                seq)
+            out = eng.sweep_item_arrays(key, arrays["panel"], cfg,
+                                        latent_dims)
+            np.savez(tmp_dir / "sweep.npz", **out)
+        return consume
+    if mode == "augment":
+        from hfrep_tpu.core.data import load_panel
+        from hfrep_tpu.experiments.augment import (
+            augment_training_set,
+            split_cube,
+        )
+        from hfrep_tpu.experiments.sweep import run_sweep
+
+        panel = load_panel(payload["cleaned_dir"])
+        x_train, x_test, y_train, y_test = panel.train_test_split()
+        rf_test = panel.rf[x_train.shape[0]:]
+
+        def consume(source_idx: int, seq: int, arrays, tmp_dir: Path) -> None:
+            aug = split_cube(arrays["cube"], n_factors=x_train.shape[1],
+                             n_hf=y_train.shape[1])
+            x_aug, y_aug = augment_training_set(x_train, y_train, aug)
+            res = run_sweep(x_aug, y_aug, x_test, y_test, rf_test,
+                            panel.factors, cfg, latent_dims,
+                            strategy_names=panel.hf_names)
+            res.save(str(tmp_dir))
+        return consume
+    raise ValueError(f"unknown consume mode {mode!r}")
+
+
+# ------------------------------------------------------------- the loops
+def _generator_loop(name: str, payload: dict) -> None:
+    from hfrep_tpu import resilience
+    from hfrep_tpu.orchestrate.queue import SpoolQueue
+    from hfrep_tpu.resilience.snapshot import ProgressSnapshot
+
+    q = SpoolQueue(payload["queue_dir"], capacity=int(payload["capacity"]))
+    source, blocks = payload["source"], int(payload["blocks"])
+    snap = ProgressSnapshot(
+        payload["snapshot_dir"],
+        fingerprint={"source": source, "blocks": blocks,
+                     "mode": payload["mode"],
+                     "stream_seed": payload.get("stream_seed", 0)},
+        name=f"gen_{source}")
+    start = 0
+    state = snap.load()
+    if state is not None:
+        start = int(state.get("next", 0))
+    gen = _make_generator(payload)
+    extra = {"source_idx": int(payload["source_idx"])}
+    for seq in range(start, blocks):
+        q.put(source, seq, gen(seq), extra_meta=extra)
+        snap.save({"next": seq + 1})
+        # the sub-block boundary: injected faults fire here, and a
+        # requested drain raises with the snapshot already on disk
+        resilience.boundary("item")
+    q.put_eof(source, blocks)
+    snap.save({"next": blocks, "eof": True})
+
+
+def _missing_results(eofs: Dict[str, int], results_dir: Path) -> List[str]:
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    missing = []
+    for source, count in sorted(eofs.items()):
+        for seq in range(count):
+            res = results_dir / result_name(source, seq)
+            if not (res / ckpt.META_NAME).exists():
+                missing.append(result_name(source, seq))
+    return missing
+
+
+def _consumer_loop(name: str, payload: dict) -> None:
+    import shutil
+    import time
+
+    from hfrep_tpu import resilience
+    from hfrep_tpu.orchestrate.queue import SpoolQueue
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    q = SpoolQueue(payload["queue_dir"], capacity=int(payload["capacity"]))
+    results_dir = Path(payload["results_dir"])
+    results_dir.mkdir(parents=True, exist_ok=True)
+    sources = list(payload["sources"])
+    consume = _make_consumer(payload)
+    while True:
+        item = q.claim(name)
+        if item is None:
+            if q.drained(sources):
+                missing = _missing_results(q.eof_counts(), results_dir)
+                if missing:
+                    raise QueueGap(
+                        f"stream complete but {len(missing)} results "
+                        f"missing: {', '.join(missing[:5])}"
+                        + ("..." if len(missing) > 5 else ""))
+                return
+            # idle poll is also a safe boundary — nothing is claimed
+            resilience.boundary("idle")
+            time.sleep(q.poll)
+            continue
+        res_dir = results_dir / result_name(item.source, item.seq)
+        # skip only a result that VERIFIES: a duplicate delivery whose
+        # published artifact rotted in the meantime is recomputed (same
+        # degrade-don't-trust pattern as every snapshot loader here)
+        published = (res_dir / ckpt.META_NAME).exists()
+        if published:
+            try:
+                ckpt.verify(res_dir)
+            except ckpt.CheckpointCorrupt:
+                shutil.rmtree(res_dir, ignore_errors=True)
+                published = False
+        if not published:
+            arrays = item.arrays()
+            source_idx = int(item.meta.get("source_idx", 0))
+            ckpt.write_atomic(
+                res_dir,
+                lambda tmp: consume(source_idx, item.seq, arrays, tmp),
+                metadata={"source": item.source, "seq": item.seq},
+                io_site="result_save", fault_site="result")
+        q.ack(item)
+        # the item boundary: result published + claim acked = the common
+        # checkpoint boundary every member drains at
+        resilience.boundary("item")
+
+
+# ------------------------------------------------------------- bootstrap
+def actor_main(name: str, role: str, payload: dict) -> None:
+    """Entry point of a spawned member process.
+
+    Pins the JAX platform before anything initializes it (children must
+    match the pod's backend, and a spawned interpreter re-resolves it
+    from scratch), opens a per-actor obs session when the supervisor
+    handed one down, and maps the drain contract onto exit codes.
+    """
+    platform = payload.get("platform")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    # a spawned member is a fresh interpreter: without the persistent
+    # cache every consumer restart re-pays its AE chunk-program compile
+    from hfrep_tpu.utils.xla_cache import enable_compilation_cache
+    enable_compilation_cache()
+    import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu import resilience
+
+    with obs_pkg.session(payload.get("obs_dir"), command=f"actor:{role}",
+                         actor=name):
+        try:
+            with resilience.graceful_drain():
+                if role == "generator":
+                    _generator_loop(name, payload)
+                elif role == "consumer":
+                    _consumer_loop(name, payload)
+                else:
+                    raise ValueError(f"unknown actor role {role!r}")
+        except resilience.Preempted:
+            from hfrep_tpu.obs import get_obs
+            get_obs().event("actor_drained", actor=name)
+            # the barrier crossing: an injected stall@drain_barrier hangs
+            # HERE, driving the supervisor's timeout/escalation path
+            resilience.tick("drain_barrier")
+            sys.exit(EXIT_DRAINED)
+        except QueueGap as e:
+            print(f"{name}: {e}", file=sys.stderr)
+            sys.exit(EXIT_GAP)

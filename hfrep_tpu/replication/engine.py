@@ -550,6 +550,31 @@ def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
                         n_lanes_init=n_lanes, resume_dir=resume_dir)
 
 
+def sweep_item_arrays(key: jax.Array, panel, cfg: AEConfig,
+                      latent_dims: Sequence[int]) -> dict:
+    """Actor-driven entry point: one queue item's latent sweep as a flat
+    ``{name: np.ndarray}`` dict ready for an ``npz`` artifact.
+
+    The orchestration fabric's consumer actors
+    (:mod:`hfrep_tpu.orchestrate.actors`) call this once per claimed
+    item; the output is a pure function of ``(key, panel, cfg,
+    latent_dims)`` — the property the fabric's kill→resume bit-identity
+    rests on — and flat so the artifact needs no pytree bookkeeping
+    (``param_<name>`` carries each parameter with its leading lane
+    axis).  Runs the chunked early-exit drive, so a consumer stops
+    paying for an item's epochs the moment its lanes stop.
+    """
+    xs = jnp.asarray(panel, jnp.float32)
+    res, stats = sweep_autoencoders_chunked(key, xs, cfg, list(latent_dims))
+    out = {f"param_{k}": np.asarray(jax.device_get(v))
+           for k, v in sorted(res.params.items())}
+    out["stop_epoch"] = np.asarray(jax.device_get(res.stop_epoch))
+    out["train_loss"] = np.asarray(jax.device_get(res.train_loss))
+    out["val_loss"] = np.asarray(jax.device_get(res.val_loss))
+    out["chunks_dispatched"] = np.asarray(stats.chunks_dispatched)
+    return out
+
+
 def emit_chunk_stats(stats: Optional[ChunkStats]) -> None:
     """Publish a chunked drive's savings as obs gauges (no-op when
     telemetry is off or the drive ran monolithically)."""

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import random
 import signal
 import time
 from typing import Callable, Optional
@@ -90,13 +91,19 @@ def clear_plan() -> None:
 
 def active_plan() -> Optional[FaultPlan]:
     """The installed plan, else one parsed from ``HFREP_FAULTS`` (read
-    once per process — a plan's counters must persist across hooks)."""
+    once per process — a plan's counters must persist across hooks).
+
+    A spec that does not parse raises :class:`FaultSpecError` — and
+    keeps raising on every later call (the env read is only marked
+    consumed on success): a malformed plan must fail the drive loudly,
+    never silently disable the injection it was asked for.
+    """
     global _plan, _env_consumed
     if _plan is None and not _env_consumed:
-        _env_consumed = True
         spec = os.environ.get(ENV_FAULTS)
         if spec:
-            _plan = FaultPlan.parse(spec)
+            _plan = FaultPlan.parse(spec)      # FaultSpecError propagates
+        _env_consumed = True
     return _plan
 
 
@@ -143,7 +150,15 @@ def graceful_drain():
     again.  In a non-main thread ``signal.signal`` is unavailable —
     the drain flag still works via :func:`request_drain` and injected
     ``preempt`` faults, only the OS signal route is off.
+
+    Entry also resolves the ``HFREP_FAULTS`` plan eagerly: every long
+    drive (GAN trainer, chunked AE engine, multi-seed trainer, the
+    orchestration supervisor) enters through here, so a malformed spec
+    raises :class:`FaultSpecError` at the drive entry point — before any
+    work is paid for — instead of at whichever hook happens to fire
+    first deep inside the loop.
     """
+    active_plan()
     outermost = _DRAIN.depth == 0
     _DRAIN.depth += 1
     if outermost:
@@ -211,6 +226,16 @@ def post_save(site: str, path) -> None:
         plan.post_save(site, path)
 
 
+def actor_kill_point(site: str = "actor") -> bool:
+    """Fault-injection hook for the orchestration supervisor: True when
+    a ``kill@actor=N`` directive fires at this occurrence (one call per
+    newly observed queue item) — the supervisor then SIGKILLs the member
+    that produced the item.  The effect lives in the caller because only
+    the supervisor knows the actor pids."""
+    plan = active_plan()
+    return plan.actor(site) if plan is not None else False
+
+
 # ------------------------------------------------------------------ retry
 def io_attempts(default: int = 3) -> int:
     try:
@@ -219,17 +244,37 @@ def io_attempts(default: int = 3) -> int:
         return default
 
 
+def backoff_delay(attempt: int, base: float = 0.05, factor: float = 2.0,
+                  cap: float = 30.0,
+                  rng: Callable[[], float] = random.random) -> float:
+    """Full-jitter exponential backoff: uniform in
+    ``[0, min(cap, base * factor**attempt)]`` (``attempt`` 0-based).
+
+    The jitter is the point, not a refinement: a preemption or an EIO
+    burst hits every pod member at the same moment, and a deterministic
+    schedule would march all of them back onto the shared storage (or
+    the supervisor's restart path) in lockstep, re-creating the
+    contention that failed them.  ``rng`` is injectable so tests can pin
+    the bounds exactly (``rng=lambda: 1.0`` = the deterministic ceiling,
+    the pre-jitter behavior).
+    """
+    return min(cap, base * (factor ** attempt)) * rng()
+
+
 def retry_io(fn: Callable, *, what: str, attempts: Optional[int] = None,
              base_delay: float = 0.05, factor: float = 2.0,
-             sleep: Callable[[float], None] = time.sleep):
+             sleep: Callable[[float], None] = time.sleep,
+             rng: Callable[[], float] = random.random):
     """Run ``fn`` with a small bounded retry/backoff on ``OSError``.
 
     The policy for host-side I/O that must survive flaky storage
     (checkpoint saves, obs manifest writes): ``attempts`` tries total
-    (default 3, env override ``HFREP_IO_RETRIES``), exponential backoff
-    from ``base_delay``.  Each retry lands in the obs stream as an
-    ``io_retry`` event + ``resilience/io_retries`` counter; the final
-    failure propagates — bounded means bounded.
+    (default 3, env override ``HFREP_IO_RETRIES``), full-jitter
+    exponential backoff from ``base_delay`` (:func:`backoff_delay` — the
+    k-th retry sleeps uniform in ``[0, base_delay * factor**(k-1)]``).
+    Each retry lands in the obs stream as an ``io_retry`` event +
+    ``resilience/io_retries`` counter; the final failure propagates —
+    bounded means bounded.
     """
     attempts = attempts if attempts is not None else io_attempts()
     for attempt in range(1, attempts + 1):
@@ -238,7 +283,8 @@ def retry_io(fn: Callable, *, what: str, attempts: Optional[int] = None,
         except OSError as e:
             if attempt == attempts:
                 raise
-            delay = base_delay * (factor ** (attempt - 1))
+            delay = backoff_delay(attempt - 1, base=base_delay,
+                                  factor=factor, rng=rng)
             try:
                 from hfrep_tpu.obs import get_obs
                 obs = get_obs()

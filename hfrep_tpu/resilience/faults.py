@@ -20,15 +20,31 @@ Spec grammar (semicolon-separated directives)::
 kind     sites                 effect at the Nth occurrence
 ======== ===================== ==========================================
 sigterm  boundary (``chunk``,  a REAL ``os.kill(getpid(), SIGTERM)`` —
-         ``block``)            caught by the graceful-drain handler
-preempt  boundary              set the drain flag directly (no signal)
+         ``block``,            caught by the graceful-drain handler.
+         ``supervise``,        Also valid at io sites: the signal then
+         ``drain_barrier``)    lands DURING that host I/O call (e.g.
+         or io                 ``sigterm@snapshot_save=1`` = SIGTERM
+                               mid-way through the final drain snapshot)
+preempt  boundary or io        set the drain flag directly (no signal)
+stall    boundary              sleep :data:`STALL_SECS` at the boundary —
+                               a member that hangs instead of draining
+                               (drives the supervisor's drain-barrier
+                               timeout/escalation path)
 io_fail  io (``ckpt_save``,    raise ``OSError(EIO)`` from that I/O call
          ``snapshot_save``,
          ``obs_append``,
-         ``manifest``)
+         ``manifest``,
+         ``queue_put``,
+         ``queue_get``)
 torn     post-save (``ckpt``,  truncate the just-written payload — a
          ``snapshot``)         torn write that survived the process
 corrupt  post-save             flip bytes mid-payload (bit rot)
+kill     actor                 tell the orchestration supervisor to
+                               SIGKILL the actor behind the Nth observed
+                               queue item (:func:`FaultPlan.actor`
+                               returns True; the supervisor — the only
+                               caller that knows the pids — does the
+                               killing)
 ======== ===================== ==========================================
 
 Examples::
@@ -36,9 +52,12 @@ Examples::
     HFREP_FAULTS='sigterm@chunk=2'            # kill at the 2nd chunk boundary
     HFREP_FAULTS='io_fail@ckpt_save=1x2'      # first two save calls fail
     HFREP_FAULTS='torn@ckpt=3;preempt@block=5'
+    HFREP_FAULTS='kill@actor=2'               # SIGKILL the producer of the
+                                              # 2nd queue item the supervisor
+                                              # observes
 
 Occurrence counters live on the :class:`FaultPlan` instance, keyed by
-(kind group, site), so a plan's behavior is a pure function of the spec
+(hook group, site), so a plan's behavior is a pure function of the spec
 and the sequence of hook calls — no randomness, no wall clock.
 """
 
@@ -49,13 +68,21 @@ import errno
 import os
 import re
 import signal
+import time
 from pathlib import Path
 from typing import Dict, Iterable, Tuple
 
-BOUNDARY_KINDS = ("sigterm", "preempt")
+BOUNDARY_KINDS = ("sigterm", "preempt", "stall")
 IO_KINDS = ("io_fail",)
 POST_SAVE_KINDS = ("torn", "corrupt")
-KINDS = BOUNDARY_KINDS + IO_KINDS + POST_SAVE_KINDS
+ACTOR_KINDS = ("kill",)
+KINDS = BOUNDARY_KINDS + IO_KINDS + POST_SAVE_KINDS + ACTOR_KINDS
+
+#: how long an injected ``stall`` holds its boundary — long enough that
+#: any realistic drain-barrier timeout fires first (the stalled member is
+#: then escalated/SIGKILLed; it never wakes up to matter), short enough
+#: that a misconfigured test cannot hang CI forever
+STALL_SECS = 120.0
 
 _DIRECTIVE_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<site>[a-z_]+)=(?P<n>[0-9]+)(?:x(?P<count>[0-9]+))?$")
@@ -76,16 +103,8 @@ class Directive:
         return self.n <= occurrence < self.n + self.count
 
 
-def _group(kind: str) -> str:
-    if kind in BOUNDARY_KINDS:
-        return "boundary"
-    if kind in IO_KINDS:
-        return "io"
-    return "post_save"
-
-
 class FaultPlan:
-    """A parsed spec plus its per-(group, site) occurrence counters."""
+    """A parsed spec plus its per-(hook group, site) occurrence counters."""
 
     def __init__(self, directives: Iterable[Directive]):
         self.directives: Tuple[Directive, ...] = tuple(directives)
@@ -115,34 +134,70 @@ class FaultPlan:
         self._counts[key] = occ = self._counts.get(key, 0) + 1
         return occ
 
-    def _matching(self, group: str, site: str, occ: int):
+    def _matching(self, kinds: Tuple[str, ...], site: str, occ: int):
         for d in self.directives:
-            if d.site == site and _group(d.kind) == group and d.hits(occ):
+            if d.site == site and d.kind in kinds and d.hits(occ):
                 yield d
+
+    def _fire_signalish(self, d: Directive, site: str, occ: int) -> None:
+        """The sigterm/preempt/stall effects, shared by the boundary and
+        io hooks (a SIGTERM can land mid-I/O just as well as between
+        chunks — the drain-during-final-checkpoint scenario)."""
+        if d.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif d.kind == "stall":
+            time.sleep(STALL_SECS)
+        else:
+            from hfrep_tpu import resilience
+            resilience.request_drain(f"injected preempt@{site}={occ}")
 
     # ------------------------------------------------------------- hooks
     def boundary(self, site: str) -> None:
         """Called by the drives at each ``site`` boundary crossing."""
         occ = self._tick("boundary", site)
-        for d in self._matching("boundary", site, occ):
+        for d in self._matching(BOUNDARY_KINDS, site, occ):
             _note(d, occ)
-            if d.kind == "sigterm":
-                os.kill(os.getpid(), signal.SIGTERM)
-            else:
-                from hfrep_tpu import resilience
-                resilience.request_drain(f"injected preempt@{site}={occ}")
+            self._fire_signalish(d, site, occ)
 
     def io(self, site: str) -> None:
-        """Called just before a host-side I/O operation at ``site``."""
+        """Called just before a host-side I/O operation at ``site``.
+
+        ``io_fail`` raises the injected EIO; boundary kinds (``sigterm``
+        / ``preempt`` / ``stall``) fire here too — their occurrence is
+        counted against the SAME ("io", site) counter, so e.g.
+        ``sigterm@snapshot_save=1`` lands during the first snapshot
+        write of the process.
+        """
         occ = self._tick("io", site)
-        for d in self._matching("io", site, occ):
+        for d in self._matching(BOUNDARY_KINDS, site, occ):
+            _note(d, occ)
+            self._fire_signalish(d, site, occ)
+        for d in self._matching(IO_KINDS, site, occ):
             _note(d, occ)
             raise OSError(errno.EIO, f"injected io_fail@{site} (call {occ})")
+
+    def actor(self, site: str = "actor") -> bool:
+        """Called by the orchestration supervisor once per newly observed
+        queue item; True = a ``kill`` directive fired and the supervisor
+        should SIGKILL the actor that produced it (the effect lives in
+        the supervisor — only it knows the member pids).  Boundary kinds
+        fire here too: ``preempt@actor=N`` requests a pod drain at the
+        Nth observed item — a drain deterministically coupled to stream
+        progress rather than to supervision-loop timing."""
+        occ = self._tick("actor", site)
+        for d in self._matching(BOUNDARY_KINDS, site, occ):
+            _note(d, occ)
+            self._fire_signalish(d, site, occ)
+        fired = False
+        for d in self._matching(ACTOR_KINDS, site, occ):
+            _note(d, occ)
+            fired = True
+        return fired
 
     def post_save(self, site: str, path) -> None:
         """Called after a successful save of ``path`` — may damage it."""
         occ = self._tick("post_save", site)
-        for d in self._matching("post_save", site, occ):
+        for d in self._matching(POST_SAVE_KINDS, site, occ):
             _note(d, occ)
             target = _payload_file(Path(path))
             if target is None:

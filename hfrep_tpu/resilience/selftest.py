@@ -17,6 +17,21 @@ contracts hold bitwise:
    run (params, loss traces, stop epochs).
 3. **Kill→resume, multi-dataset sweep** — same contract for the fused
    (K+1)×L padded program, via the signal-free ``preempt`` injection.
+4. **Ensemble: SIGKILL one actor of a running fabric** — a real
+   multi-process pipeline (2 generator actors streaming into an AE
+   sweep consumer over the bounded spool queue,
+   :mod:`hfrep_tpu.orchestrate`); an injected ``kill@actor`` makes the
+   supervisor SIGKILL a generator mid-stream — REAL ``SIGKILL``, no
+   handler, no cleanup — and the run must still complete with every
+   artifact **bit-identical** to the undisturbed reference (computed
+   in-process from the same pure functions: the fabric's determinism
+   contract is that no interleaving, restart or kill can change a byte).
+5. **Ensemble: coordinated pod drain → resume** — an injected pod-wide
+   drain (``preempt@actor=2``: at the 2nd queue item observed) triggers
+   the supervisor's drain barrier; every member checkpoints at its item
+   boundary and the pipeline raises
+   :class:`~hfrep_tpu.resilience.Preempted`; the resumed pipeline
+   completes bit-identical to the reference.
 
 Exit 0 with one JSON line on stdout; any violated contract raises and
 exits 1.  Wired into ``tools/check.sh`` (env-stripped, CPU-pinned) next
@@ -132,6 +147,104 @@ def _kill_resume(td: str, name: str, spec: str, run) -> dict:
             f"{name}_lanes": int(stats.lanes)}
 
 
+def _ensemble_plan(out_dir: str):
+    """The tiny fixture pipeline shared by the ensemble scenarios: 2
+    generator actors × 2 blocks, 1 consumer, capacity-1 backpressure (so
+    a producer is reliably alive/blocked when the injected kill lands)."""
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec
+
+    rows, feats = 32, 4
+    cfg = AEConfig(n_factors=feats, latent_dim=2, epochs=6, batch_size=16,
+                   patience=2, seed=0, chunk_epochs=3)
+    sources = [SourceSpec(name=f"s{i}", mode="fixture",
+                          params={"rows": rows, "feats": feats})
+               for i in range(2)]
+    return PipelinePlan(out_dir=out_dir, sources=sources, blocks=2,
+                        consumers=1, capacity=1, ae_cfg=cfg,
+                        latent_dims=[1, 2], consume_mode="direct",
+                        stream_seed=11, drain_timeout=60.0, timeout=240.0)
+
+
+def _expected_digests(plan) -> dict:
+    """The undisturbed reference, computed IN-PROCESS with no actors:
+    every item is a pure function of (stream_seed, source, seq) and
+    every result a pure function of its item, so the expected artifact
+    digests follow from the same code the consumers run — the fabric
+    under injected kills must reproduce these bytes exactly."""
+    import hashlib
+    import io
+
+    import jax
+    from hfrep_tpu.orchestrate.actors import _fixture_panel
+    from hfrep_tpu.replication.engine import sweep_item_arrays
+    from hfrep_tpu.utils import checkpoint as ckpt_mod
+
+    out = {}
+    for idx, src in enumerate(plan.sources):
+        items = {}
+        for seq in range(plan.blocks):
+            panel = _fixture_panel(plan.stream_seed, idx, seq,
+                                   src.params["rows"], src.params["feats"])
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(plan.ae_cfg.seed),
+                                   idx), seq)
+            arrays = sweep_item_arrays(key, panel, plan.ae_cfg,
+                                       plan.latent_dims)
+            buf = io.BytesIO()
+            np.savez(buf, **arrays)
+            # the aggregate digest checkpoint.compute_checksum embeds in
+            # the artifact's meta.json (one payload file: sweep.npz)
+            items[f"{seq:05d}"] = ckpt_mod.aggregate_digest(
+                {"sweep.npz": hashlib.sha256(buf.getvalue()).hexdigest()})
+        out[src.name] = items
+    return out
+
+
+def _summary_digests(summary: dict) -> dict:
+    return {name: doc["items"] for name, doc in summary["sources"].items()}
+
+
+def _check_ensemble(td: str) -> dict:
+    import hfrep_tpu.resilience as res
+    from hfrep_tpu.orchestrate import run_pipeline
+
+    expected = _expected_digests(_ensemble_plan(os.path.join(td, "unused")))
+
+    # --- scenario 4: REAL SIGKILL of a generator actor mid-stream; the
+    # supervisor restarts it from its sub-block snapshot and the run
+    # completes bit-identical to the undisturbed reference
+    res.install_plan(res.FaultPlan.parse("kill@actor=1"))
+    try:
+        out = run_pipeline(_ensemble_plan(os.path.join(td, "ens_kill")))
+    finally:
+        res.clear_plan()
+    assert out["stats"]["restarts"] >= 1, \
+        "ensemble kill: the SIGKILL did not land on a live member"
+    assert _summary_digests(out["summary"]) == expected, \
+        "ensemble kill: artifacts differ from the undisturbed reference"
+
+    # --- scenario 5: pod-wide drain at the 2nd observed item → barrier
+    # (every member checkpoints at its item boundary) → resume completes
+    # bit-identical
+    drain_out = os.path.join(td, "ens_drain")
+    res.install_plan(res.FaultPlan.parse("preempt@actor=2"))
+    try:
+        run_pipeline(_ensemble_plan(drain_out))
+        raise AssertionError("ensemble drain: injected pod drain did not "
+                             "preempt the pipeline")
+    except res.Preempted:
+        pass
+    finally:
+        res.clear_plan()
+    resumed = run_pipeline(_ensemble_plan(drain_out), resume=True)
+    assert _summary_digests(resumed["summary"]) == expected, \
+        "ensemble drain: resumed artifacts differ from the reference"
+    return {"ensemble_kill": "ok",
+            "ensemble_kill_restarts": int(out["stats"]["restarts"]),
+            "ensemble_drain": "ok"}
+
+
 def run_selftest() -> dict:
     import dataclasses
 
@@ -166,6 +279,10 @@ def run_selftest() -> dict:
             td, "multi", "preempt@chunk=1",
             lambda rd: sweep_autoencoders_multi(key, stack, rows, mcfg,
                                                 [1, 2, 3], resume_dir=rd)))
+
+        # the async actor fabric: REAL SIGKILL of a running ensemble
+        # member + coordinated pod drain → resume, both bit-identical
+        doc.update(_check_ensemble(td))
     return doc
 
 

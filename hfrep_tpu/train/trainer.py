@@ -461,3 +461,21 @@ class GanTrainer:
             # global-array awareness
             out = jnp.asarray(jax.device_get(out))
         return out
+
+    def generate_block(self, seq: int, n_samples: int,
+                       stream_seed: int = 0,
+                       unscale: bool = True) -> jnp.ndarray:
+        """Actor-driven entry point: the ``seq``-th sample block of a
+        deterministic stream.
+
+        A generator actor in the orchestration fabric
+        (:mod:`hfrep_tpu.orchestrate`) streams blocks into the spool
+        queue by calling this with consecutive ``seq``; the key is
+        derived by folding ``seq`` into ``PRNGKey(stream_seed)``, so a
+        member restarted after SIGKILL regenerates exactly the block the
+        killed one would have delivered — the queue-level dedup and the
+        fabric's bit-identity contract both rest on that.  Distinct
+        sources use distinct ``stream_seed`` values.
+        """
+        key = jax.random.fold_in(jax.random.PRNGKey(stream_seed), seq)
+        return self.generate(key, n_samples, unscale=unscale)

@@ -63,6 +63,17 @@ def _ocp():
 
 
 # ---------------------------------------------------------------- checksum
+def aggregate_digest(file_digests: dict) -> str:
+    """The ``checksum["digest"]`` aggregate for a ``{relpath: sha256}``
+    map — THE format, exposed so callers that need to predict a written
+    artifact's digest without writing it (the resilience selftest's
+    in-process reference, ``tools/bench_async.py``) cannot drift from
+    the writer."""
+    return hashlib.sha256("\n".join(
+        f"{k}:{v}" for k, v in sorted(file_digests.items())).encode()
+    ).hexdigest()
+
+
 def compute_checksum(path) -> dict:
     """sha256 per payload file (sorted relative paths, ``meta.json``
     excluded) plus one aggregate digest over the file list."""
@@ -72,9 +83,8 @@ def compute_checksum(path) -> dict:
         if f.is_file() and f.name != META_NAME:
             files[f.relative_to(p).as_posix()] = hashlib.sha256(
                 f.read_bytes()).hexdigest()
-    agg = hashlib.sha256("\n".join(
-        f"{k}:{v}" for k, v in sorted(files.items())).encode()).hexdigest()
-    return {"algo": "sha256", "digest": agg, "files": files}
+    return {"algo": "sha256", "digest": aggregate_digest(files),
+            "files": files}
 
 
 def read_meta(path) -> Optional[dict]:

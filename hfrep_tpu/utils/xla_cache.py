@@ -1,0 +1,36 @@
+"""The one persistent-XLA-compilation-cache policy.
+
+Shared by the CLI entry points and the orchestration fabric's actor
+processes (a spawned member is a fresh interpreter — without the cache
+every consumer restart re-pays its AE chunk-program compile).  One
+implementation so the cache path/threshold cannot drift between the
+parent CLI process and fabric members.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache() -> None:
+    """Persist XLA compilations across processes (best-effort).
+
+    The sweep/train programs cost ~2 min of compiles per fresh process;
+    with the on-disk cache a repeat run on a directly-attached backend
+    skips them.  (On a tunneled backend compilation happens on the far
+    side, so the local cache cannot shortcut it — measured no-op there,
+    effective on standard CPU/TPU backends.)  Disable with
+    ``HFREP_COMPILATION_CACHE=''``.  Failures degrade to no cache — a
+    cache is an optimization, never a blocker.
+    """
+    cache = os.environ.get("HFREP_COMPILATION_CACHE",
+                           os.path.expanduser("~/.cache/hfrep_tpu_xla"))
+    if not cache:
+        return
+    try:
+        import jax
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (OSError, AttributeError):
+        pass

@@ -1,0 +1,524 @@
+"""Async actor fabric (ISSUE 7): spool-queue semantics (atomic items,
+dedup, claims, requeue, backpressure, gap detection), sub-block progress
+snapshots, full-jitter backoff bounds, supervisor restart/abort logic,
+loud malformed-``HFREP_FAULTS`` failure from every drive entry point,
+the second-SIGTERM-during-final-drain-checkpoint CLI contract, and the
+spawn-based ensemble paths (slow tier)."""
+
+import types
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+import hfrep_tpu.resilience as res
+from hfrep_tpu.config import AEConfig, ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.orchestrate import (
+    ActorSpec,
+    OrchestrationError,
+    PipelineStateError,
+    SpoolQueue,
+    Supervisor,
+)
+from hfrep_tpu.orchestrate import queue as q_mod
+from hfrep_tpu.orchestrate.actors import EXIT_GAP, _missing_results, result_name
+from hfrep_tpu.resilience import FaultPlan, FaultSpecError, Preempted, faults
+from hfrep_tpu.resilience.snapshot import ProgressSnapshot
+from hfrep_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _pristine_fault_state(monkeypatch):
+    """Every test starts with no plan and an unconsumed env read, and
+    leaks neither a plan nor a requested drain."""
+    res.clear_plan()
+    monkeypatch.setattr(res, "_env_consumed", False)
+    monkeypatch.delenv(res.ENV_FAULTS, raising=False)
+    yield
+    res.clear_plan()
+    res._DRAIN.requested = False
+    res._DRAIN.reason = None
+
+
+def _arrays(seed: int = 0):
+    g = np.random.default_rng(seed)
+    return {"panel": g.normal(size=(8, 3)).astype(np.float32)}
+
+
+# --------------------------------------------------------------- queue
+class TestSpoolQueue:
+    def test_put_claim_ack_roundtrip(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        assert q.put("s0", 0, _arrays(), extra_meta={"source_idx": 0})
+        assert q.depth() == 1
+        item = q.claim("consA")
+        assert item is not None
+        assert (item.source, item.seq) == ("s0", 0)
+        assert item.meta["source_idx"] == 0
+        # the digest rides inside the item: checksum over the payload
+        assert item.meta["checksum"]["files"]["payload.npz"]
+        np.testing.assert_array_equal(item.arrays()["panel"],
+                                      _arrays()["panel"])
+        q.ack(item)
+        assert q.depth() == 0 and not q.claimed_names()
+
+    def test_duplicate_put_is_skipped(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        assert q.put("s0", 1, _arrays())
+        assert not q.put("s0", 1, _arrays())        # still ready
+        item = q.claim("c")
+        assert not q.put("s0", 1, _arrays())        # claimed, still spooled
+        q.ack(item)
+        assert q.put("s0", 1, _arrays())            # acked: re-offer allowed
+
+    def test_claim_order_and_contention(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=8)
+        for seq in (1, 0, 2):
+            q.put("s0", seq, _arrays(seq))
+        a = q.claim("A")
+        b = q.claim("B")
+        assert (a.seq, b.seq) == (0, 1)             # sorted, no double-claim
+
+    def test_corrupt_item_discarded_on_claim(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        q.put("s0", 0, _arrays())
+        faults.corrupt_file(
+            tmp_path / q_mod.READY / q_mod.item_name("s0", 0) / "payload.npz")
+        assert q.claim("c") is None                 # discarded, not consumed
+        assert q.depth() == 0
+
+    def test_requeue_orphaned_claims(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        q.put("s0", 0, _arrays())
+        q.put("s0", 1, _arrays(1))
+        q.claim("dead")
+        q.claim("alive")
+        assert q.depth() == 0
+        moved = q.requeue_claims("dead")
+        assert moved == [q_mod.item_name("s0", 0)]
+        assert q.depth() == 1
+        # resume path: requeue EVERY claim (the whole pod died)
+        assert q.requeue_claims(None) == [q_mod.item_name("s0", 1)]
+        assert q.depth() == 2
+
+    def test_blocked_put_aborts_on_drain(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=1, poll=0.001)
+        q.put("s0", 0, _arrays())
+        res.request_drain("test")
+        with pytest.raises(Preempted) as ei:
+            q.put("s0", 1, _arrays(1))
+        assert ei.value.site == "queue_put"
+
+    def test_eof_and_drained(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        q.put("s0", 0, _arrays())
+        q.put_eof("s0", 1)
+        q.put_eof("s1", 0)
+        assert q.eof_counts() == {"s0": 1, "s1": 0}
+        assert not q.drained(["s0", "s1"])          # item still spooled
+        item = q.claim("c")
+        assert not q.drained(["s0", "s1"])          # claimed, in flight
+        q.ack(item)
+        assert q.drained(["s0", "s1"])
+        assert not q.drained(["s0", "s1", "s2"])    # s2 never finished
+
+    def test_gap_detection(self, tmp_path):
+        results = tmp_path / "results"
+        (results / result_name("s0", 0)).mkdir(parents=True)
+        (results / result_name("s0", 0) / ckpt.META_NAME).write_text("{}")
+        missing = _missing_results({"s0": 2, "s1": 1}, results)
+        assert missing == [result_name("s0", 1), result_name("s1", 0)]
+
+    def test_injected_queue_io_faults_bite(self, tmp_path):
+        q = SpoolQueue(tmp_path, capacity=4)
+        res.install_plan(FaultPlan.parse("io_fail@queue_get=1"))
+        with pytest.raises(OSError):
+            q.claim("c")
+        res.install_plan(FaultPlan.parse("io_fail@queue_put=1"))
+        # the put write path runs under the bounded retry policy, so a
+        # single injected EIO is retried and the item still lands
+        assert q.put("s0", 0, _arrays())
+
+    def test_item_name_roundtrip_and_foreign_names(self, tmp_path):
+        assert q_mod._parse_item_name(q_mod.item_name("a_b", 7)) == ("a_b", 7)
+        assert q_mod._parse_item_name("garbage") is None
+        q = SpoolQueue(tmp_path, capacity=4)
+        (q.ready / "not_an_item").mkdir()
+        assert q.depth() == 0 and q.claim("c") is None
+
+
+# --------------------------------------------------- progress snapshots
+class TestProgressSnapshot:
+    FP = {"source": "s0", "blocks": 4}
+
+    def test_roundtrip_and_clear(self, tmp_path):
+        snap = ProgressSnapshot(tmp_path, self.FP, name="gen_s0")
+        assert snap.load() is None
+        snap.save({"next": 2})
+        assert snap.load() == {"next": 2}
+        snap.save({"next": 3})
+        assert snap.load() == {"next": 3}
+        snap.clear()
+        assert snap.load() is None
+
+    def test_foreign_fingerprint_refused(self, tmp_path):
+        ProgressSnapshot(tmp_path, self.FP, name="g").save({"next": 1})
+        other = ProgressSnapshot(tmp_path, {"source": "s1", "blocks": 4},
+                                 name="g")
+        assert other.load() is None
+
+    def test_corrupt_falls_back_to_prev(self, tmp_path):
+        snap = ProgressSnapshot(tmp_path, self.FP, name="g")
+        snap.save({"next": 1})
+        snap.save({"next": 2})
+        faults.corrupt_file(snap.path / "progress.json")
+        # the live copy is damaged; the .prev sibling (previous boundary)
+        # still restores — a kill mid-overwrite costs one item
+        assert snap.load() == {"next": 1}
+
+
+# ------------------------------------------------- backoff (full jitter)
+class TestBackoffJitter:
+    def test_bounds_pinned(self):
+        # ceiling: rng=1 reproduces the deterministic schedule exactly
+        assert res.backoff_delay(0, base=0.1, rng=lambda: 1.0) == 0.1
+        assert res.backoff_delay(3, base=0.1, factor=2.0,
+                                 rng=lambda: 1.0) == pytest.approx(0.8)
+        # floor: full jitter reaches all the way down to zero
+        assert res.backoff_delay(5, base=0.1, rng=lambda: 0.0) == 0.0
+        # cap: the exponential never escapes the bound
+        assert res.backoff_delay(50, base=1.0, cap=7.5,
+                                 rng=lambda: 1.0) == 7.5
+
+    def test_default_rng_samples_stay_in_bounds_and_spread(self):
+        caps = [min(30.0, 0.05 * 2.0 ** k) for k in range(6)]
+        samples = {k: [res.backoff_delay(k) for _ in range(200)]
+                   for k in range(6)}
+        for k, cap in enumerate(caps):
+            assert all(0.0 <= s <= cap for s in samples[k])
+        # jitter exists: pod members must not share a schedule
+        assert len({round(s, 12) for s in samples[5]}) > 100
+
+    def test_retry_io_backoff_is_jittered_within_bounds(self, tmp_path):
+        res.install_plan(FaultPlan.parse("io_fail@manifest=1x3"))
+        sleeps = []
+        res.retry_io(lambda: res.io_point("manifest"), what="manifest",
+                     attempts=4, base_delay=0.1, sleep=sleeps.append,
+                     rng=lambda: 0.5)
+        # retry k sleeps uniform·(base·factor^(k-1)): rng=0.5 pins it
+        assert sleeps == pytest.approx([0.05, 0.1, 0.2])
+
+
+# ------------------------------------------------ supervisor (spawn-free)
+def _dummy_specs(n_consumers: int = 1):
+    return [ActorSpec(name="gen_s0", role="generator",
+                      payload={"source": "s0"})] + [
+        ActorSpec(name=f"cons{c}", role="consumer", payload={})
+        for c in range(n_consumers)]
+
+
+def _fake_proc(exitcode):
+    return types.SimpleNamespace(
+        is_alive=lambda: False, exitcode=exitcode, pid=4242,
+        kill=lambda: None, join=lambda timeout=None: None)
+
+
+class TestSupervisorLogic:
+    def _sup(self, tmp_path, **kw):
+        # rng pinned to the ceiling: scheduled restarts stay comfortably
+        # in the future, so no real process is ever spawned here
+        kw.setdefault("backoff_rng", lambda: 1.0)
+        kw.setdefault("backoff_base", 30.0)
+        return Supervisor(_dummy_specs(), SpoolQueue(tmp_path / "q"), **kw)
+
+    def test_crash_schedules_jittered_restart_and_requeues(self, tmp_path):
+        sup = self._sup(tmp_path)
+        q = sup.queue
+        q.put("s0", 0, _arrays())
+        q.claim("cons0")                       # the dead consumer's claim
+        m = sup._members["cons0"]
+        m.proc = _fake_proc(-9)                # SIGKILLed
+        sup._poll_members()
+        assert m.restarts == 1 and sup.total_restarts == 1
+        assert m.restart_at is not None
+        assert q.depth() == 1                  # claim requeued before restart
+
+    def test_restart_budget_exhaustion_raises(self, tmp_path):
+        sup = self._sup(tmp_path)
+        m = sup._members["gen_s0"]
+        m.spec.max_restarts = 2
+        for _ in range(2):
+            m.proc = _fake_proc(1)
+            sup._poll_members()
+            m.restart_at = None                # pretend the restart ran
+        m.proc = _fake_proc(1)
+        with pytest.raises(OrchestrationError, match="restart budget"):
+            sup._poll_members()
+
+    def test_gap_exit_aborts_the_run(self, tmp_path):
+        sup = self._sup(tmp_path)
+        sup._members["cons0"].proc = _fake_proc(EXIT_GAP)
+        with pytest.raises(OrchestrationError, match="gap"):
+            sup._poll_members()
+
+    def test_clean_and_drained_exits_mark_members(self, tmp_path):
+        sup = self._sup(tmp_path)
+        sup._members["gen_s0"].proc = _fake_proc(0)
+        sup._members["cons0"].proc = _fake_proc(75)
+        sup._poll_members(draining=True)
+        assert sup._members["gen_s0"].done
+        assert sup._members["cons0"].drained
+
+    def test_duplicate_actor_names_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="duplicate"):
+            Supervisor([ActorSpec("a", "consumer", {}),
+                        ActorSpec("a", "generator", {})],
+                       SpoolQueue(tmp_path / "q"))
+
+    def test_kill_directive_fires_on_observed_item(self, tmp_path):
+        res.install_plan(FaultPlan.parse("kill@actor=2"))
+        sup = self._sup(tmp_path)
+        killed = []
+        m = sup._members["gen_s0"]
+        m.proc = types.SimpleNamespace(
+            is_alive=lambda: True, pid=4242, exitcode=None,
+            kill=lambda: killed.append("gen_s0"),
+            join=lambda timeout=None: None)
+        sup.queue.put("s0", 0, _arrays())
+        sup._observe_items()                   # occurrence 1: no fire
+        assert killed == []
+        sup.queue.put("s0", 1, _arrays(1))
+        sup._observe_items()                   # occurrence 2: SIGKILL
+        assert killed == ["gen_s0"]
+
+
+# ------------------------- malformed HFREP_FAULTS: loud per entry drive
+MCFG = ModelConfig(family="wgan_gp", window=8, features=5, hidden=8)
+TCFG = TrainConfig(epochs=4, batch_size=8, n_critic=1, steps_per_call=2,
+                   log_every=100)
+
+
+class TestMalformedSpecRaisesPerDrive:
+    """A malformed spec must abort every drive at its entry point —
+    never be swallowed into silently-disabled injection (the PR-5 obs
+    sink only narrowed its own ImportError path)."""
+
+    @pytest.fixture(autouse=True)
+    def _bad_spec(self, monkeypatch):
+        monkeypatch.setenv(res.ENV_FAULTS, "totally@@broken")
+        monkeypatch.setattr(res, "_plan", None)
+        monkeypatch.setattr(res, "_env_consumed", False)
+
+    def test_gan_trainer_drive(self, rng):
+        from hfrep_tpu.train.trainer import GanTrainer
+        windows = jnp.asarray(rng.normal(size=(16, 8, 5)).astype(np.float32))
+        tr = GanTrainer(ExperimentConfig(model=MCFG, train=TCFG), windows)
+        with pytest.raises(FaultSpecError):
+            tr.train()
+
+    def test_chunked_ae_drive(self):
+        from hfrep_tpu.replication.engine import train_autoencoder_chunked
+        cfg = AEConfig(n_factors=4, latent_dim=2, epochs=8, batch_size=16,
+                       patience=2, chunk_epochs=4)
+        xs = jnp.asarray(np.random.default_rng(0).normal(
+            size=(24, 4)).astype(np.float32))
+        with pytest.raises(FaultSpecError):
+            train_autoencoder_chunked(jax.random.PRNGKey(0), xs, cfg)
+
+    def test_multi_seed_drive(self, rng):
+        from hfrep_tpu.train.multi_seed import MultiSeedTrainer
+        windows = jnp.asarray(rng.normal(size=(16, 8, 5)).astype(np.float32))
+        tr = MultiSeedTrainer(ExperimentConfig(model=MCFG, train=TCFG),
+                              windows, seeds=(0, 1))
+        with pytest.raises(FaultSpecError):
+            tr.train()
+
+    def test_supervisor_drive(self, tmp_path):
+        sup = Supervisor([], SpoolQueue(tmp_path / "q"))
+        with pytest.raises(FaultSpecError):
+            sup.run()
+
+
+# --------------------------------------- CLI: second SIGTERM mid-drain
+def _write_cleaned_fixture(d: Path, months: int = 96) -> None:
+    """A fabricated cleaned_data/ directory shaped like the real one
+    (22 factors, 13 HF indices, 1 rf, Date index)."""
+    from hfrep_tpu.core.data import dic_save
+
+    d.mkdir(parents=True, exist_ok=True)
+    g = np.random.default_rng(5)
+    dates = pd.date_range("2000-01-31", periods=months, freq="ME")
+    fac = [f"F{j}" for j in range(22)]
+    hf = [f"H{j}" for j in range(13)]
+    mix = g.normal(size=(22, 13)) * 0.3
+    x = g.normal(0, 0.03, (months, 22))
+    y = x @ mix + g.normal(0, 0.01, (months, 13))
+    for name, cols, vals in (
+            ("factor_etf_data.csv", fac, x),
+            ("hfd.csv", hf, y),
+            ("rf.csv", ["RF"], np.abs(g.normal(0.002, 5e-4, (months, 1))))):
+        df = pd.DataFrame(vals.astype(np.float32), columns=cols)
+        df.insert(0, "Date", dates)
+        df.to_csv(d / name, index=False)
+    dic_save({c: c for c in hf}, d / "hfd_fullname.pkl")
+    dic_save({c: c for c in fac}, d / "factor_etf_name.pkl")
+
+
+@pytest.fixture(scope="module")
+def cleaned_fixture(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cleaned") / "cleaned_data"
+    _write_cleaned_fixture(d)
+    return str(d)
+
+
+class TestCliSecondSigtermDuringDrain:
+    def _sweep(self, cleaned, out):
+        from hfrep_tpu.experiments.cli import main
+        return main(["sweep", "--cleaned-dir", cleaned, "--latents", "1:2",
+                     "--epochs", "6", "--chunk-epochs", "3",
+                     "--out", out, "--resume"])
+
+    def test_sigterm_during_final_drain_checkpoint_twice(
+            self, cleaned_fixture, tmp_path, monkeypatch):
+        """First SIGTERM lands DURING a snapshot save (which thereby
+        becomes the final drain checkpoint); the resumed run takes a
+        second SIGTERM during ITS final snapshot save.  Both exit 75
+        with a restorable snapshot; the third run completes and matches
+        an undisturbed sweep bit-for-bit."""
+        base_out = tmp_path / "base"
+        assert self._sweep(cleaned_fixture, str(base_out)) == 0
+
+        out = tmp_path / "drained"
+        # occurrences accumulate in-process: save #1 (run 1) and save #2
+        # (the resumed run's first boundary) each take a SIGTERM mid-write
+        monkeypatch.setenv(res.ENV_FAULTS, "sigterm@snapshot_save=1x2")
+        monkeypatch.setattr(res, "_plan", None)
+        monkeypatch.setattr(res, "_env_consumed", False)
+        assert self._sweep(cleaned_fixture, str(out)) == 75
+        snap = out / "_resume" / "chunk_snapshot"
+        assert (snap / ckpt.META_NAME).exists(), \
+            "drained run must leave a restorable snapshot"
+        assert self._sweep(cleaned_fixture, str(out)) == 75
+        assert (snap / ckpt.META_NAME).exists(), \
+            "second SIGTERM mid-checkpoint must still leave a snapshot"
+
+        assert self._sweep(cleaned_fixture, str(out)) == 0
+        assert not snap.exists()               # cleared after completion
+        for f in ("post.npy", "ante.npy", "fit_metrics.csv"):
+            assert (out / f).read_bytes() == (base_out / f).read_bytes(), \
+                f"{f} differs from the undisturbed sweep"
+
+
+# ------------------------------------------ pipeline state (spawn-free)
+class TestPipelineState:
+    def test_fresh_run_refuses_leftover_results(self, tmp_path):
+        from hfrep_tpu.orchestrate import run_pipeline
+        plan = _tiny_plan(tmp_path / "p")
+        rd = Path(plan.out_dir) / "results" / result_name("s0", 0)
+        rd.mkdir(parents=True)
+        with pytest.raises(PipelineStateError, match="previous pipeline"):
+            run_pipeline(plan)            # refused before any member spawns
+
+    def test_plan_marker_refuses_foreign_plan(self, tmp_path):
+        from hfrep_tpu.orchestrate import pipeline as pl
+        plan_a = _tiny_plan(tmp_path / "p")
+        paths = pl._paths(plan_a)
+        paths["results"].mkdir(parents=True)
+        pl._check_plan_marker(plan_a, paths)
+        pl._check_plan_marker(plan_a, paths)       # same plan: idempotent
+        plan_b = _tiny_plan(tmp_path / "p", stream_seed=99)
+        # resuming artifacts produced by a different stream would
+        # assemble the OLD bytes under the new plan's name
+        with pytest.raises(PipelineStateError, match="DIFFERENT"):
+            pl._check_plan_marker(plan_b, paths)
+
+    def test_resume_heals_corrupt_result_and_replays_block(self, tmp_path):
+        from hfrep_tpu.orchestrate import pipeline as pl
+        from hfrep_tpu.resilience.snapshot import ProgressSnapshot
+        plan = _tiny_plan(tmp_path / "p")
+        paths = pl._paths(plan)
+        for key in ("queue", "snapshots", "results"):
+            paths[key].mkdir(parents=True)
+        def writer(tmp):
+            (tmp / "sweep.npz").write_bytes(b"x" * 64)
+
+        for seq in range(plan.blocks):
+            ckpt.write_atomic(paths["results"] / result_name("s0", seq),
+                              writer, metadata={"source": "s0", "seq": seq})
+        faults.corrupt_file(
+            paths["results"] / result_name("s0", 1) / "sweep.npz")
+        snap = ProgressSnapshot(paths["snapshots"], fingerprint={},
+                                name="gen_s0")
+        snap.save({"next": plan.blocks, "eof": True})
+        queue = SpoolQueue(paths["queue"], capacity=2)
+        queue.put_eof("s0", plan.blocks)
+
+        healed = pl._heal_corrupt_results(plan, paths, queue)
+        assert healed == [result_name("s0", 1)]
+        assert not (paths["results"] / result_name("s0", 1)).exists()
+        assert (paths["results"] / result_name("s0", 0)).exists()
+        # the block replays: producer snapshot and eof marker are gone,
+        # so the restarted stream re-delivers and recomputes the gap
+        assert snap.load() is None
+        assert queue.eof_counts() == {}
+
+
+# ----------------------------------------------- spawn-based (slow tier)
+def _tiny_plan(out_dir, **kw):
+    from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=6, batch_size=16,
+                   patience=2, seed=0, chunk_epochs=3)
+    defaults = dict(
+        out_dir=str(out_dir),
+        sources=[SourceSpec(name="s0", mode="fixture",
+                            params={"rows": 32, "feats": 4})],
+        blocks=2, consumers=1, capacity=1, ae_cfg=cfg, latent_dims=[1, 2],
+        consume_mode="direct", stream_seed=7, drain_timeout=8.0,
+        timeout=180.0)
+    defaults.update(kw)
+    return PipelinePlan(**defaults)
+
+
+@pytest.mark.slow
+class TestPipelineSpawned:
+    def test_refuses_dirty_work_dir_without_resume(self, tmp_path):
+        from hfrep_tpu.orchestrate import run_pipeline
+        plan = _tiny_plan(tmp_path / "p")
+        (Path(plan.out_dir) / "_work").mkdir(parents=True)
+        with pytest.raises(PipelineStateError, match="resume"):
+            run_pipeline(plan)
+
+    def test_stalled_member_escalated_at_drain_barrier(self, tmp_path):
+        """A member that hangs instead of draining (injected
+        ``stall@drain_barrier``) must not wedge the pod: the barrier
+        times out, the straggler is SIGKILLed, the pipeline still exits
+        preempted, and the resume completes bit-identically."""
+        from hfrep_tpu.orchestrate import run_pipeline
+        from hfrep_tpu.orchestrate.pipeline import (
+            SpoolQueue as _SQ,
+            _actor_specs,
+            _paths,
+        )
+        plan = _tiny_plan(tmp_path / "p")
+        paths = _paths(plan)
+        for key in ("queue", "snapshots", "results"):
+            paths[key].mkdir(parents=True, exist_ok=True)
+        specs = _actor_specs(plan, paths, None)
+        for s in specs:
+            if s.role == "generator":
+                s.env = {res.ENV_FAULTS: "stall@drain_barrier=1"}
+        queue = _SQ(paths["queue"], capacity=plan.capacity)
+        sup = Supervisor(specs, queue, drain_timeout=plan.drain_timeout,
+                         timeout=plan.timeout)
+        res.install_plan(FaultPlan.parse("preempt@actor=1"))
+        try:
+            with pytest.raises(Preempted, match="escalated"):
+                sup.run()
+        finally:
+            res.clear_plan()
+        out = run_pipeline(plan, resume=True)
+        assert out["stats"]["restarts"] == 0
+        assert sorted(out["summary"]["sources"]) == ["s0"]

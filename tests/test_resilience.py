@@ -550,3 +550,8 @@ def test_resilience_selftest_smoke():
     assert doc["selftest"] == "ok"
     assert doc["lanes21"] == "ok" and doc["multi"] == "ok"
     assert doc["lanes21_lanes"] == 21
+    # the async-fabric ensemble scenarios: REAL SIGKILL of a running
+    # member (supervisor restart, bit-identical artifacts) + pod drain
+    # barrier → resume bit-identical
+    assert doc["ensemble_kill"] == "ok" and doc["ensemble_drain"] == "ok"
+    assert doc["ensemble_kill_restarts"] >= 1

@@ -1,0 +1,206 @@
+"""Async actor fabric probe: overlapped vs sequential pipeline wall clock.
+
+The paper's pipeline runs GAN synthesis and the AE replication sweep as
+two serialized phases; :mod:`hfrep_tpu.orchestrate` decouples them into
+generator and consumer actor pools over a bounded queue, so the phases
+overlap (arxiv 2111.04628's producer/consumer split under arxiv
+2104.06272's supervision).  This probe measures what the overlap buys —
+and what the fabric costs — on this host:
+
+* **sequential** — generate every item, then sweep every item, one
+  process, phases serialized (the pre-fabric drive; warmed program, so
+  compile is excluded like every bench here);
+* **overlapped** — the same items through :func:`~hfrep_tpu.orchestrate.
+  run_pipeline` (2 generator actors + consumer actors over the spool
+  queue).  The pipeline time INCLUDES member spawn and any cold child
+  compile — the honest price of the fabric; the persistent compilation
+  cache amortizes the compile across invocations.
+
+Generator latency is modeled with a deterministic per-item delay
+(``gen_delay`` — the fixture source's stand-in for real GAN sampling
+cost, which on an accelerator runs concurrently with consumer training).
+The overlap win scales with it: serial pays ``sum(gen) + sum(sweep)``,
+the fabric pays ``~max(sum(gen)/P, sum(sweep)/C)`` + orchestration
+overhead.  At self-test shapes the spawn overhead can dominate — the
+SELF-CHECK therefore asserts *correctness* (the fabric's artifacts are
+bit-identical to the sequential reference — the determinism contract)
+and completion, and reports the speedup un-asserted.
+
+Prints ONE JSON line.  Exit 0 = self-check passed, 1 = check or history
+regression, 2 = tooling failure.  With ``HFREP_OBS_DIR`` the
+measurements land as ``bench`` spans + ``bench/async_*`` gauges and gate
+against the rolling history baseline exactly like ``bench_ae.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+if __name__ == "__main__":                     # `python tools/bench_async.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec, run_pipeline
+from hfrep_tpu.orchestrate.actors import _fixture_panel
+from hfrep_tpu.replication.engine import sweep_item_arrays
+from hfrep_tpu.utils import checkpoint as ckpt
+
+
+def _plan(out_dir: str, self_test: bool) -> PipelinePlan:
+    if self_test:
+        rows, feats, latents = 32, 4, [1, 2]
+        epochs, chunk, blocks, consumers, delay = 6, 3, 2, 1, 0.15
+    else:
+        rows, feats, latents = 120, 16, list(range(1, 9))
+        epochs, chunk, blocks, consumers, delay = 120, 30, 4, 2, 0.5
+    cfg = AEConfig(n_factors=feats, latent_dim=max(latents), epochs=epochs,
+                   batch_size=16 if self_test else 48, patience=3, seed=0,
+                   chunk_epochs=chunk)
+    sources = [SourceSpec(name=f"b{i}", mode="fixture",
+                          params={"rows": rows, "feats": feats,
+                                  "gen_delay": delay})
+               for i in range(2)]
+    return PipelinePlan(out_dir=out_dir, sources=sources, blocks=blocks,
+                        consumers=consumers, capacity=2, ae_cfg=cfg,
+                        latent_dims=latents, consume_mode="direct",
+                        stream_seed=3, timeout=600.0)
+
+
+def _item_delay(plan: PipelinePlan) -> float:
+    return float(plan.sources[0].params.get("gen_delay", 0.0))
+
+
+def _sequential(plan: PipelinePlan):
+    """Phase-serialized reference: all generation, then all sweeps.
+    Returns (wall_secs, {source: {seq: aggregate_digest}}) — the digests
+    in the exact format the fabric's artifact checksums use, so the two
+    paths are byte-comparable."""
+    import jax
+
+    delay = _item_delay(plan)
+    items = []
+    # warm the sweep program so the sequential side excludes compile
+    warm_key = jax.random.PRNGKey(plan.ae_cfg.seed)
+    warm_panel = _fixture_panel(plan.stream_seed, 0, 0,
+                                plan.sources[0].params["rows"],
+                                plan.sources[0].params["feats"])
+    sweep_item_arrays(warm_key, warm_panel, plan.ae_cfg, plan.latent_dims)
+
+    t0 = time.perf_counter()
+    for idx, src in enumerate(plan.sources):      # phase 1: generation
+        for seq in range(plan.blocks):
+            if delay > 0.0:
+                time.sleep(delay)
+            items.append((idx, src.name, seq, _fixture_panel(
+                plan.stream_seed, idx, seq, src.params["rows"],
+                src.params["feats"])))
+    digests: dict = {src.name: {} for src in plan.sources}
+    for idx, name, seq, panel in items:           # phase 2: sweeps
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(plan.ae_cfg.seed), idx),
+            seq)
+        arrays = sweep_item_arrays(key, panel, plan.ae_cfg,
+                                   plan.latent_dims)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        digests[name][f"{seq:05d}"] = ckpt.aggregate_digest(
+            {"sweep.npz": hashlib.sha256(buf.getvalue()).hexdigest()})
+    return time.perf_counter() - t0, digests
+
+
+def run_probe(obs, self_test: bool) -> int:
+    with tempfile.TemporaryDirectory(prefix="hfrep_bench_async_") as td:
+        plan = _plan(os.path.join(td, "pipe"), self_test)
+        obs.annotate(config={
+            "model": {"family": "async_pipeline",
+                      "window": plan.sources[0].params["rows"],
+                      "features": plan.sources[0].params["feats"],
+                      "hidden": max(plan.latent_dims)},
+            "train": {"batch_size": plan.ae_cfg.batch_size}})
+
+        seq_s, seq_digests = _sequential(plan)
+
+        t0 = time.perf_counter()
+        out = run_pipeline(plan)
+        pipe_s = time.perf_counter() - t0
+        pipe_digests = {name: doc["items"]
+                        for name, doc in out["summary"]["sources"].items()}
+
+        n_items = len(plan.sources) * plan.blocks
+        obs.record_span("bench", seq_s, steps=n_items, synced=True,
+                        config="async_sequential")
+        obs.record_span("bench", pipe_s, steps=n_items, synced=True,
+                        config="async_overlapped")
+        speedup = seq_s / pipe_s if pipe_s > 0 else float("inf")
+
+        problems = []
+        if pipe_digests != seq_digests:
+            problems.append("fabric artifacts differ from the sequential "
+                            "reference (determinism contract broken)")
+        if out["stats"]["restarts"] != 0:
+            problems.append(f"unexpected member restarts: "
+                            f"{out['stats']['restarts']}")
+
+        print(json.dumps({
+            "metric": "async_overlap_speedup",
+            "value": round(speedup, 3),
+            "unit": "x",
+            "sequential_s": round(seq_s, 4),
+            "overlapped_s": round(pipe_s, 4),
+            "items": n_items,
+            "gen_delay_s": _item_delay(plan),
+            "sources": len(plan.sources),
+            "consumers": plan.consumers,
+            "self_check": "ok" if not problems else "; ".join(problems),
+            "self_test": bool(self_test),
+        }))
+
+        for name, value in (("async_overlap_speedup", speedup),
+                            ("async_sequential_s", seq_s),
+                            ("async_overlapped_s", pipe_s)):
+            if np.isfinite(value):
+                obs.gauge(f"bench/{name}").set(float(value))
+        obs.memory_snapshot(phase="bench_async_end")
+
+        if problems:
+            print(f"bench_async: SELF-CHECK FAILED: {'; '.join(problems)}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_async",
+        description="async actor fabric overlap probe (orchestrate/)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="tiny shapes: bit-identity + completion checks "
+                         "in under a minute on CPU")
+    args = ap.parse_args(argv)
+
+    obs_dir = os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session_or_off(obs_dir, "bench_async",
+                                command="bench_async") as obs:
+        if obs_dir and not obs.enabled:
+            obs_dir = None                 # degraded: nothing to gate below
+        rc = run_probe(obs, args.self_test)
+    from hfrep_tpu.obs import history as hist_mod
+    hist = hist_mod.resolve_history(obs_dir)
+    if obs_dir and hist:
+        rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

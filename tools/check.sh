@@ -32,10 +32,14 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
     python tools/bench_ae.py --self-test 1>&2
 # resilience gate: kill→resume bit-identical (REAL SIGTERM through the
 # graceful-drain handler, 21-lane + multi-dataset AE sweeps at fixture
-# shapes) and corrupt/torn-checkpoint → fallback-to-previous-good.
-# CPU-pinned and env-stripped like the bench self-test: ambient
-# HFREP_OBS_DIR/HFREP_HISTORY must not pollute the committed history
-# store, and an ambient HFREP_FAULTS plan must not fire inside the gate.
+# shapes), corrupt/torn-checkpoint → fallback-to-previous-good, and the
+# async-fabric ensemble scenarios (hfrep_tpu/orchestrate): REAL SIGKILL
+# of one generator actor of a running pipeline → supervisor restart from
+# its sub-block snapshot → artifacts bit-identical; pod-wide drain
+# barrier → pipeline resume bit-identical.  CPU-pinned and env-stripped
+# like the bench self-test: ambient HFREP_OBS_DIR/HFREP_HISTORY must not
+# pollute the committed history store, and an ambient HFREP_FAULTS plan
+# must not fire inside the gate.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
     python -m hfrep_tpu.resilience selftest 1>&2
 # mixed-precision gate: the production Policy path end to end at fixture
