@@ -8,6 +8,10 @@ notebook cells, SURVEY §5.6); these subcommands cover the full pipeline:
     eval-gan    12-metric eval of a saved sample cube vs real windows
     sweep       latent-dim sweep (real-only, or GAN-augmented via
                 --gan-checkpoint), tables + summary + plots
+    pipeline    async actor fabric: GAN synthesis → AE sweep consumers
+    serve       replication-as-a-service drill: AOT-compiled serving
+                behind deadline batching + admission control (exit 75
+                on SIGTERM drain)
 """
 
 from __future__ import annotations
@@ -241,6 +245,53 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="telemetry run dir: actor lifecycle events, "
                          "queue depth gauge, restart counters (each actor "
                          "additionally streams into <dir>/actors/<name>)")
+
+    sv = sub.add_parser(
+        "serve",
+        help="replication-as-a-service drill: the trained AE head (and "
+             "optionally a GAN generator) AOT-compiled behind deadline "
+             "micro-batching, admission control and a circuit breaker; "
+             "drives simulated query load against the envelope and "
+             "reports every request's typed terminal outcome.  SIGTERM "
+             "drains gracefully (stop admitting, flush in-flight) and "
+             "exits 75 like every drive in the repo")
+    sv.add_argument("--requests", type=int, default=2000,
+                    help="simulated queries to offer")
+    sv.add_argument("--wave", type=int, default=256,
+                    help="queries offered per wave; the drain flag is "
+                         "polled between waves")
+    sv.add_argument("--timeout-ms", type=float, default=None,
+                    help="per-request deadline (default: the envelope's "
+                         "request_timeout_ms); requests still queued at "
+                         "expiry are cancelled AT the batcher, typed")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="requests per dispatched program")
+    sv.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="micro-batch accumulation deadline: dispatch at "
+                         "--max-batch or after this window, whichever "
+                         "comes first")
+    sv.add_argument("--max-queue", type=int, default=256,
+                    help="admission bound: beyond this many queued "
+                         "requests, submits shed immediately with a "
+                         "typed Overloaded rejection")
+    sv.add_argument("--workers", type=int, default=2,
+                    help="dispatch worker threads")
+    sv.add_argument("--fixture-feats", type=int, default=16,
+                    help="width of the fixture replication head (trained "
+                         "in-process at startup; no cleaned data needed)")
+    sv.add_argument("--sample-every", type=int, default=0,
+                    help="every Nth query samples the generator instead "
+                         "of replicating (needs --gan-checkpoint)")
+    sv.add_argument("--gan-checkpoint", default=None,
+                    help="also serve `sample` queries from this trained "
+                         "generator checkpoint")
+    sv.add_argument("--preset", default="mtss_wgan_gp_prod",
+                    help="preset the --gan-checkpoint was trained with")
+    sv.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    sv.add_argument("--obs-dir", default=None,
+                    help="telemetry run dir: serve_admit/shed/"
+                         "deadline_miss/breaker events, serve/* gauges "
+                         "(qps, p50/p95, shed rate, queue depth)")
 
     h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
                                          "into an inverse-scaled cube (.npy)")
@@ -731,6 +782,98 @@ def _cmd_pipeline_impl(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu.resilience import Preempted
+    obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session(obs_dir, command="serve"):
+        try:
+            return _cmd_serve_impl(args)
+        except Preempted as e:
+            # graceful drain: admission stopped, in-flight flushed, every
+            # request reached a typed terminal outcome; 75 = EX_TEMPFAIL
+            print(f"preempted: {e}", file=sys.stderr)
+            return 75
+
+
+def _cmd_serve_impl(args) -> int:
+    from hfrep_tpu import resilience
+    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.serve.fixture import fixture_server, warm_server
+    from hfrep_tpu.serve.loadgen import drive_load, make_panels
+    from hfrep_tpu.serve.server import ServeConfig
+
+    gen_model = None
+    if args.sample_every and not args.gan_checkpoint:
+        raise SystemExit("--sample-every needs --gan-checkpoint")
+    if args.gan_checkpoint:
+        from hfrep_tpu.serve.aot import GenServeModel
+        trainer, _, _, cfg = _make_trainer(args.preset, args.cleaned_dir,
+                                           quiet=True)
+        trainer.restore_checkpoint(args.gan_checkpoint)
+        gen_model = GenServeModel.create(cfg.model, trainer.state.g_params)
+
+    scfg = ServeConfig(max_batch=args.max_batch,
+                       batch_window_ms=args.batch_window_ms,
+                       max_queue=args.max_queue, workers=args.workers,
+                       # the drill's panel pool tops out at 96 rows; a
+                       # tighter ladder keeps the warmed grid (and
+                       # startup) small
+                       row_buckets=(32, 64, 128))
+    timeout_ms = (args.timeout_ms if args.timeout_ms is not None
+                  else scfg.request_timeout_ms)
+    obs = get_obs()
+    obs.annotate(config={"serve": {"max_batch": scfg.max_batch,
+                                   "deadline_ms": timeout_ms,
+                                   "max_queue": scfg.max_queue,
+                                   "workers": scfg.workers}})
+    panels = make_panels(23, args.fixture_feats, (32, 64, 96),
+                         variants=8)
+    with resilience.graceful_drain():
+        server = fixture_server(scfg, feats=args.fixture_feats,
+                                gen_model=gen_model)
+        try:
+            n_programs = warm_server(server, panels)
+            print(f"serving: {n_programs} AOT programs resident "
+                  f"(export={'on' if server.cfg.via_export else 'off'}); "
+                  f"offering {args.requests} queries "
+                  f"(deadline {timeout_ms:.0f}ms)", file=sys.stderr)
+
+            def on_wave(done: int) -> None:
+                if resilience.drain_requested():
+                    doc = server.drain(reason="SIGTERM", timeout=30.0)
+                    print(json.dumps({"drained": doc,
+                                      "stats": server.stats()},
+                                     indent=2, default=str))
+                    raise resilience.Preempted(
+                        site="serve", reason="drain requested",
+                        epoch=done)
+
+            report = drive_load(server, args.requests, panels,
+                                timeout_ms=timeout_ms,
+                                sample_every=args.sample_every,
+                                wave=args.wave, on_wave=on_wave)
+            # a drain requested after the last wave was offered (all
+            # futures already awaited) still honors the contract: stop,
+            # flush (trivially), exit 75
+            on_wave(args.requests)
+            for name, value in (("serve/qps", report["qps"]),
+                                ("serve/p50_ms", report["p50_ms"]),
+                                ("serve/p95_ms", report["p95_ms"]),
+                                ("serve/shed_rate", report["shed_rate"])):
+                if value is not None:
+                    obs.gauge(name).set(float(value))
+            print(json.dumps({"report": report, "stats": server.stats()},
+                             indent=2, default=str))
+            ledger = server.outcomes.as_dict()
+            if ledger["terminal"] != ledger["submitted"]:
+                print(f"serve: OUTCOME LEAK: {ledger}", file=sys.stderr)
+                return 1
+            return 0
+        finally:
+            server.stop()
+
+
 def cmd_sample_h5(args) -> int:
     import jax
     from hfrep_tpu.core.data import load_panel
@@ -757,9 +900,9 @@ def main(argv=None) -> int:
     if args.cmd != "clean":            # clean is jax-free; keep startup light
         from hfrep_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
-        if args.cmd not in ("train-gan", "sweep", "pipeline"):
+        if args.cmd not in ("train-gan", "sweep", "pipeline", "serve"):
             # HFREP_OBS_DIR opt-in for the commands without an --obs-dir
-            # flag; train-gan/sweep/pipeline manage their own lifecycle
+            # flag; train-gan/sweep/pipeline/serve manage their own lifecycle
             # (multi-host ordering + per-process dirs + run_end on the
             # error path)
             from hfrep_tpu.obs import maybe_enable_from_env
@@ -767,7 +910,7 @@ def main(argv=None) -> int:
     try:
         return {"clean": cmd_clean, "train-gan": cmd_train_gan,
                 "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
-                "pipeline": cmd_pipeline,
+                "pipeline": cmd_pipeline, "serve": cmd_serve,
                 "sample-h5": cmd_sample_h5}[args.cmd](args)
     finally:
         from hfrep_tpu.obs import disable

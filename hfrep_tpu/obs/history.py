@@ -54,10 +54,17 @@ METRIC_FIELDS = (
     "compile_secs",
 )
 
-#: gauge-name prefix whose values ride into the record verbatim — the
-#: bench probes' ``bench/<name>`` emissions become first-class history
-#: metrics without the store having to know each bench's vocabulary
-BENCH_GAUGE_PREFIX = "bench/"
+#: gauge-name prefixes whose values ride into the record verbatim — the
+#: bench probes' ``bench/<name>`` emissions and the serving layer's
+#: ``serve/<name>`` gauges become first-class history metrics without
+#: the store having to know each probe's vocabulary
+GAUGE_PREFIXES = ("bench/", "serve/")
+BENCH_GAUGE_PREFIX = "bench/"          # back-compat alias
+
+#: deadline-class ladder for the serve shape signature: a 10ms-deadline
+#: series and a 1s-deadline series measure different regimes (shed-bound
+#: vs batch-bound) and must never share a baseline
+_DEADLINE_CLASSES = (10, 25, 50, 100, 250, 500, 1000)
 
 
 def _num(v) -> Optional[float]:
@@ -70,6 +77,18 @@ def _num(v) -> Optional[float]:
     return v
 
 
+def _deadline_class(ms) -> str:
+    """``deadline_ms`` → its ladder class (``d100`` = the 50–100ms
+    band; ``dinf`` beyond the ladder)."""
+    v = _num(ms)
+    if v is None:
+        return "d?"
+    for bound in _DEADLINE_CLASSES:
+        if v <= bound:
+            return f"d{bound}"
+    return "dinf"
+
+
 def _shape_sig(cfg: dict) -> Optional[str]:
     """Compact program-shape signature from the annotated config —
     ``w48f35h100b32`` for the headline bench shape.  Family alone is not
@@ -77,7 +96,17 @@ def _shape_sig(cfg: dict) -> Optional[str]:
     the same family differ ~3.5x in steps/sec by construction, and
     blending their series would bake a baseline no shape ever ran.
     Runs that never annotated a config (manual ``enable()`` callers)
-    yield None and compare only with other shapeless runs."""
+    yield None and compare only with other shapeless runs.
+
+    Serve runs get their OWN signature — ``svb<max_batch><deadline
+    class>`` from the annotated ``serve`` section (batch bucket ×
+    deadline class, e.g. ``svb8d250``) — so a serving run's latency/QPS
+    series can never blend into a training run's steps/sec series even
+    when both annotate the same model family."""
+    serve = cfg.get("serve") or {}
+    if serve:
+        return "svb{}{}".format(serve.get("max_batch", "?"),
+                                _deadline_class(serve.get("deadline_ms")))
     model = cfg.get("model") or {}
     train = cfg.get("train") or {}
     parts = (model.get("window"), model.get("features"),
@@ -115,7 +144,7 @@ def record_from_summary(summary: dict, manifest: dict, *,
         # real measurement as a regression — absent, not zero
         metrics["memory_high_water_bytes"] = None
     for name, value in (summary.get("gauges") or {}).items():
-        if str(name).startswith(BENCH_GAUGE_PREFIX):
+        if str(name).startswith(GAUGE_PREFIXES):
             metrics[str(name)] = _num(value)
     return {
         "v": HISTORY_SCHEMA_VERSION,

@@ -71,6 +71,24 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                 "mad_mult": 5.0},
     "bench/js_div_regenerated": {"direction": "down", "rel_tol": 0.25,
                                  "mad_mult": 5.0},
+    # serving-layer gauges (tools/bench_serve.py; ISSUE 8).  These rules
+    # also decide the cross-host gauge FOLD direction in
+    # history.fold_gauges (min where higher-better / max for costs), so
+    # the serve/* vocabulary must be explicit here: ``serve/shed_rate``
+    # in particular would hit the ``_rate`` = higher-is-better suffix
+    # heuristic and gate (and fold) inverted.  shed_rate/queue_depth use
+    # absolute floors — both sit near 0 on a healthy run, where a
+    # relative tolerance of ~nothing would flag scheduler jitter.
+    "serve/qps":               {"direction": "up",   "rel_tol": 0.10,
+                                "mad_mult": 5.0},
+    "serve/p50_ms":            {"direction": "down", "rel_tol": 0.15,
+                                "mad_mult": 5.0},
+    "serve/p95_ms":            {"direction": "down", "rel_tol": 0.25,
+                                "mad_mult": 5.0},
+    "serve/shed_rate":         {"direction": "down", "rel_tol": 0.0,
+                                "abs_tol": 0.05, "mad_mult": 5.0},
+    "serve/queue_depth":       {"direction": "down", "rel_tol": 0.0,
+                                "abs_tol": 4.0, "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

@@ -22,29 +22,38 @@ kind     sites                 effect at the Nth occurrence
 sigterm  boundary (``chunk``,  a REAL ``os.kill(getpid(), SIGTERM)`` —
          ``block``,            caught by the graceful-drain handler.
          ``supervise``,        Also valid at io sites: the signal then
-         ``drain_barrier``)    lands DURING that host I/O call (e.g.
-         or io                 ``sigterm@snapshot_save=1`` = SIGTERM
-                               mid-way through the final drain snapshot)
+         ``drain_barrier``,    lands DURING that host I/O call (e.g.
+         ``batcher``)          ``sigterm@snapshot_save=1`` = SIGTERM
+         or io                 mid-way through the final drain snapshot)
 preempt  boundary or io        set the drain flag directly (no signal)
 stall    boundary              sleep :data:`STALL_SECS` at the boundary —
                                a member that hangs instead of draining
                                (drives the supervisor's drain-barrier
-                               timeout/escalation path)
+                               timeout/escalation path); at ``batcher``
+                               it wedges the serving layer's batch
+                               formation, turning queued requests into
+                               a deadline storm the batcher must cancel
+                               typed (never dispatch-and-forget)
 io_fail  io (``ckpt_save``,    raise ``OSError(EIO)`` from that I/O call
-         ``snapshot_save``,
-         ``obs_append``,
-         ``manifest``,
+         ``snapshot_save``,    (at ``serve_result``: the server's
+         ``obs_append``,       result-publish boundary — the request
+         ``manifest``,         must fail TYPED, never silently)
          ``queue_put``,
-         ``queue_get``)
+         ``queue_get``,
+         ``serve_result``)
 torn     post-save (``ckpt``,  truncate the just-written payload — a
          ``snapshot``)         torn write that survived the process
 corrupt  post-save             flip bytes mid-payload (bit rot)
-kill     actor                 tell the orchestration supervisor to
-                               SIGKILL the actor behind the Nth observed
-                               queue item (:func:`FaultPlan.actor`
-                               returns True; the supervisor — the only
-                               caller that knows the pids — does the
-                               killing)
+kill     actor,                tell the caller that owns the victim to
+         ``serve_worker``      kill it: the orchestration supervisor
+                               SIGKILLs the actor behind the Nth
+                               observed queue item
+                               (:func:`FaultPlan.actor` returns True;
+                               only the supervisor knows the pids), the
+                               replication server kills the worker
+                               thread holding the Nth dispatched batch
+                               mid-flight (its requests must still
+                               reach typed terminal outcomes)
 ======== ===================== ==========================================
 
 Examples::
@@ -81,7 +90,10 @@ KINDS = BOUNDARY_KINDS + IO_KINDS + POST_SAVE_KINDS + ACTOR_KINDS
 #: how long an injected ``stall`` holds its boundary — long enough that
 #: any realistic drain-barrier timeout fires first (the stalled member is
 #: then escalated/SIGKILLed; it never wakes up to matter), short enough
-#: that a misconfigured test cannot hang CI forever
+#: that a misconfigured test cannot hang CI forever.  Read at fire time,
+#: so in-process drivers that stall a *thread* they cannot escalate (the
+#: serving chaos scenario stalls the batcher to manufacture a deadline
+#: storm) shorten it for the scenario's scope and restore it after.
 STALL_SECS = 120.0
 
 _DIRECTIVE_RE = re.compile(

@@ -32,6 +32,24 @@ contracts hold bitwise:
    boundary and the pipeline raises
    :class:`~hfrep_tpu.resilience.Preempted`; the resumed pipeline
    completes bit-identical to the reference.
+6. **Serving chaos (``hfrep_tpu.serve``)** — a real
+   :class:`~hfrep_tpu.serve.ReplicationServer` over a trained AE head
+   under ``kill@serve_worker`` (worker dies mid-batch, batch fails over
+   and retries) + ``io_fail@serve_result`` (result publish raises EIO)
+   + a ``stall@batcher`` deadline storm + an overload burst past the
+   admission bound: **every submitted request reaches exactly one
+   terminal outcome** (zero silent drops — the ledger's
+   ``terminal == submitted`` invariant), sheds and deadline misses are
+   typed, the circuit breaker trips on repeated faults and serves
+   degraded last-good answers *flagged stale*, closes again after
+   cooldown, and a REAL SIGTERM drains the server (admission stops,
+   in-flight flushes, :class:`~hfrep_tpu.resilience.Preempted` at the
+   next boundary → the CLI's exit 75).
+
+Every scenario runs under its own watchdog timeout
+(:func:`_scenario_timeout`, SIGALRM): one wedged scenario fails loudly
+with its name and budget instead of eating the whole ``tools/check.sh``
+time budget as a silent hang.
 
 Exit 0 with one JSON line on stdout; any violated contract raises and
 exits 1.  Wired into ``tools/check.sh`` (env-stripped, CPU-pinned) next
@@ -41,14 +59,46 @@ to the analyzer/obs/bench gates.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
+import signal
 import tempfile
+import threading
 import time
 from pathlib import Path
 from typing import List, Optional
 
 import numpy as np
+
+
+class ScenarioTimeout(RuntimeError):
+    """A selftest scenario overran its watchdog budget."""
+
+
+@contextlib.contextmanager
+def _scenario_timeout(name: str, secs: float):
+    """Per-scenario watchdog: SIGALRM raises :class:`ScenarioTimeout`
+    naming the wedged scenario.  A no-op off the main thread or on
+    platforms without SIGALRM (the scenario then runs unbounded, as
+    before — a degraded watchdog must not block the gate itself)."""
+    if (not hasattr(signal, "SIGALRM")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise ScenarioTimeout(
+            f"scenario {name!r} exceeded its {secs:.0f}s watchdog budget")
+
+    prev = signal.signal(signal.SIGALRM, _alarm)
+    signal.setitimer(signal.ITIMER_REAL, secs)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev if prev is not None
+                      else signal.SIG_DFL)
 
 
 def _fixture_panel(rows: int = 90, feats: int = 6):
@@ -245,6 +295,187 @@ def _check_ensemble(td: str) -> dict:
             "ensemble_drain": "ok"}
 
 
+def _serving_fixture_server(workers: int = 1):
+    """A real server over a really-trained (tiny) AE replication head."""
+    import jax
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication.engine import train_autoencoder_chunked
+    from hfrep_tpu.serve import AEServeModel, ReplicationServer, ServeConfig
+
+    cfg = AEConfig(n_factors=6, latent_dim=3, epochs=8, batch_size=16,
+                   patience=2, seed=0, chunk_epochs=4)
+    res, _ = train_autoencoder_chunked(jax.random.PRNGKey(3),
+                                       _fixture_panel(40, 6), cfg)
+    model = AEServeModel.create(cfg, res.params)
+    scfg = ServeConfig(max_batch=4, batch_window_ms=5.0,
+                       request_timeout_ms=3000.0, max_queue=32,
+                       workers=workers, row_buckets=(32, 64),
+                       breaker_failures=2, breaker_cooldown_s=0.3,
+                       compile_storm=64)
+    return ReplicationServer(scfg, ae_model=model).start()
+
+
+def _await_all(futures) -> None:
+    from concurrent.futures import wait
+    wait(futures, timeout=60)
+    undone = [f for f in futures if not f.done()]
+    assert not undone, (f"serving: {len(undone)} requests never reached a "
+                        "terminal outcome (silent drop / hang)")
+
+
+def _check_serving(td: str) -> dict:
+    import hfrep_tpu.resilience as res
+    from hfrep_tpu.resilience import faults
+    from hfrep_tpu.serve import Overloaded
+    from hfrep_tpu.serve.loadgen import classify, make_panels
+
+    server = _serving_fixture_server(workers=1)
+    panels = make_panels(5, 6, (16, 28), variants=4)
+    try:
+        # warm the (batch-bucket, row-bucket) programs OUTSIDE the fault
+        # plan so the chaos phase measures the envelope, not XLA compiles
+        for n in (1, 2, 4):
+            _await_all([server.replicate(panels[i % len(panels)],
+                                         timeout_ms=30000)
+                        for i in range(n)])
+
+        # --- chaos: worker killed mid-batch + result-publish EIO + a
+        # wedged batcher manufacturing a deadline storm, plus an
+        # overload burst past the admission bound — every submitted
+        # request must land in exactly one typed terminal outcome
+        res.install_plan(res.FaultPlan.parse(
+            "kill@serve_worker=2;io_fail@serve_result=5;stall@batcher=3"))
+        prev_stall, faults.STALL_SECS = faults.STALL_SECS, 0.3
+        try:
+            futs = []
+            for i in range(40):
+                tight = i % 5 == 4
+                futs.append(server.replicate(
+                    panels[i % len(panels)],
+                    timeout_ms=25.0 if tight else 5000.0))
+                if i % 8 == 7:
+                    time.sleep(0.01)
+            # burst: 2x the admission bound at once — the excess must
+            # shed typed, immediately
+            futs += [server.replicate(panels[0], timeout_ms=5000.0)
+                     for _ in range(2 * server.cfg.max_queue)]
+            _await_all(futs)
+        finally:
+            faults.STALL_SECS = prev_stall
+            res.clear_plan()
+        chaos = classify(futs)
+        ledger = server.outcomes.as_dict()
+        assert ledger["terminal"] == ledger["submitted"], \
+            f"serving chaos: silent drops — ledger {ledger}"
+        assert ledger["worker_kills"] >= 1, \
+            "serving chaos: the injected worker kill never landed"
+        assert ledger["requeues"] >= 1, \
+            "serving chaos: the killed batch was not failed over"
+        assert ledger["worker_faults"] >= 1, \
+            "serving chaos: the injected result EIO produced no typed fault"
+        assert ledger["deadline_missed"] >= 1, \
+            "serving chaos: the stall produced no deadline miss"
+        assert chaos["shed"] >= 1, \
+            "serving chaos: the overload burst was not shed"
+        assert chaos["results"] >= 1, \
+            "serving chaos: nothing was actually served"
+        assert chaos["errors"] == 0, \
+            f"serving chaos: untyped outcomes: {chaos}"
+
+        # settle: the chaos faults may have left the breaker open — wait
+        # out the cooldown and let one clean probe close it, so the
+        # breaker phase below observes its own trip, not the chaos one's
+        time.sleep(server.cfg.breaker_cooldown_s + 0.1)
+        settle = server.replicate(panels[0], timeout_ms=5000.0)
+        _await_all([settle])
+        assert server.breaker.state == "closed", \
+            f"breaker did not settle closed: {server.breaker.state}"
+
+        # --- breaker: every publish fails → consecutive faults trip it
+        # OPEN; submits then get last-good DEGRADED answers flagged
+        # stale; cooldown + one good probe close it again
+        res.install_plan(res.FaultPlan.parse("io_fail@serve_result=1x50"))
+        try:
+            faulted = 0
+            for _ in range(4):
+                f = server.replicate(panels[0], timeout_ms=5000.0)
+                _await_all([f])
+                if f.exception() is not None:
+                    faulted += 1
+                if server.breaker.state == "open":
+                    break
+            assert server.breaker.state == "open" and faulted >= 2, \
+                (f"serving breaker: {faulted} faults did not trip it "
+                 f"(state {server.breaker.state})")
+            degraded = server.replicate(panels[1], timeout_ms=5000.0)
+            _await_all([degraded])
+            out = degraded.result()
+            assert out.stale, "breaker-open answer must be flagged stale"
+        finally:
+            res.clear_plan()
+        time.sleep(server.cfg.breaker_cooldown_s + 0.1)
+        probe = server.replicate(panels[0], timeout_ms=5000.0)
+        _await_all([probe])
+        assert probe.exception() is None and not probe.result().stale, \
+            "post-cooldown probe must serve fresh"
+        assert server.breaker.state == "closed", \
+            f"breaker did not close after a good probe: {server.breaker.state}"
+
+        # --- drain: a REAL SIGTERM through graceful_drain stops
+        # admission, flushes in-flight work, and preempts at the next
+        # boundary (the CLI maps this to exit 75)
+        with res.graceful_drain():
+            inflight = [server.replicate(panels[i % len(panels)],
+                                         timeout_ms=10000.0)
+                        for i in range(6)]
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert res.drain_requested(), \
+                "SIGTERM did not set the drain flag"
+            doc = server.drain(reason="selftest SIGTERM", timeout=30.0)
+            assert doc["flushed"], f"drain did not flush in-flight: {doc}"
+            _await_all(inflight)
+            for f in inflight:
+                err = f.exception()
+                assert err is None or isinstance(err, Overloaded) or \
+                    getattr(err, "code", "") in ("draining", "deadline"), \
+                    f"drain left an untyped outcome: {err!r}"
+            late = server.replicate(panels[0])
+            _await_all([late])
+            assert getattr(late.exception(), "code", None) in (
+                "draining", "closed"), \
+                "post-drain admission must be a typed rejection"
+            try:
+                res.boundary("serve_drive")
+                raise AssertionError(
+                    "drain flag set but boundary did not preempt")
+            except res.Preempted:
+                pass
+        ledger = server.outcomes.as_dict()
+        assert ledger["terminal"] == ledger["submitted"], \
+            f"serving drain: silent drops — ledger {ledger}"
+        return {"serving_chaos": "ok",
+                "serving_submitted": ledger["submitted"],
+                "serving_sheds": ledger["shed"],
+                "serving_deadline_misses": ledger["deadline_missed"],
+                "serving_worker_kills": ledger["worker_kills"],
+                "serving_breaker_trips": server.breaker.trips,
+                "serving_drain": "ok"}
+    finally:
+        server.stop()
+
+
+#: per-scenario watchdog budgets (seconds) — generous multiples of the
+#: measured CPU fixture times, tight enough that a wedge cannot eat the
+#: whole tools/check.sh budget silently
+SCENARIO_BUDGETS = {
+    "checkpoint_cycle": 60.0,
+    "lanes21": 120.0,
+    "multi": 120.0,
+    "ensemble": 300.0,
+    "serving": 120.0,
+}
+
+
 def run_selftest() -> dict:
     import dataclasses
 
@@ -259,7 +490,9 @@ def run_selftest() -> dict:
     xs = _fixture_panel()
     doc: dict = {}
     with tempfile.TemporaryDirectory(prefix="hfrep_resilience_") as td:
-        doc.update(_check_checkpoint_cycle(td))
+        with _scenario_timeout("checkpoint_cycle",
+                               SCENARIO_BUDGETS["checkpoint_cycle"]):
+            doc.update(_check_checkpoint_cycle(td))
 
         # the paper's 21-lane latent sweep, shrunk to fixture epochs —
         # a real vmapped training drive killed by a REAL SIGTERM
@@ -267,22 +500,31 @@ def run_selftest() -> dict:
                        patience=3, seed=0, chunk_epochs=6)
         dims = list(range(1, 22))
         key = jax.random.PRNGKey(0)
-        doc.update(_kill_resume(
-            td, "lanes21", "sigterm@chunk=2",
-            lambda rd: sweep_autoencoders_chunked(key, xs, cfg, dims,
-                                                  resume_dir=rd)))
+        with _scenario_timeout("lanes21", SCENARIO_BUDGETS["lanes21"]):
+            doc.update(_kill_resume(
+                td, "lanes21", "sigterm@chunk=2",
+                lambda rd: sweep_autoencoders_chunked(key, xs, cfg, dims,
+                                                      resume_dir=rd)))
 
         # the fused multi-dataset fabric (2 padded datasets × 3 lanes)
         mcfg = dataclasses.replace(cfg, latent_dim=4)
         stack, rows = stack_padded([xs, xs[:70]])
-        doc.update(_kill_resume(
-            td, "multi", "preempt@chunk=1",
-            lambda rd: sweep_autoencoders_multi(key, stack, rows, mcfg,
-                                                [1, 2, 3], resume_dir=rd)))
+        with _scenario_timeout("multi", SCENARIO_BUDGETS["multi"]):
+            doc.update(_kill_resume(
+                td, "multi", "preempt@chunk=1",
+                lambda rd: sweep_autoencoders_multi(key, stack, rows, mcfg,
+                                                    [1, 2, 3],
+                                                    resume_dir=rd)))
 
         # the async actor fabric: REAL SIGKILL of a running ensemble
         # member + coordinated pod drain → resume, both bit-identical
-        doc.update(_check_ensemble(td))
+        with _scenario_timeout("ensemble", SCENARIO_BUDGETS["ensemble"]):
+            doc.update(_check_ensemble(td))
+
+        # the serving layer: chaos (kill/EIO/deadline storm/overload),
+        # breaker + degraded answers, SIGTERM drain — zero silent drops
+        with _scenario_timeout("serving", SCENARIO_BUDGETS["serving"]):
+            doc.update(_check_serving(td))
     return doc
 
 

@@ -1,0 +1,62 @@
+"""hfrep_tpu.serve — replication-as-a-service with overload protection.
+
+Everything else in the repo answers one *batch* question; the ROADMAP
+north star is answering portfolio-replication *queries* for millions of
+users.  This package is that serving layer, built robustness-first —
+a server that melts under load or drops a request silently on a fault
+is worse than no server:
+
+* **AOT programs** (:mod:`~hfrep_tpu.serve.aot`) — the trained AE
+  replication head and GAN generators compiled ahead of time
+  (``jax.jit(...).lower().compile()``, with a ``jax.export``
+  serialize→deserialize round-trip where this jax carries it), behind a
+  bounded LRU of compiled programs + device-resident weights keyed by
+  padded-shape bucket (the PR-4 ``stack_padded`` masking fabric: one
+  program serves every tenant shape in a bucket);
+* **deadline-aware micro-batching** (:mod:`~hfrep_tpu.serve.batcher`)
+  — accumulate up to ``max_batch`` or ``batch_window_ms``, whichever
+  first; per-request deadlines propagate end-to-end and expire AT the
+  batcher (typed ``DeadlineExceeded``, never a dispatch nobody awaits);
+* **admission control + load shedding** (:mod:`~hfrep_tpu.serve.
+  admission`) — a bounded queue; beyond it requests are shed
+  immediately with a typed ``Overloaded`` rejection;
+* **circuit breaking + degraded answers** — repeated worker faults or
+  a compile storm trip the breaker; while open the server answers from
+  the last-good cache *flagged stale* instead of queueing to death;
+* **graceful drain** — SIGTERM (via :func:`hfrep_tpu.resilience.
+  graceful_drain`) stops admission, flushes in-flight work and exits 75,
+  like every other drive in the repo;
+* **chaos-tested** — ``HFREP_FAULTS`` grows serve sites
+  (``kill@serve_worker``, ``io_fail@serve_result``, ``stall@batcher``)
+  and the resilience selftest drives a worker kill + EIO + deadline
+  storm, asserting every admitted request reaches exactly one terminal
+  outcome (zero silent drops).
+
+Entry points: ``python -m hfrep_tpu serve`` (fixture-driven service
+drill) and ``tools/bench_serve.py`` (p50/p95/QPS at 1k/10k/100k
+simulated concurrent queries, gated through the PR-3 sentinel).
+"""
+
+from __future__ import annotations
+
+from hfrep_tpu.serve.admission import (  # noqa: F401  (public re-exports)
+    CircuitBreaker,
+    DeadlineExceeded,
+    Draining,
+    InvalidRequest,
+    Overloaded,
+    ServeError,
+    ServerClosed,
+    WorkerFault,
+)
+from hfrep_tpu.serve.aot import (  # noqa: F401
+    AEServeModel,
+    GenServeModel,
+    jax_export_supported,
+)
+from hfrep_tpu.serve.batcher import MicroBatcher, ServeRequest  # noqa: F401
+from hfrep_tpu.serve.server import (  # noqa: F401
+    ReplicationServer,
+    ServeConfig,
+    ServeResult,
+)

@@ -1,0 +1,280 @@
+"""AOT compilation + compiled-program LRU for the serving layer.
+
+Training amortizes one compile over hours of steps; serving cannot — an
+XLA compile in the request path is a multi-second p99 outlier and, under
+a shape-diverse tenant mix, a compile *storm*.  Following the
+serving-vs-training split of the Gemma-on-TPU comparison (PAPERS.md,
+arxiv 2605.25645), this module moves every compile ahead of time:
+
+* **padded-shape buckets** — tenant panels arrive with arbitrary row
+  counts; requests are padded up to a small fixed ladder of row buckets
+  (the PR-4 ``stack_padded`` masking discipline: zero rows after the
+  true tail + an ``n_rows`` operand the program masks by), so ONE
+  compiled program serves every tenant whose shape falls in the bucket;
+* **AOT programs** — ``jax.jit(fn).lower(*specs).compile()`` produces an
+  executable before the first request; where this jax version carries
+  ``jax.export``, the lowered program additionally round-trips through
+  ``export → serialize → deserialize`` so the artifact the server runs
+  is the one a model registry could ship (bitwise-equal outputs pinned
+  by test, with a clean fallback to the plain compiled path);
+* **LRU of compiled programs + device-resident weights** — model
+  parameters are ``device_put`` once at registration and shared by every
+  bucket's program; compiled executables live in a bounded
+  least-recently-used cache whose evictions and compiles are visible to
+  the circuit breaker (a thrashing cache IS the compile-storm signal).
+
+Nothing here touches the request path's locks: the cache has its own,
+and programs execute outside it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hfrep_tpu.config import AEConfig, ModelConfig
+from hfrep_tpu.models.autoencoder import Autoencoder, latent_mask
+
+#: default row-bucket ladder (tenant panels up to 512 rows); the serve
+#: config can override.  Buckets are few on purpose: programs scale with
+#: the ladder, and each one is an AOT compile held resident.
+DEFAULT_ROW_BUCKETS = (32, 64, 128, 256, 512)
+
+
+class BucketError(ValueError):
+    """A request shape no bucket covers (rows beyond the ladder)."""
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket >= n — the padded shape the request runs at."""
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    raise BucketError(f"{n} rows exceeds the largest serve bucket "
+                      f"{max(buckets)}; raise ServeConfig.row_buckets")
+
+
+def jax_export_supported() -> bool:
+    """Does this jax carry a usable ``jax.export`` serialize/deserialize
+    pair?  (0.4.3x does; older runtimes fall back to plain AOT.)"""
+    try:
+        from jax import export  # noqa: F401
+        return hasattr(export, "export") and hasattr(export, "deserialize")
+    except ImportError:
+        return False
+
+
+def aot_compile(fn: Callable, *example_args,
+                via_export: bool = True) -> Tuple[Callable, str]:
+    """Ahead-of-time compile ``fn`` against ``example_args``.
+
+    Returns ``(callable, mode)`` with ``mode`` one of ``"export"`` (the
+    program ran through ``jax.export`` serialize→deserialize — the
+    shippable-artifact path) or ``"compiled"`` (plain
+    ``lower().compile()``).  The export round-trip is attempted first
+    when supported and asked for; any failure degrades silently to the
+    compiled path — serving must come up on every runtime, and the
+    round-trip equivalence is pinned separately by test.
+
+    Either way the program is EXECUTED once on the example operands
+    before this returns: a rehydrated ``Exported.call`` defers its real
+    XLA compile to the first invocation, which would silently move the
+    compile back into the request path that "ahead of time" exists to
+    protect (measured: the first serve of a "warmed" program paid
+    ~0.5s).
+    """
+    if via_export and jax_export_supported():
+        try:
+            from jax import export
+            exported = export.export(jax.jit(fn))(*example_args)
+            rehydrated = export.deserialize(exported.serialize())
+            jax.block_until_ready(rehydrated.call(*example_args))
+            return rehydrated.call, "export"
+        except Exception:
+            pass
+    compiled = jax.jit(fn).lower(*example_args).compile()
+    jax.block_until_ready(compiled(*example_args))
+    return compiled, "compiled"
+
+
+# ------------------------------------------------------------ serve models
+@dataclasses.dataclass(frozen=True)
+class AEServeModel:
+    """The trained replication head, weights device-resident.
+
+    ``params`` is the engine's ``{encoder_kernel, decoder_kernel}`` dict
+    (one lane of a sweep, or a full-latent train); ``mask`` the optional
+    latent mask of the lane being served.  ``decoder_host`` is the ONE
+    host copy of the replication weights every response carries —
+    fetched at registration, not per request (the params never change
+    after create, and a device→host pull per request would put a
+    blocking transfer in the hot dispatch path).
+    """
+
+    cfg: AEConfig
+    params: dict
+    decoder_host: np.ndarray
+    mask: Optional[jnp.ndarray] = None
+
+    @classmethod
+    def create(cls, cfg: AEConfig, params: dict,
+               mask=None) -> "AEServeModel":
+        dev = jax.tree_util.tree_map(jnp.asarray, params)
+        dev = jax.device_put(dev)
+        m = None if mask is None else jax.device_put(jnp.asarray(mask))
+        host = np.asarray(jax.device_get(dev["decoder_kernel"]))
+        return cls(cfg=cfg, params=dev, decoder_host=host, mask=m)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenServeModel:
+    """A trained GAN generator (any family), weights device-resident."""
+
+    cfg: ModelConfig
+    params: dict
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, params: dict) -> "GenServeModel":
+        dev = jax.device_put(jax.tree_util.tree_map(jnp.asarray, params))
+        return cls(cfg=cfg, params=dev)
+
+
+# ------------------------------------------------------- batch programs
+def ae_batch_fn(model: AEServeModel) -> Callable:
+    """The AE replication program one (batch, rows) bucket runs.
+
+    ``fn(params, x (B, T, F), n_rows (B,), mask)`` → ``(recon (B, T, F),
+    err (B,))``: each request's panel is MinMax-scaled with its OWN
+    masked column ranges (rows past ``n_rows`` excluded — the
+    ``stack_padded`` discipline), encoded/decoded through the head, and
+    scored with a row-masked reconstruction MSE.  Pure in every operand,
+    so the padded program is identical for every tenant in the bucket.
+    """
+    ae = Autoencoder(n_features=model.cfg.n_factors,
+                     latent_dim=model.cfg.latent_dim,
+                     slope=model.cfg.leaky_slope)
+
+    def one(params, x, n_rows, mask):
+        t = x.shape[0]
+        rows = (jnp.arange(t) < n_rows)[:, None].astype(jnp.float32)
+        n = jnp.maximum(n_rows.astype(jnp.float32), 1.0)
+        # masked per-column min/max over the true rows only: padding
+        # zeros must not widen a tenant's scale range
+        big = jnp.float32(3.4e38)
+        mins = jnp.min(jnp.where(rows > 0, x, big), axis=0)
+        maxs = jnp.max(jnp.where(rows > 0, x, -big), axis=0)
+        scale = jnp.where(maxs - mins == 0.0, 1.0, maxs - mins)
+        scaled = (x - mins) / scale * rows
+        recon = ae.apply({"params": params}, scaled, mask)
+        err = jnp.sum(jnp.mean((recon - scaled) ** 2, axis=1) * rows[:, 0]) / n
+        return recon * rows, err
+
+    def batch(params, x, n_rows, mask):
+        return jax.vmap(lambda xb, nb: one(params, xb, nb, mask))(x, n_rows)
+
+    return batch
+
+
+def gen_batch_fn(model: GenServeModel) -> Callable:
+    """The generator sampling program: ``fn(params, noise (B, W, F))`` →
+    ``(B, W, F)`` windows in scaler space (the CLI inverse-scales where
+    a dataset scaler exists, like ``GanTrainer.generate``)."""
+    from hfrep_tpu.models.registry import build_gan
+
+    pair = build_gan(model.cfg)
+
+    def batch(params, noise):
+        return pair.generator.apply({"params": params}, noise)
+
+    return batch
+
+
+# ---------------------------------------------------------------- the LRU
+class ProgramCache:
+    """Bounded LRU of AOT-compiled programs.
+
+    Keys are ``(kind, batch, bucket)`` triples; values the compiled
+    callables.  ``get_or_compile`` is the only entry point: a hit
+    refreshes recency; a miss builds + AOT-compiles under the lock
+    (callers on other keys are briefly serialized — acceptable, because
+    steady state is all hits) and reports the compile to ``on_compile``
+    (the circuit breaker's compile-storm signal).  Evictions emit a
+    ``serve_evict`` event: a cache thrashing at steady state is
+    mis-sized, and silence would hide it.
+    """
+
+    def __init__(self, capacity: int = 8,
+                 on_compile: Optional[Callable[[], None]] = None):
+        self.capacity = max(1, int(capacity))
+        self.on_compile = on_compile
+        #: True while an intentional pre-traffic warm() fills the grid:
+        #: those compiles are the operator's choice, not a storm, and
+        #: must not count toward the breaker's compile-storm signal
+        self.warming = False
+        self._lock = threading.Lock()
+        self._programs: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self.compiles = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def get_or_compile(self, key: tuple, build: Callable[[], Callable]):
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                self._programs.move_to_end(key)
+                return fn
+            fn = build()
+            self.compiles += 1
+            self._programs[key] = fn
+            evicted = None
+            if len(self._programs) > self.capacity:
+                evicted, _ = self._programs.popitem(last=False)
+                self.evictions += 1
+        if self.on_compile is not None and not self.warming:
+            self.on_compile()
+        try:
+            from hfrep_tpu.obs import get_obs
+            obs = get_obs()
+            obs.counter("serve/compiles").inc(key=str(key))
+            if evicted is not None:
+                obs.event("serve_evict", key=str(evicted),
+                          capacity=self.capacity)
+        except Exception:
+            pass
+        return fn
+
+
+def pad_panel_batch(panels: Sequence[np.ndarray], batch: int, rows: int,
+                    feats: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stack request panels into the bucket's ``(batch, rows, feats)``
+    operand + the ``(batch,)`` true-row-count vector — the serving twin
+    of :func:`hfrep_tpu.replication.engine.stack_padded` (fixed target
+    shape instead of the max of the stack; empty slots are all-padding
+    with ``n_rows == 0``, which the masked program reduces to zero)."""
+    x = np.zeros((batch, rows, feats), np.float32)
+    n = np.zeros((batch,), np.int32)
+    for i, p in enumerate(panels):
+        arr = np.asarray(p, np.float32)
+        if arr.ndim != 2 or arr.shape[1] != feats:
+            raise ValueError(f"panel {i}: want (rows, {feats}), "
+                             f"got {arr.shape}")
+        if arr.shape[0] > rows:
+            raise ValueError(f"panel {i}: {arr.shape[0]} rows exceeds "
+                             f"bucket {rows}")
+        x[i, : arr.shape[0]] = arr
+        n[i] = arr.shape[0]
+    return jnp.asarray(x), jnp.asarray(n)
+
+
+def full_mask(cfg: AEConfig) -> jnp.ndarray:
+    """The all-ones latent mask a full-latent AE head serves with."""
+    return latent_mask(cfg.latent_dim, cfg.latent_dim)

@@ -1,0 +1,63 @@
+"""Fixture model bundles for serving drills and benches.
+
+The serving layer needs a *trained* AE replication head to be
+meaningful, but the CLI drill, ``tools/bench_serve.py`` and the chaos
+paths must all come up in seconds on CPU with no cleaned data.  This
+module really trains a small head (the chunked early-exit drive — the
+same code path production params come from) on a deterministic
+synthetic panel, once per process, and wraps it for serving.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.serve.aot import AEServeModel
+from hfrep_tpu.serve.server import ReplicationServer, ServeConfig
+
+
+@functools.lru_cache(maxsize=4)
+def fixture_ae_model(feats: int = 16, rows: int = 96, latent: int = 8,
+                     epochs: int = 30, seed: int = 0) -> AEServeModel:
+    """Train the fixture replication head (cached per shape — the bench
+    and the self-test reuse one training)."""
+    import jax
+    import jax.numpy as jnp
+    from hfrep_tpu.core import scaler as mm
+    from hfrep_tpu.replication.engine import train_autoencoder_chunked
+
+    g = np.random.default_rng(seed + 17)
+    z = g.normal(size=(rows, 3))
+    x = (z @ g.normal(size=(3, feats))
+         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    cfg = AEConfig(n_factors=feats, latent_dim=min(latent, feats),
+                   epochs=epochs, batch_size=32, patience=3, seed=seed,
+                   chunk_epochs=10)
+    res, _ = train_autoencoder_chunked(jax.random.PRNGKey(seed), scaled, cfg)
+    return AEServeModel.create(cfg, res.params)
+
+
+def fixture_server(cfg: ServeConfig, feats: int = 16,
+                   gen_model=None) -> ReplicationServer:
+    return ReplicationServer(cfg, ae_model=fixture_ae_model(feats=feats),
+                             gen_model=gen_model).start()
+
+
+def warm_server(server: ReplicationServer,
+                panels: Sequence[np.ndarray]) -> int:
+    """Pre-compile the full program grid AND push one real batch through
+    each path, OUTSIDE the measured/chaos window — a serving bench that
+    times first-request XLA compiles measures the cache being cold, not
+    the envelope.  Returns the number of programs resident."""
+    from concurrent.futures import wait
+
+    n = server.warm()
+    futs = [server.replicate(panels[i % len(panels)], timeout_ms=60000)
+            for i in range(server.cfg.max_batch)]
+    wait(futs, timeout=60)
+    return n

@@ -1,0 +1,609 @@
+"""The replication server: AOT programs behind an SRE-grade envelope.
+
+``ReplicationServer`` ties the pieces together into the ROADMAP's
+"replication-as-a-service" north-star workload:
+
+* requests (``replicate`` = run a tenant panel through the trained AE
+  replication head; ``sample`` = draw windows from a trained GAN
+  generator) enter through :meth:`submit`, which returns a ``Future``
+  resolving to a :class:`ServeResult` or raising one typed
+  :class:`~hfrep_tpu.serve.admission.ServeError` — **exactly one
+  terminal outcome per submitted request, always**;
+* admission + deadline policy live in the
+  :class:`~hfrep_tpu.serve.batcher.MicroBatcher`; compiled programs +
+  device-resident weights in the :class:`~hfrep_tpu.serve.aot.
+  ProgramCache`; overload state in the
+  :class:`~hfrep_tpu.serve.admission.CircuitBreaker`;
+* worker threads dispatch batches; a worker that dies mid-batch (the
+  ``kill@serve_worker`` chaos scenario) is detected by its own shell,
+  its in-flight batch re-queued once (then failed typed — at-most-one
+  retry, because unbounded retry of a poisoned batch is a livelock),
+  and a replacement worker spawned;
+* with the breaker OPEN the server answers from the **last-good cache**
+  — the most recent successful output per request kind, flagged
+  ``stale=True`` — instead of queueing fresh work it cannot serve
+  (degraded > dead; cold-start with an empty cache sheds typed);
+* SIGTERM (via :func:`hfrep_tpu.resilience.graceful_drain`) triggers
+  :meth:`drain`: admission stops (typed ``Draining`` rejections),
+  in-flight work flushes, the ``serve_drain`` event lands, and the CLI
+  maps the resulting :class:`~hfrep_tpu.resilience.Preempted` to
+  exit 75 like every other drive in the repo.
+
+Outcome accounting is a first-class object (:class:`Outcomes`): the
+chaos selftest's zero-silent-drop assertion is just
+``outcomes.terminal == outcomes.submitted`` after the storm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hfrep_tpu import resilience
+from hfrep_tpu.serve import aot
+from hfrep_tpu.serve.admission import (
+    OPEN,
+    CircuitBreaker,
+    Draining,
+    InvalidRequest,
+    Overloaded,
+    ServerClosed,
+    WorkerFault,
+)
+from hfrep_tpu.serve.batcher import MicroBatcher, ServeRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The serving envelope's knobs (one frozen dataclass, repo-style)."""
+
+    max_batch: int = 8              # requests per dispatched program
+    batch_window_ms: float = 5.0    # micro-batch accumulation deadline
+    request_timeout_ms: float = 250.0   # default per-request deadline
+    max_queue: int = 64             # admission bound (queued requests)
+    workers: int = 2                # dispatch threads
+    row_buckets: Tuple[int, ...] = aot.DEFAULT_ROW_BUCKETS
+    sample_buckets: Tuple[int, ...] = (8, 16, 32, 64)
+    cache_capacity: int = 32        # compiled programs held resident;
+                                    # size it >= the warmed program grid
+                                    # (batch buckets x shape buckets) or
+                                    # steady state recompiles — the LRU
+                                    # protects memory, warm() + capacity
+                                    # protect latency
+    breaker_failures: int = 3       # consecutive faults that trip OPEN
+    breaker_cooldown_s: float = 1.0
+    compile_storm: int = 16         # compiles per window that trip OPEN
+    compile_window_s: float = 10.0
+    via_export: bool = True         # jax.export round-trip when available
+    seed: int = 0                   # noise stream for `sample` requests
+    event_log_every: int = 1        # per-request obs events (admit/shed/
+                                    # degraded) sampled 1-in-N: a 100k-query
+                                    # load test must not write 200k JSONL
+                                    # lines just to be observed.  Counters
+                                    # and the outcome ledger stay exact;
+                                    # only the event stream is sampled
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """A successful terminal outcome.  ``stale=True`` marks a degraded
+    answer served from the last-good cache while the breaker was open —
+    flagged, never silent, so a client can distinguish 'fresh
+    replication of MY panel' from 'the best the server could do'."""
+
+    request_id: str
+    kind: str
+    value: dict
+    latency_ms: float
+    stale: bool = False
+    batch_size: int = 1
+
+
+class Outcomes:
+    """Thread-safe terminal-outcome ledger.
+
+    ``submitted == terminal`` is THE invariant: every request that
+    entered :meth:`ReplicationServer.submit` ends in exactly one of the
+    buckets below, and the chaos selftest fails the build if a single
+    one goes missing.
+    """
+
+    FIELDS = ("submitted", "admitted", "results", "degraded", "shed",
+              "invalid", "drain_rejected", "deadline_missed",
+              "worker_faults", "closed_rejected", "requeues",
+              "worker_kills")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def inc(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    #: the terminal buckets (everything except the transition counters
+    #: requeues/worker_kills and the non-terminal submitted/admitted)
+    TERMINAL_FIELDS = ("results", "degraded", "shed", "invalid",
+                       "drain_rejected", "deadline_missed",
+                       "worker_faults", "closed_rejected")
+
+    @property
+    def terminal(self) -> int:
+        """Requests that reached a terminal outcome (requeues and
+        worker_kills are transitions, not terminals)."""
+        with self._lock:
+            return sum(getattr(self, f) for f in self.TERMINAL_FIELDS)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            d = {f: getattr(self, f) for f in self.FIELDS}
+        d["terminal"] = sum(d[f] for f in self.TERMINAL_FIELDS)
+        return d
+
+
+class _WorkerKilled(BaseException):
+    """Injected abrupt worker death (``kill@serve_worker``).  A
+    BaseException so no except-Exception recovery path inside the
+    dispatch can accidentally 'survive' the kill — the shell is the
+    only catcher, exactly like a real thread death."""
+
+
+class ReplicationServer:
+    """See module docstring.  Construct, :meth:`start`, :meth:`submit`
+    futures, :meth:`drain`/:meth:`stop`."""
+
+    def __init__(self, cfg: ServeConfig,
+                 ae_model: Optional[aot.AEServeModel] = None,
+                 gen_model: Optional[aot.GenServeModel] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if ae_model is None and gen_model is None:
+            raise ValueError("serve needs at least one model "
+                             "(ae_model and/or gen_model)")
+        self.cfg = cfg
+        self.ae_model = ae_model
+        self.gen_model = gen_model
+        self._clock = clock
+        self.outcomes = Outcomes()
+        self.breaker = CircuitBreaker(
+            failure_threshold=cfg.breaker_failures,
+            cooldown_s=cfg.breaker_cooldown_s,
+            compile_storm=cfg.compile_storm,
+            compile_window_s=cfg.compile_window_s,
+            clock=clock)
+        self.cache = aot.ProgramCache(capacity=cfg.cache_capacity,
+                                      on_compile=self.breaker.record_compile)
+        self.batcher = MicroBatcher(
+            max_batch=cfg.max_batch, batch_window_ms=cfg.batch_window_ms,
+            max_queue=cfg.max_queue, on_deadline_miss=self._count_miss,
+            on_forced_close=lambda req: self.outcomes.inc("closed_rejected"),
+            clock=clock)
+        self._lock = threading.Lock()
+        self._last_good: Dict[str, dict] = {}
+        self._latencies: List[float] = []       # bounded reservoir
+        self._ids = itertools.count()
+        self._dispatch_seq = itertools.count()  # sample-noise stream index
+        self._in_flight = 0
+        self._idle = threading.Condition(self._lock)
+        self._running = False
+        self._workers: List[threading.Thread] = []
+        self._worker_ids = itertools.count()
+        self._batch_buckets = tuple(
+            b for b in (1, 2, 4, 8, 16, 32, 64, 128) if b < cfg.max_batch
+        ) + (cfg.max_batch,)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ReplicationServer":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        for _ in range(max(1, self.cfg.workers)):
+            self._spawn_worker()
+        return self
+
+    def _spawn_worker(self) -> None:
+        idx = next(self._worker_ids)
+        t = threading.Thread(target=self._worker_shell, args=(idx,),
+                             name=f"serve-worker-{idx}", daemon=True)
+        self._workers.append(t)
+        t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+        self.batcher.close()
+        for t in self._workers:
+            t.join(timeout=5.0)
+
+    def drain(self, reason: str = "drain", timeout: float = 30.0) -> dict:
+        """Graceful SIGTERM semantics: stop admitting, flush in-flight,
+        report.  The caller (CLI) raises Preempted → exit 75.  Drain
+        state is owned by the batcher (the admission front door) — one
+        flag, no chance of submit() and the batcher disagreeing."""
+        self.batcher.start_drain(reason)
+        flushed = self.batcher.wait_empty(timeout)
+        end = self._clock() + timeout
+        with self._idle:
+            while self._in_flight > 0 and self._clock() < end:
+                self._idle.wait(0.05)
+            flushed = flushed and self._in_flight == 0
+        self.stop()
+        doc = {"reason": reason, "flushed": bool(flushed),
+               **self.outcomes.as_dict()}
+        self._emit("serve_drain", reason=reason, flushed=bool(flushed),
+                   terminal=doc["terminal"], submitted=doc["submitted"])
+        return doc
+
+    # ------------------------------------------------------------ admission
+    def submit(self, kind: str, payload,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one query; ALWAYS returns a future that terminates.
+
+        Typed rejections (shed, draining, closed) resolve the future
+        immediately — raising at the submit call site would make the
+        sync and async client paths behave differently under overload,
+        which is exactly when behavior must be boring.
+        """
+        self.outcomes.inc("submitted")
+        now = self._clock()
+        idnum = next(self._ids)
+        rid = f"r{idnum}"
+        log = (self.cfg.event_log_every <= 1
+               or idnum % self.cfg.event_log_every == 0)
+        budget = (self.cfg.request_timeout_ms
+                  if timeout_ms is None else float(timeout_ms))
+        try:
+            bucket = self._bucket(kind, payload)
+        except (ValueError, aot.BucketError) as e:
+            self.outcomes.inc("invalid")
+            return self._rejected(InvalidRequest(str(e)))
+        req = ServeRequest(id=rid, kind=kind, payload=payload, bucket=bucket,
+                           arrival=now, deadline=now + budget / 1e3)
+        if log:
+            self._emit("serve_admit", request=rid, kind=kind,
+                       bucket=str(bucket), timeout_ms=budget)
+
+        # breaker-open fast path: degraded answer over queueing to death
+        if self.breaker.state == OPEN:
+            return self._degrade_or_shed(req, "breaker open", log=log)
+        try:
+            self.batcher.submit(req)
+        except Overloaded as e:
+            self.outcomes.inc("shed")
+            if log:
+                self._emit("serve_shed", request=rid, reason="queue_full",
+                           depth=e.depth, bound=e.bound)
+            req.finish(error=e)
+            return req.future
+        except Draining as e:
+            self.outcomes.inc("drain_rejected")
+            if log:
+                self._emit("serve_shed", request=rid, reason="draining")
+            req.finish(error=e)
+            return req.future
+        except ServerClosed as e:
+            self.outcomes.inc("closed_rejected")
+            req.finish(error=e)
+            return req.future
+        self.outcomes.inc("admitted")
+        self._gauge_depth()
+        return req.future
+
+    def replicate(self, panel, timeout_ms: Optional[float] = None) -> Future:
+        return self.submit("replicate", np.asarray(panel, np.float32),
+                           timeout_ms=timeout_ms)
+
+    def sample(self, n_windows: int,
+               timeout_ms: Optional[float] = None) -> Future:
+        return self.submit("sample", int(n_windows), timeout_ms=timeout_ms)
+
+    def _bucket(self, kind: str, payload) -> Tuple:
+        if kind == "replicate":
+            if self.ae_model is None:
+                raise ValueError("no AE replication head registered")
+            arr = np.asarray(payload)
+            if arr.ndim != 2 or arr.shape[1] != self.ae_model.cfg.n_factors:
+                raise ValueError(
+                    f"replicate wants (rows, {self.ae_model.cfg.n_factors}) "
+                    f"panels, got {arr.shape}")
+            return ("replicate",
+                    aot.bucket_for(arr.shape[0], self.cfg.row_buckets))
+        if kind == "sample":
+            if self.gen_model is None:
+                raise ValueError("no generator registered")
+            n = int(payload)
+            if n < 1:
+                raise ValueError(f"sample wants n_windows >= 1, got {n}")
+            return ("sample", aot.bucket_for(n, self.cfg.sample_buckets))
+        raise ValueError(f"unknown request kind {kind!r}")
+
+    def _rejected(self, err: ServeError) -> Future:
+        f: Future = Future()
+        f.set_exception(err)
+        return f
+
+    def _degrade_or_shed(self, req: ServeRequest, why: str,
+                         log: bool = True) -> Future:
+        with self._lock:
+            cached = self._last_good.get(req.kind)
+        if cached is not None:
+            self.outcomes.inc("degraded")
+            latency = (self._clock() - req.arrival) * 1e3
+            req.finish(value=ServeResult(
+                request_id=req.id, kind=req.kind, value=cached,
+                latency_ms=latency, stale=True))
+            if log:
+                self._emit("serve_degraded", request=req.id, reason=why)
+        else:
+            self.outcomes.inc("shed")
+            if log:
+                self._emit("serve_shed", request=req.id, reason=why)
+            req.finish(error=Overloaded(depth=self.batcher.depth,
+                                        bound=self.cfg.max_queue))
+        return req.future
+
+    # -------------------------------------------------------------- workers
+    def _worker_shell(self, idx: int) -> None:
+        """Supervision boundary of one worker thread: translate abrupt
+        death into fail-over + replacement, so a killed worker costs one
+        retry, never an answer."""
+        try:
+            self._worker_loop(idx)
+        except _WorkerKilled as e:
+            batch = e.args[0] if e.args else []
+            self.outcomes.inc("worker_kills")
+            self.breaker.record_failure(cause="worker killed")
+            self._emit("serve_worker_exit", worker=idx, kind="killed",
+                       in_flight=len(batch))
+            self._fail_over(batch)
+            with self._lock:
+                self._in_flight -= len(batch)
+                respawn = self._running
+                self._idle.notify_all()
+            if respawn:
+                self._spawn_worker()
+
+    def _worker_loop(self, idx: int) -> None:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            batch = self.batcher.next_batch(timeout=0.05)
+            if not batch:
+                continue
+            with self._lock:
+                self._in_flight += len(batch)
+            # the injected-chaos hook: a ``kill@serve_worker`` directive
+            # fires at the Nth batch ANY worker picked up — the thread
+            # dies abruptly with its batch in flight.  The kill MUST
+            # raise here, outside the try/finally below: the shell owns
+            # the in_flight decrement on this path, and a kill inside
+            # the try would decrement twice
+            if resilience.actor_kill_point("serve_worker"):
+                raise _WorkerKilled(batch)
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._lock:
+                    self._in_flight -= len(batch)
+                    self._idle.notify_all()
+            self._gauge_depth()
+
+    def _fail_over(self, batch: List[ServeRequest]) -> None:
+        """A batch whose worker died: retry once, then typed failure."""
+        retry, dead = [], []
+        for r in batch:
+            if r.future.done():
+                continue
+            (retry if r.retries < 1 else dead).append(r)
+        for r in retry:
+            r.retries += 1
+        if retry:
+            self.outcomes.inc("requeues", len(retry))
+            self.batcher.requeue(retry)
+        for r in dead:
+            self.outcomes.inc("worker_faults")
+            r.finish(error=WorkerFault(r.id, "worker died twice"))
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, batch: List[ServeRequest]) -> None:
+        kind = batch[0].kind
+        if not self.breaker.allow():
+            # tripped (or half-open with the probe already out) while
+            # these were queued: degrade rather than dispatch
+            for r in batch:
+                self._degrade_or_shed(r, "breaker open at dispatch")
+            return
+        try:
+            if kind == "replicate":
+                values = self._run_replicate(batch)
+            else:
+                values = self._run_sample(batch)
+        except Exception as e:           # compile/execute failure of the batch
+            self.breaker.record_failure(cause=type(e).__name__)
+            for r in batch:
+                self.outcomes.inc("worker_faults")
+                r.finish(error=WorkerFault(r.id, f"{type(e).__name__}: {e}"))
+            return
+        ok = True
+        now = self._clock()
+        for r, value in zip(batch, values):
+            try:
+                # the result-publish boundary: ``io_fail@serve_result``
+                # raises the injected EIO here — the request then fails
+                # TYPED (WorkerFault), never silently
+                resilience.io_point("serve_result")
+            except OSError as e:
+                ok = False
+                self.breaker.record_failure(cause="serve_result EIO")
+                self.outcomes.inc("worker_faults")
+                r.finish(error=WorkerFault(r.id, f"result publish: {e}"))
+                continue
+            latency = (now - r.arrival) * 1e3
+            if r.finish(value=ServeResult(request_id=r.id, kind=kind,
+                                          value=value, latency_ms=latency,
+                                          batch_size=len(batch))):
+                self.outcomes.inc("results")
+                self._note_latency(latency)
+        if ok:
+            self.breaker.record_success()
+            with self._lock:
+                self._last_good[kind] = values[-1]
+
+    def warm(self) -> int:
+        """AOT-compile the full program grid — every (kind, batch
+        bucket, shape bucket) the config admits — ahead of traffic, and
+        report the programs resident.  The serving contract is that a
+        request never waits on XLA in steady state; warm() is how a
+        deployment buys that before taking load (the compile-storm
+        breaker is the backstop for the grid the operator got wrong).
+        Warm compiles are intentional and do NOT count toward the
+        breaker's compile-storm signal."""
+        self.cache.warming = True
+        try:
+            if self.ae_model is not None:
+                for rows in self.cfg.row_buckets:
+                    for bsz in self._batch_buckets:
+                        self._replicate_program(bsz, rows)
+            if self.gen_model is not None:
+                for bucket in self.cfg.sample_buckets:
+                    self._sample_program(bucket)
+        finally:
+            self.cache.warming = False
+        return len(self.cache)
+
+    def _ae_mask(self):
+        model = self.ae_model
+        return (model.mask if model.mask is not None
+                else aot.full_mask(model.cfg))
+
+    def _replicate_program(self, bsz: int, rows: int):
+        model = self.ae_model
+        feats = model.cfg.n_factors
+        return self.cache.get_or_compile(
+            ("replicate", bsz, rows),
+            lambda: aot.aot_compile(aot.ae_batch_fn(model), model.params,
+                                    jnp.zeros((bsz, rows, feats), jnp.float32),
+                                    jnp.zeros((bsz,), jnp.int32),
+                                    self._ae_mask(),
+                                    via_export=self.cfg.via_export)[0])
+
+    def _sample_program(self, bucket: int):
+        model = self.gen_model
+        w, f = model.cfg.window, model.cfg.features
+        return self.cache.get_or_compile(
+            ("sample", bucket),
+            lambda: aot.aot_compile(
+                aot.gen_batch_fn(model), model.params,
+                jnp.zeros((bucket, w, f), jnp.float32),
+                via_export=self.cfg.via_export)[0])
+
+    def _run_replicate(self, batch: List[ServeRequest]) -> List[dict]:
+        model = self.ae_model
+        rows = batch[0].bucket[1]
+        bsz = aot.bucket_for(len(batch), self._batch_buckets)
+        feats = model.cfg.n_factors
+        x, n_rows = aot.pad_panel_batch([r.payload for r in batch],
+                                        bsz, rows, feats)
+        mask = self._ae_mask()
+        fn = self._replicate_program(bsz, rows)
+        recon, err = fn(model.params, x, n_rows, mask)
+        recon, err, rows_h = jax.device_get((recon, err, n_rows))
+        return [{"reconstruction": np.asarray(recon[i][: int(rows_h[i])]),
+                 "recon_mse": float(err[i]),
+                 "weights": model.decoder_host}
+                for i in range(len(batch))]
+
+    def _run_sample(self, batch: List[ServeRequest]) -> List[dict]:
+        """Each request claims ``payload`` window slots; the batch runs
+        in slot-bounded chunks so a wide batch can never overflow the
+        largest compiled noise bucket (each request alone fits — the
+        submit-time bucket check guarantees it)."""
+        model = self.gen_model
+        max_slots = max(self.cfg.sample_buckets)
+        w, f = model.cfg.window, model.cfg.features
+        chunks: List[List[ServeRequest]] = [[]]
+        slots = 0
+        for r in batch:
+            n = int(r.payload)
+            if chunks[-1] and slots + n > max_slots:
+                chunks.append([])
+                slots = 0
+            chunks[-1].append(r)
+            slots += n
+        out = []
+        for chunk in chunks:
+            total = sum(int(r.payload) for r in chunk)
+            bucket = aot.bucket_for(total, self.cfg.sample_buckets)
+            fn = self._sample_program(bucket)
+            key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed),
+                                     next(self._dispatch_seq))
+            noise = jax.random.normal(key, (bucket, w, f))
+            windows = np.asarray(jax.device_get(fn(model.params, noise)))
+            off = 0
+            for r in chunk:
+                n = int(r.payload)
+                out.append({"windows": windows[off: off + n]})
+                off += n
+        return out
+
+    # ------------------------------------------------------------ telemetry
+    def _count_miss(self, req: ServeRequest, late_ms: float) -> None:
+        self.outcomes.inc("deadline_missed")
+
+    def _note_latency(self, ms: float) -> None:
+        with self._lock:
+            if len(self._latencies) < 65536:
+                self._latencies.append(ms)
+        try:
+            from hfrep_tpu.obs import get_obs
+            get_obs().histogram("serve/latency_ms").observe(ms)
+        except Exception:
+            pass
+
+    def _gauge_depth(self) -> None:
+        try:
+            from hfrep_tpu.obs import get_obs
+            obs = get_obs()
+            if obs.enabled:
+                obs.gauge("serve/queue_depth").set(self.batcher.depth)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _emit(name: str, **attrs) -> None:
+        try:
+            from hfrep_tpu.obs import get_obs
+            get_obs().event(name, **attrs)
+        except Exception:
+            pass
+
+    def latency_percentiles(self) -> dict:
+        from hfrep_tpu.serve.loadgen import percentile
+        with self._lock:
+            s = sorted(self._latencies)
+        if not s:
+            return {"n": 0, "p50_ms": None, "p95_ms": None, "max_ms": None}
+        return {"n": len(s), "p50_ms": percentile(s, 50),
+                "p95_ms": percentile(s, 95), "max_ms": s[-1]}
+
+    def stats(self) -> dict:
+        doc = self.outcomes.as_dict()
+        doc.update(self.latency_percentiles())
+        doc["breaker"] = {"state": self.breaker.state,
+                          "trips": self.breaker.trips,
+                          "reason": self.breaker.last_trip_reason}
+        doc["cache"] = {"programs": len(self.cache),
+                        "compiles": self.cache.compiles,
+                        "evictions": self.cache.evictions}
+        doc["queue_depth"] = self.batcher.depth
+        return doc

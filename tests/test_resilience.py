@@ -555,3 +555,29 @@ def test_resilience_selftest_smoke():
     # barrier → resume bit-identical
     assert doc["ensemble_kill"] == "ok" and doc["ensemble_drain"] == "ok"
     assert doc["ensemble_kill_restarts"] >= 1
+    # the serving chaos scenario: worker kill + result EIO + deadline
+    # storm with zero silent drops, breaker trip → degraded-stale →
+    # close, REAL SIGTERM drain
+    assert doc["serving_chaos"] == "ok" and doc["serving_drain"] == "ok"
+    assert doc["serving_worker_kills"] >= 1
+    assert doc["serving_deadline_misses"] >= 1
+    assert doc["serving_breaker_trips"] >= 1
+
+
+def test_scenario_timeout_watchdog():
+    """One wedged scenario must fail loudly with its name, not eat the
+    whole check.sh budget (ISSUE 8 satellite)."""
+    import time as _time
+
+    from hfrep_tpu.resilience.selftest import (
+        ScenarioTimeout,
+        _scenario_timeout,
+    )
+
+    with _scenario_timeout("fast", 5.0):
+        pass                                   # no alarm leaks...
+    with pytest.raises(ScenarioTimeout, match="wedged.*budget"):
+        with _scenario_timeout("wedged", 0.2):
+            _time.sleep(2.0)
+    # ...and the timer is disarmed after the raise
+    _time.sleep(0.3)

@@ -1,0 +1,479 @@
+"""Serving layer (hfrep_tpu.serve): AOT programs, micro-batching,
+admission control, circuit breaking, chaos fail-over, drain — plus the
+obs/history satellites (serve comparability key, gauge fold rules)."""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import wait
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.resilience as res
+from hfrep_tpu.config import AEConfig, ModelConfig
+from hfrep_tpu.serve import aot
+from hfrep_tpu.serve.admission import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    Draining,
+    Overloaded,
+    ServerClosed,
+    WorkerFault,
+)
+from hfrep_tpu.serve.batcher import MicroBatcher, ServeRequest
+from hfrep_tpu.serve.server import ReplicationServer, ServeConfig
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def ae_model():
+    from hfrep_tpu.serve.fixture import fixture_ae_model
+    return fixture_ae_model(feats=6, rows=48, latent=3, epochs=8, seed=1)
+
+
+def _panel(rows: int, feats: int = 6, seed: int = 0) -> np.ndarray:
+    g = np.random.default_rng(seed)
+    return (g.normal(size=(rows, feats)) * 0.02).astype(np.float32)
+
+
+def _server(ae_model, **kw) -> ReplicationServer:
+    base = dict(max_batch=4, batch_window_ms=3.0, request_timeout_ms=2000.0,
+                max_queue=16, workers=1, row_buckets=(32,),
+                breaker_failures=2, breaker_cooldown_s=0.25,
+                compile_storm=64)
+    base.update(kw)
+    return ReplicationServer(ServeConfig(**base), ae_model=ae_model).start()
+
+
+def _settle(fut, timeout=30):
+    wait([fut], timeout=timeout)
+    assert fut.done()
+    return fut
+
+
+# ------------------------------------------------------------- aot basics
+def test_bucket_for_ladder():
+    assert aot.bucket_for(1, (32, 64)) == 32
+    assert aot.bucket_for(32, (32, 64)) == 32
+    assert aot.bucket_for(33, (32, 64)) == 64
+    with pytest.raises(aot.BucketError):
+        aot.bucket_for(65, (32, 64))
+
+
+def test_pad_panel_batch_masks_and_validates():
+    x, n = aot.pad_panel_batch([_panel(5), _panel(8)], batch=4, rows=16,
+                               feats=6)
+    assert x.shape == (4, 16, 6) and list(np.asarray(n)) == [5, 8, 0, 0]
+    assert float(jnp.sum(jnp.abs(x[0, 5:]))) == 0.0    # padding is zero
+    with pytest.raises(ValueError):
+        aot.pad_panel_batch([_panel(5, feats=3)], 1, 16, 6)
+    with pytest.raises(ValueError):
+        aot.pad_panel_batch([_panel(20)], 1, 16, 6)
+
+
+def test_program_cache_lru_and_warming():
+    compiles = []
+    cache = aot.ProgramCache(capacity=2, on_compile=lambda: compiles.append(1))
+    for key in ("a", "b", "c"):
+        cache.get_or_compile((key,), lambda: (lambda: key))
+    assert len(cache) == 2 and cache.evictions == 1
+    # "a" was evicted (LRU); "c" and "b" hit without compiling
+    n = cache.compiles
+    cache.get_or_compile(("c",), lambda: (lambda: "c2"))
+    assert cache.compiles == n
+    assert len(compiles) == 3
+    # warm-mode compiles stay out of the breaker's storm signal
+    cache.warming = True
+    cache.get_or_compile(("d",), lambda: (lambda: "d"))
+    assert len(compiles) == 3 and cache.compiles == n + 1
+
+
+# ------------------------------------------- AOT export round-trip (pin)
+def _export_case_ae(ae_model):
+    fn = aot.ae_batch_fn(ae_model)
+    x = jnp.zeros((2, 16, 6)).at[0, :16].set(_panel(16)).at[1, :12].set(
+        _panel(12, seed=3))
+    args = (ae_model.params, x, jnp.asarray([16, 12], jnp.int32),
+            aot.full_mask(ae_model.cfg))
+    return fn, args
+
+
+@pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp", "mtss_gan",
+                                    "mtss_wgan", "mtss_wgan_gp"])
+def test_export_roundtrip_generator_bitwise(family):
+    """compile→serialize→deserialize→execute must match the eager
+    generator bitwise — one generator per family.  Skips cleanly where
+    this jax carries no usable ``jax.export`` (the server then runs the
+    plain ``lower().compile()`` path, covered below)."""
+    if not aot.jax_export_supported():
+        pytest.skip("jax.export not available on this jax version")
+    from hfrep_tpu.serve.aot import GenServeModel, gen_batch_fn
+
+    cfg = ModelConfig(family=family, hidden=8, features=4, window=6)
+    from hfrep_tpu.models.registry import build_gan
+    pair = build_gan(cfg)
+    noise = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 4))
+    params = pair.generator.init(jax.random.PRNGKey(1), noise)["params"]
+    model = GenServeModel.create(cfg, params)
+    fn = gen_batch_fn(model)
+    eager = jax.jit(fn)(model.params, noise)
+    rt, mode = aot.aot_compile(fn, model.params, noise, via_export=True)
+    assert mode == "export"
+    assert jnp.array_equal(eager, rt(model.params, noise))
+
+
+def test_export_roundtrip_ae_head_bitwise(ae_model):
+    if not aot.jax_export_supported():
+        pytest.skip("jax.export not available on this jax version")
+    fn, args = _export_case_ae(ae_model)
+    eager_recon, eager_err = jax.jit(fn)(*args)
+    rt, mode = aot.aot_compile(fn, *args, via_export=True)
+    assert mode == "export"
+    recon, err = rt(*args)
+    assert jnp.array_equal(eager_recon, recon)
+    assert jnp.array_equal(eager_err, err)
+
+
+def test_compiled_fallback_matches(ae_model):
+    """The non-export AOT path (every runtime) matches the jitted AE
+    head bitwise too."""
+    fn, args = _export_case_ae(ae_model)
+    eager_recon, eager_err = jax.jit(fn)(*args)
+    comp, mode = aot.aot_compile(fn, *args, via_export=False)
+    assert mode == "compiled"
+    recon, err = comp(*args)
+    assert jnp.array_equal(eager_recon, recon)
+    assert jnp.array_equal(eager_err, err)
+
+
+# ---------------------------------------------------------------- breaker
+def test_breaker_trips_and_recovers():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                        clock=lambda: now[0])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    now[0] = 1.1                       # cooldown elapsed → half-open
+    assert br.state == "half_open"
+    assert br.allow() and not br.allow()     # exactly one probe
+    br.record_success()
+    assert br.state == "closed"
+    # probe failure re-opens with a fresh cooldown
+    br.record_failure(); br.record_failure()
+    now[0] = 2.3
+    assert br.allow()                   # the probe
+    br.record_failure()
+    assert br.state == "open"
+
+
+def test_breaker_compile_storm():
+    now = [0.0]
+    br = CircuitBreaker(compile_storm=3, compile_window_s=10.0,
+                        clock=lambda: now[0])
+    for _ in range(3):
+        br.record_compile()
+    assert br.state == "closed"
+    br.record_compile()
+    assert br.state == "open"
+    assert "compile storm" in br.last_trip_reason
+
+
+# ---------------------------------------------------------------- batcher
+def _req(rid, clock, kind="replicate", bucket=("replicate", 32),
+         budget_s=10.0):
+    now = clock()
+    return ServeRequest(id=rid, kind=kind, payload=None, bucket=bucket,
+                        arrival=now, deadline=now + budget_s)
+
+
+def test_batcher_sheds_at_bound():
+    b = MicroBatcher(max_batch=4, batch_window_ms=50.0, max_queue=2)
+    b.submit(_req("a", time.monotonic))
+    b.submit(_req("b", time.monotonic))
+    with pytest.raises(Overloaded):
+        b.submit(_req("c", time.monotonic))
+    # fail-over requeue bypasses the bound (already-admitted work)
+    b.requeue([_req("c", time.monotonic)])
+    assert b.depth == 3
+
+
+def test_batcher_groups_and_caps():
+    b = MicroBatcher(max_batch=2, batch_window_ms=40.0, max_queue=16)
+    b.submit(_req("a", time.monotonic))
+    b.submit(_req("x", time.monotonic, bucket=("replicate", 64)))
+    b.submit(_req("b", time.monotonic))
+    batch = b.next_batch(timeout=1.0)
+    assert [r.id for r in batch] == ["a", "b"]     # head's bucket, capped
+    batch2 = b.next_batch(timeout=1.0)             # x flushes on its window
+    assert [r.id for r in batch2] == ["x"]
+    assert b.depth == 0
+
+
+def test_batcher_window_flush_single_request():
+    b = MicroBatcher(max_batch=8, batch_window_ms=20.0, max_queue=4)
+    t0 = time.monotonic()
+    b.submit(_req("solo", time.monotonic))
+    batch = b.next_batch(timeout=2.0)
+    assert [r.id for r in batch] == ["solo"]
+    assert time.monotonic() - t0 >= 0.015          # waited the window out
+
+
+def test_batcher_deadline_cancellation():
+    misses = []
+    b = MicroBatcher(max_batch=4, batch_window_ms=5.0, max_queue=8,
+                     on_deadline_miss=lambda r, late: misses.append(r.id))
+    r = _req("late", time.monotonic, budget_s=0.001)
+    b.submit(r)
+    time.sleep(0.01)
+    out = b.next_batch(timeout=0.5)
+    assert out in ([], None) or "late" not in [x.id for x in out]
+    assert misses == ["late"]
+    with pytest.raises(DeadlineExceeded):
+        r.future.result(timeout=1)
+
+
+def test_batcher_close_completes_queued_typed():
+    b = MicroBatcher(max_batch=4, batch_window_ms=1000.0, max_queue=8)
+    r = _req("q", time.monotonic)
+    b.submit(r)
+    b.close()
+    with pytest.raises(ServerClosed):
+        r.future.result(timeout=1)
+    with pytest.raises(ServerClosed):
+        b.submit(_req("post", time.monotonic))
+
+
+def test_batcher_draining_rejects_typed():
+    b = MicroBatcher(max_batch=4, batch_window_ms=1000.0, max_queue=8)
+    b.start_drain("test")
+    with pytest.raises(Draining):
+        b.submit(_req("x", time.monotonic))
+
+
+# ------------------------------------------------------- server behavior
+def test_server_serves_and_is_deterministic(ae_model):
+    srv = _server(ae_model)
+    try:
+        p = _panel(20, seed=7)
+        a = _settle(srv.replicate(p)).result()
+        b = _settle(srv.replicate(p)).result()
+        assert not a.stale and a.value["recon_mse"] >= 0.0
+        assert a.value["reconstruction"].shape == (20, 6)
+        # same panel, same program → bitwise-identical answers
+        assert np.array_equal(a.value["reconstruction"],
+                              b.value["reconstruction"])
+        led = srv.outcomes.as_dict()
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_rejects_bad_shapes_typed(ae_model):
+    from hfrep_tpu.serve.admission import InvalidRequest
+
+    srv = _server(ae_model)
+    try:
+        f = srv.replicate(_panel(20, feats=3))        # wrong width
+        with pytest.raises(InvalidRequest):
+            _settle(f).result()
+        f = srv.replicate(_panel(200))                # beyond the ladder
+        with pytest.raises(InvalidRequest):
+            _settle(f).result()
+        led = srv.outcomes.as_dict()
+        assert led["invalid"] == 2
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_worker_kill_fails_over(ae_model):
+    """kill@serve_worker: the worker thread dies mid-batch; the batch is
+    re-queued, a replacement worker serves it — no request is lost."""
+    srv = _server(ae_model)
+    try:
+        # warm so the fail-over retry is fast
+        _settle(srv.replicate(_panel(16)))
+        res.install_plan(res.FaultPlan.parse("kill@serve_worker=1"))
+        try:
+            futs = [srv.replicate(_panel(16, seed=i)) for i in range(3)]
+            wait(futs, timeout=60)
+        finally:
+            res.clear_plan()
+        assert all(f.exception() is None for f in futs)
+        led = srv.outcomes.as_dict()
+        assert led["worker_kills"] == 1 and led["requeues"] >= 1
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_result_eio_is_typed_worker_fault(ae_model):
+    srv = _server(ae_model)
+    try:
+        _settle(srv.replicate(_panel(16)))
+        res.install_plan(res.FaultPlan.parse("io_fail@serve_result=1"))
+        try:
+            f = _settle(srv.replicate(_panel(16)))
+        finally:
+            res.clear_plan()
+        assert isinstance(f.exception(), WorkerFault)
+        led = srv.outcomes.as_dict()
+        assert led["worker_faults"] == 1
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_breaker_degrades_stale_then_recovers(ae_model):
+    srv = _server(ae_model)
+    try:
+        _settle(srv.replicate(_panel(16)))            # seeds last-good
+        res.install_plan(res.FaultPlan.parse("io_fail@serve_result=1x20"))
+        try:
+            for _ in range(3):
+                f = _settle(srv.replicate(_panel(16)))
+                if srv.breaker.state == "open":
+                    break
+            assert srv.breaker.state == "open"
+            stale = _settle(srv.replicate(_panel(16))).result()
+            assert stale.stale, "breaker-open answer must be flagged stale"
+        finally:
+            res.clear_plan()
+        time.sleep(srv.cfg.breaker_cooldown_s + 0.1)
+        fresh = _settle(srv.replicate(_panel(16))).result()
+        assert not fresh.stale and srv.breaker.state == "closed"
+        led = srv.outcomes.as_dict()
+        assert led["degraded"] >= 1
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_drain_flushes_and_rejects(ae_model):
+    srv = _server(ae_model)
+    try:
+        _settle(srv.replicate(_panel(16)))
+        futs = [srv.replicate(_panel(16, seed=i)) for i in range(3)]
+        doc = srv.drain(reason="test", timeout=30.0)
+        assert doc["flushed"]
+        wait(futs, timeout=30)
+        assert all(f.exception() is None for f in futs), \
+            "in-flight work must flush through a drain"
+        post = _settle(srv.replicate(_panel(16)))
+        assert getattr(post.exception(), "code", None) in ("draining",
+                                                           "closed")
+        led = srv.outcomes.as_dict()
+        assert led["terminal"] == led["submitted"]
+    finally:
+        srv.stop()
+
+
+def test_server_overload_burst_sheds_typed(ae_model):
+    srv = _server(ae_model, max_queue=4, workers=1)
+    try:
+        futs = [srv.replicate(_panel(16, seed=i)) for i in range(32)]
+        wait(futs, timeout=60)
+        sheds = [f for f in futs if isinstance(f.exception(), Overloaded)]
+        assert sheds, "a 8x-bound burst must shed"
+        led = srv.outcomes.as_dict()
+        assert led["terminal"] == led["submitted"] == 32
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- obs/history satellites
+def test_history_serve_shape_signature():
+    from hfrep_tpu.obs import history
+
+    assert history._shape_sig({"serve": {"max_batch": 8,
+                                         "deadline_ms": 250.0}}) == "svb8d250"
+    assert history._shape_sig({"serve": {"max_batch": 16,
+                                         "deadline_ms": 30}}) == "svb16d50"
+    assert history._shape_sig(
+        {"serve": {"max_batch": 4, "deadline_ms": 9999}}) == "svb4dinf"
+    # serve beats model: a serve run annotating a model family still
+    # indexes under the serving signature
+    sig = history._shape_sig({"serve": {"max_batch": 8, "deadline_ms": 100},
+                              "model": {"window": 48, "features": 35,
+                                        "hidden": 100}})
+    assert sig == "svb8d100"
+    # training runs unchanged
+    assert history._shape_sig({"model": {"window": 48, "features": 35,
+                                         "hidden": 100},
+                               "train": {"batch_size": 32}}) == "w48f35h100b32"
+
+
+def test_history_ingests_serve_gauges():
+    from hfrep_tpu.obs.history import record_from_summary
+
+    rec = record_from_summary(
+        {"run_id": "r", "gauges": {"serve/qps": 100.0, "serve/p95_ms": 12.0,
+                                   "bench/x": 1.0, "train/loss": 3.0}},
+        {"config": {"serve": {"max_batch": 8, "deadline_ms": 250}}})
+    assert rec["metrics"]["serve/qps"] == 100.0
+    assert rec["metrics"]["serve/p95_ms"] == 12.0
+    assert rec["metrics"]["bench/x"] == 1.0
+    assert "train/loss" not in rec["metrics"]
+    assert rec["key"]["shape"] == "svb8d250"
+
+
+def test_regress_serve_gauge_directions_and_folds():
+    from hfrep_tpu.obs import regress
+    from hfrep_tpu.obs.history import fold_gauges
+
+    # shed_rate would hit the "_rate" → up heuristic without its entry
+    assert regress._rule_for("serve/shed_rate", None)["direction"] == "down"
+    assert regress._rule_for("serve/qps", None)["direction"] == "up"
+    assert regress._rule_for("serve/p95_ms", None)["direction"] == "down"
+    folded = fold_gauges([
+        {"gauges": {"serve/qps": 100.0, "serve/p95_ms": 10.0,
+                    "serve/shed_rate": 0.1}},
+        {"gauges": {"serve/qps": 80.0, "serve/p95_ms": 14.0,
+                    "serve/shed_rate": 0.3}},
+    ])
+    # pod-conservative: min of rates, max of costs
+    assert folded["serve/qps"] == 80.0
+    assert folded["serve/p95_ms"] == 14.0
+    assert folded["serve/shed_rate"] == 0.3
+
+
+def test_regress_serve_gate_end_to_end():
+    from hfrep_tpu.obs import regress
+
+    key = {"family": None, "shape": "svb8d250", "mesh": None,
+           "host": "h", "backend": "cpu"}
+    records = [{"run_id": f"r{i}", "created_unix": i, "key": key,
+                "metrics": {"serve/qps": 100.0 + i, "serve/p95_ms": 10.0}}
+               for i in range(4)]
+    good = {"run_id": "new", "created_unix": 9, "key": key,
+            "metrics": {"serve/qps": 101.0, "serve/p95_ms": 10.5}}
+    assert regress.check_run(good, records)["ok"]
+    bad = {"run_id": "new2", "created_unix": 10, "key": key,
+           "metrics": {"serve/qps": 50.0, "serve/p95_ms": 10.0}}
+    verdict = regress.check_run(bad, records)
+    assert not verdict["ok"] and "serve/qps" in verdict["regressions"]
+
+
+# ----------------------------------------------------------------- CLI
+def test_cli_serve_smoke_and_injected_drain(tmp_path, monkeypatch):
+    from hfrep_tpu.experiments import cli
+
+    monkeypatch.delenv("HFREP_OBS_DIR", raising=False)
+    monkeypatch.delenv("HFREP_FAULTS", raising=False)
+    args = ["serve", "--requests", "120", "--wave", "24",
+            "--fixture-feats", "6", "--max-batch", "4", "--workers", "1",
+            "--max-queue", "32", "--timeout-ms", "5000"]
+    assert cli.main(args) == 0
+
+    # injected pod drain at the 3rd formed batch → graceful drain → 75
+    res.install_plan(res.FaultPlan.parse("preempt@batcher=3"))
+    try:
+        assert cli.main(args) == 75
+    finally:
+        res.clear_plan()

@@ -1,0 +1,253 @@
+"""Serving-layer load probe: p50/p95 latency + QPS under simulated
+concurrent portfolio-replication queries.
+
+The ROADMAP's north-star workload is answering replication queries for
+millions of users; this probe measures what the ``hfrep_tpu.serve``
+envelope (AOT programs + micro-batching + admission control) actually
+sustains on this host, and — more importantly for an overload-protection
+layer — that the envelope's *shape* holds at every offered load:
+
+* levels of **1k / 10k / 100k simulated concurrent queries** (each level
+  is one open-loop burst offered to the admission layer; everything the
+  envelope cannot serve inside the deadline must come back as a typed
+  rejection);
+* per level: p50/p95 latency of served requests, QPS, shed rate — and
+  the structural self-checks: **every submitted request reached exactly
+  one terminal outcome** (zero silent drops), zero untyped errors, p95
+  bounded even at 100× overload (shed requests cost microseconds, which
+  is the whole point of shedding).
+
+``--self-test`` (wired into ``tools/check.sh``, env-stripped) shrinks
+the levels and adds the chaos smoke: a deadline storm (every request
+offered a ~5ms budget), an overload burst past the admission bound, and
+an injected ``io_fail@serve_result`` streak that must trip the circuit
+breaker into serving flagged-stale degraded answers and close again
+after cooldown.
+
+Prints ONE JSON line.  Exit 0 = self-checks passed, 1 = a check (or a
+history regression) failed, 2 = tooling failure.
+
+Telemetry: with ``HFREP_OBS_DIR`` the run lands in an obs run dir with
+``serve/*`` gauges (QPS, p50/p95, shed rate, queue depth) plus per-level
+``bench/serve_*`` gauges, annotated with a ``serve`` config section so
+the history store indexes it under the serving comparability key
+(``svb<max_batch><deadline class>``) — serve latency series never blend
+into training steps/sec series.  With a history store on top
+(``HFREP_HISTORY`` or the repo default), the run gates against the
+rolling baseline and auto-ingests on pass, exactly like ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __name__ == "__main__":                 # `python tools/bench_serve.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import hfrep_tpu.obs as obs_pkg
+
+#: offered-load levels (simulated concurrent queries per burst)
+LEVELS = (1_000, 10_000, 100_000)
+SELF_TEST_LEVELS = (128, 512)
+
+#: p95 sanity bound, as a multiple of the request deadline: a served
+#: request's latency is queue wait (deadline-capped at the batcher) +
+#: one batch execution, so p95 beyond a few deadlines means the
+#: cancellation machinery rotted
+P95_DEADLINE_MULT = 4.0
+
+
+def _level_label(n: int) -> str:
+    return f"c{n // 1000}k" if n >= 1000 else f"c{n}"
+
+
+def _check_level(level: int, rep: dict, timeout_ms: float, problems: list):
+    if rep["terminal"] != rep["submitted"]:
+        problems.append(f"{_level_label(level)}: {rep['submitted']} "
+                        f"submitted but {rep['terminal']} terminal "
+                        "(silent drops)")
+    if rep["errors"]:
+        problems.append(f"{_level_label(level)}: {rep['errors']} untyped "
+                        "outcomes")
+    p95 = rep.get("p95_ms")
+    if p95 is not None and p95 > P95_DEADLINE_MULT * timeout_ms:
+        problems.append(f"{_level_label(level)}: p95 {p95:.1f}ms "
+                        f"> {P95_DEADLINE_MULT}x the {timeout_ms:.0f}ms "
+                        "deadline")
+    if rep["results"] + rep["stale"] == 0:
+        problems.append(f"{_level_label(level)}: nothing served at all")
+
+
+def _chaos_smoke(server, panels, problems: list) -> dict:
+    """The shed + deadline + breaker paths, exercised deterministically
+    (the full chaos matrix lives in the resilience selftest; this is the
+    CI-fast smoke that the bench's own envelope can take a punch)."""
+    import hfrep_tpu.resilience as res
+    from concurrent.futures import wait
+    from hfrep_tpu.serve.loadgen import classify
+
+    # deadline storm: a burst with a ~5ms budget — the batcher must
+    # cancel what it cannot dispatch in time, typed
+    futs = [server.replicate(panels[i % len(panels)], timeout_ms=5.0)
+            for i in range(64)]
+    wait(futs, timeout=60)
+    storm = classify(futs)
+    if storm["deadline"] == 0:
+        problems.append("chaos: 5ms-deadline storm produced no misses")
+
+    # breaker: a result-publish EIO streak must trip it into degraded
+    # stale answers, and one clean probe after cooldown must close it
+    res.install_plan(res.FaultPlan.parse("io_fail@serve_result=1x50"))
+    try:
+        faults = 0
+        for _ in range(6):
+            f = server.replicate(panels[0], timeout_ms=5000.0)
+            wait([f], timeout=60)
+            if f.exception() is not None:
+                faults += 1
+            if server.breaker.state == "open":
+                break
+        if server.breaker.state != "open":
+            problems.append(f"chaos: {faults} publish faults did not trip "
+                            "the breaker")
+        probe = server.replicate(panels[1], timeout_ms=5000.0)
+        wait([probe], timeout=60)
+        if probe.exception() is not None or not probe.result().stale:
+            problems.append("chaos: breaker-open answer was not a "
+                            "flagged-stale degraded result")
+    finally:
+        res.clear_plan()
+    time.sleep(server.cfg.breaker_cooldown_s + 0.1)
+    fresh = server.replicate(panels[0], timeout_ms=5000.0)
+    wait([fresh], timeout=60)
+    if fresh.exception() is not None or fresh.result().stale:
+        problems.append("chaos: post-cooldown probe did not serve fresh")
+    if server.breaker.state != "closed":
+        problems.append("chaos: breaker did not close after a good probe")
+    return {"deadline_misses": storm["deadline"],
+            "breaker_trips": server.breaker.trips}
+
+
+def run_probe(obs, self_test: bool) -> int:
+    from hfrep_tpu.serve.fixture import fixture_server, warm_server
+    from hfrep_tpu.serve.loadgen import drive_load, make_panels
+    from hfrep_tpu.serve.server import ServeConfig
+
+    if self_test:
+        levels = SELF_TEST_LEVELS
+        feats, rows_choices = 8, (16, 24, 32)
+        scfg = ServeConfig(max_batch=4, batch_window_ms=3.0,
+                           request_timeout_ms=250.0, max_queue=64,
+                           workers=1, row_buckets=(32, 64),
+                           breaker_failures=2, breaker_cooldown_s=0.3,
+                           compile_storm=64)
+    else:
+        levels = LEVELS
+        feats, rows_choices = 16, (32, 64, 96, 128)
+        scfg = ServeConfig(max_batch=8, batch_window_ms=5.0,
+                           request_timeout_ms=250.0, max_queue=256,
+                           workers=2, row_buckets=(64, 128, 256),
+                           compile_storm=64, event_log_every=1000)
+    # annotate the SERVE envelope (not a training shape): the history
+    # key's signature becomes svb<max_batch><deadline class>, its own
+    # series — serve p95 can never blend into a steps/sec baseline
+    obs.annotate(config={"serve": {"max_batch": scfg.max_batch,
+                                   "deadline_ms": scfg.request_timeout_ms,
+                                   "max_queue": scfg.max_queue,
+                                   "workers": scfg.workers}})
+
+    server = fixture_server(scfg, feats=feats)
+    panels = make_panels(11, feats, rows_choices, variants=8)
+    problems: list = []
+    doc: dict = {"metric": "serve_load", "self_test": bool(self_test)}
+    try:
+        t0 = time.perf_counter()
+        warmed = warm_server(server, panels)
+        doc["warm_programs"] = warmed
+        doc["warm_s"] = round(time.perf_counter() - t0, 3)
+        doc["aot_export"] = bool(__import__(
+            "hfrep_tpu.serve.aot", fromlist=["x"]).jax_export_supported())
+
+        per_level = {}
+        for level in levels:
+            rep = drive_load(server, level, panels,
+                             timeout_ms=scfg.request_timeout_ms, wave=level)
+            _check_level(level, rep, scfg.request_timeout_ms, problems)
+            label = _level_label(level)
+            per_level[label] = {k: rep[k] for k in
+                                ("submitted", "results", "stale", "shed",
+                                 "deadline", "worker_faults", "invalid",
+                                 "errors", "qps", "p50_ms", "p95_ms",
+                                 "shed_rate", "wall_s")}
+            for name, value in (("qps", rep["qps"]),
+                                ("p95_ms", rep["p95_ms"]),
+                                ("shed_rate", rep["shed_rate"])):
+                if value is not None and np.isfinite(value):
+                    obs.gauge(f"bench/serve_{name}_{label}").set(float(value))
+        doc["levels"] = per_level
+
+        # headline serve/* gauges from the LOWEST level — the regime
+        # where (nearly) everything is served fresh, so p50/p95 measure
+        # the envelope, not the shed fast-path
+        head = per_level[_level_label(levels[0])]
+        for name, value in (("serve/qps", head["qps"]),
+                            ("serve/p50_ms", head["p50_ms"]),
+                            ("serve/p95_ms", head["p95_ms"]),
+                            ("serve/shed_rate", head["shed_rate"])):
+            if value is not None and np.isfinite(value):
+                obs.gauge(name).set(float(value))
+        obs.gauge("serve/queue_depth").set(server.batcher.depth)
+
+        if self_test:
+            doc["chaos"] = _chaos_smoke(server, panels, problems)
+
+        ledger = server.outcomes.as_dict()
+        if ledger["terminal"] != ledger["submitted"]:
+            problems.append(f"ledger: {ledger['submitted']} submitted vs "
+                            f"{ledger['terminal']} terminal (silent drops)")
+        doc["ledger"] = ledger
+        doc["stats"] = {k: server.stats()[k] for k in ("breaker", "cache")}
+        obs.memory_snapshot(phase="bench_serve_end")
+    finally:
+        server.stop()
+
+    doc["self_check"] = "ok" if not problems else "; ".join(problems)
+    print(json.dumps(doc))
+    if problems:
+        print(f"bench_serve: SELF-CHECK FAILED: {'; '.join(problems)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_serve",
+        description="serving-layer p50/p95/QPS load probe + chaos smoke")
+    ap.add_argument("--self-test", action="store_true",
+                    help="tiny levels + the shed/deadline/breaker chaos "
+                         "smoke in seconds on CPU (the CI fast path)")
+    args = ap.parse_args(argv)
+
+    obs_dir = os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session_or_off(obs_dir, "bench_serve",
+                                command="bench_serve") as obs:
+        if obs_dir and not obs.enabled:
+            obs_dir = None                 # degraded: nothing to gate below
+        rc = run_probe(obs, args.self_test)
+    from hfrep_tpu.obs import history as hist_mod
+    hist = hist_mod.resolve_history(obs_dir)
+    if obs_dir and hist:
+        rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,14 +32,19 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
     python tools/bench_ae.py --self-test 1>&2
 # resilience gate: kill→resume bit-identical (REAL SIGTERM through the
 # graceful-drain handler, 21-lane + multi-dataset AE sweeps at fixture
-# shapes), corrupt/torn-checkpoint → fallback-to-previous-good, and the
+# shapes), corrupt/torn-checkpoint → fallback-to-previous-good, the
 # async-fabric ensemble scenarios (hfrep_tpu/orchestrate): REAL SIGKILL
 # of one generator actor of a running pipeline → supervisor restart from
 # its sub-block snapshot → artifacts bit-identical; pod-wide drain
-# barrier → pipeline resume bit-identical.  CPU-pinned and env-stripped
-# like the bench self-test: ambient HFREP_OBS_DIR/HFREP_HISTORY must not
-# pollute the committed history store, and an ambient HFREP_FAULTS plan
-# must not fire inside the gate.
+# barrier → pipeline resume bit-identical; and the serving chaos
+# scenario (hfrep_tpu/serve): worker kill + result-publish EIO +
+# deadline storm + overload burst with every request reaching exactly
+# one typed terminal outcome, breaker → degraded-stale → close, REAL
+# SIGTERM drain.  Each scenario runs under its own SIGALRM watchdog so
+# one wedge fails loudly instead of eating this script's budget.
+# CPU-pinned and env-stripped like the bench self-test: ambient
+# HFREP_OBS_DIR/HFREP_HISTORY must not pollute the committed history
+# store, and an ambient HFREP_FAULTS plan must not fire inside the gate.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
     python -m hfrep_tpu.resilience selftest 1>&2
 # mixed-precision gate: the production Policy path end to end at fixture
@@ -48,3 +53,12 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
 # at n_critic=1.  CPU-pinned + env-stripped like the other self-tests.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
     python tools/bench_bf16_probe.py --self-test 1>&2
+# serving gate: the overload envelope at tiny shapes — AOT-warmed
+# programs, micro-batch load levels with zero silent drops and bounded
+# p95, plus the chaos smoke (5ms deadline storm → typed misses; burst
+# past the admission bound → typed sheds; injected result-publish EIO
+# streak → breaker opens, serves flagged-stale degraded answers, closes
+# after cooldown).  Env-stripped so ambient fault plans / history stores
+# stay out of the gate.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+    python tools/bench_serve.py --self-test 1>&2
