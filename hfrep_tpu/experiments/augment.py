@@ -11,7 +11,9 @@ vstack the synthetic rows above the real training rows (cell 50).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+import hashlib
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +21,39 @@ import jax.numpy as jnp
 from hfrep_tpu.core import scaler as mm
 from hfrep_tpu.core.data import Panel
 from hfrep_tpu.core.sampling import factor_hf_split
+
+
+def source_labels(paths: Sequence[str]) -> List[str]:
+    """Stable per-source labels for a repeatable ``--gan-checkpoint`` /
+    ``--h5-generator`` flag: the artifact's basename stem, disambiguated
+    on collision by a short digest of the FULL path — never the flag
+    position.  Positional labels (the old ``gen{i}_<base>``) silently
+    remapped every per-dataset output subdir when the flags were
+    reordered; these don't (regression-pinned)."""
+    stems = []
+    for p in paths:
+        base = os.path.basename(str(p).rstrip(os.sep))
+        stems.append(os.path.splitext(base)[0] or base)
+    labels = []
+    for stem, p in zip(stems, paths):
+        if stems.count(stem) > 1:
+            labels.append(
+                f"{stem}_{hashlib.sha256(str(p).encode()).hexdigest()[:6]}")
+        else:
+            labels.append(stem)
+    if len(set(labels)) != len(labels):
+        raise ValueError(f"duplicate augmentation sources: {list(paths)}")
+    return labels
+
+
+def source_sample_key(label: str, base_seed: int = 7) -> jax.Array:
+    """The sampling key of one augmentation source, derived from its
+    stable label (not its flag position): reordering the flags can
+    neither remap which seed samples which generator nor, therefore,
+    change any source's artifacts."""
+    digest = int.from_bytes(
+        hashlib.sha256(label.encode()).digest()[:4], "big") % (2 ** 31)
+    return jax.random.fold_in(jax.random.PRNGKey(base_seed), digest)
 
 
 @dataclasses.dataclass

@@ -12,6 +12,10 @@ notebook cells, SURVEY §5.6); these subcommands cover the full pipeline:
     serve       replication-as-a-service drill: AOT-compiled serving
                 behind deadline batching + admission control (exit 75
                 on SIGTERM drain)
+    scenario    scenario factory: conditional stress banks (bank),
+                walk-forward regime sweeps (walkforward), synthetic-
+                universe scaling drives (universe); --resume, exit 75
+                on drain
 """
 
 from __future__ import annotations
@@ -203,6 +207,18 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="K deterministic synthetic generator actors "
                             "(no cleaned data or checkpoint needed) — "
                             "drills and benches the fabric itself")
+    plsrc.add_argument("--scenario-sources", type=int, default=None,
+                       metavar="K",
+                       help="K conditional scenario-bank generator actors "
+                            "(scenario factory): source k streams regime "
+                            "k mod --scenario-regimes, so one bank's "
+                            "regimes fan out across the actor pool; "
+                            "consumers sweep each block like fixture "
+                            "items")
+    pl.add_argument("--scenario-regimes", type=int, default=3,
+                    help="regime count for --scenario-sources (condition "
+                         "vector width of the fixture conditional "
+                         "generator)")
     pl.add_argument("--blocks", type=int, default=4,
                     help="sample blocks per generator actor; the block is "
                          "streamed item-wise with a sub-block snapshot "
@@ -292,6 +308,75 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="telemetry run dir: serve_admit/shed/"
                          "deadline_miss/breaker events, serve/* gauges "
                          "(qps, p50/p95, shed rate, queue depth)")
+
+    sc = sub.add_parser(
+        "scenario",
+        help="scenario factory: conditional stress banks, walk-forward "
+             "regime sweeps, synthetic-universe scaling drives (exit 75 "
+             "on SIGTERM drain; --resume continues bit-identically)")
+    sc.add_argument("mode", choices=["bank", "walkforward", "universe"])
+    sc.add_argument("--out", required=True)
+    sc.add_argument("--resume", action="store_true",
+                    help="continue a drained/killed run: training resumes "
+                         "from chunk snapshots, published bank blocks / "
+                         "window scores that verify are skipped — final "
+                         "artifacts bit-identical to an uninterrupted run")
+    sc.add_argument("--cleaned-dir", default="/root/reference/cleaned_data")
+    sc.add_argument("--fixture", action="store_true",
+                    help="run on the deterministic fabricated panel "
+                         "instead of cleaned data (drills/benches; no "
+                         "data files needed)")
+    sc.add_argument("--obs-dir", default=None,
+                    help="telemetry run dir: scenario_bank_block / "
+                         "walkforward_window events, scenario/* gauges, "
+                         "the scn* comparability key")
+    # bank knobs
+    sc.add_argument("--family", default="gan",
+                    help="conditional GAN family (bank mode)")
+    sc.add_argument("--n-regimes", type=int, default=3,
+                    help="vol-state regimes the labeler bins the panel "
+                         "into (= condition vector width)")
+    sc.add_argument("--regime-window", type=int, default=12,
+                    help="trailing months the vol-state labeler looks at")
+    sc.add_argument("--regimes", default=None,
+                    help="comma list of regimes to bank (default: all)")
+    sc.add_argument("--blocks", type=int, default=4,
+                    help="sample blocks per regime")
+    sc.add_argument("--block-size", type=int, default=16,
+                    help="windows per block")
+    sc.add_argument("--stream-seed", type=int, default=0)
+    sc.add_argument("--train-epochs", type=int, default=30,
+                    help="conditional GAN training epochs before banking "
+                         "(0 = deterministic initialized generator)")
+    sc.add_argument("--gan-window", type=int, default=24,
+                    help="window length of the conditional training "
+                         "windows / bank samples")
+    # walk-forward / universe knobs
+    sc.add_argument("--latents", default="1:8",
+                    help="'lo:hi' inclusive, or comma list")
+    sc.add_argument("--start", type=int, default=120,
+                    help="training months of the first walk-forward window")
+    sc.add_argument("--step", type=int, default=1,
+                    help="months the training window grows per roll")
+    sc.add_argument("--windows", type=int, default=24,
+                    help="walk-forward windows (lanes = windows x latents)")
+    sc.add_argument("--horizon", type=int, default=36,
+                    help="OOS months scored per window (fixed, so one "
+                         "compiled program scores every window)")
+    sc.add_argument("--epochs", type=int, default=None,
+                    help="AE epochs override")
+    sc.add_argument("--chunk-epochs", type=int, default=None,
+                    help="AEConfig.chunk_epochs override")
+    sc.add_argument("--ols-window", type=int, default=None,
+                    help="AEConfig.ols_window override")
+    # universe knobs
+    sc.add_argument("--funds", type=int, default=64,
+                    help="synthetic hedge funds (universe mode)")
+    sc.add_argument("--months", type=int, default=360,
+                    help="synthetic months (universe mode)")
+    sc.add_argument("--n-factors", type=int, default=22,
+                    help="synthetic factor columns (universe mode)")
+    sc.add_argument("--seed", type=int, default=0)
 
     h = sub.add_parser("sample-h5", help="sample a reference Keras .h5 generator "
                                          "into an inverse-scaled cube (.npy)")
@@ -590,26 +675,37 @@ def cmd_sweep(args) -> int:
 def _sample_augmentations(args, panel):
     """Sample every ``--gan-checkpoint`` / ``--h5-generator`` source into
     an :class:`~hfrep_tpu.experiments.augment.AugmentedData` list (the
-    flags are mutually exclusive, each repeatable)."""
-    import jax
+    flags are mutually exclusive, each repeatable).
+
+    Source identity — the per-dataset output subdir AND the sampling
+    key — derives from the checkpoint/artifact stem, never from flag
+    position (``augment.source_labels`` / ``source_sample_key``):
+    reordering the flags cannot silently remap artifacts between
+    sources."""
+    from hfrep_tpu.experiments.augment import (
+        source_labels,
+        source_sample_key,
+    )
 
     augs, names = [], []
     if args.gan_checkpoint:
         trainer, _, _, _ = _make_trainer(args.preset, args.cleaned_dir,
                                          quiet=True)
         from hfrep_tpu.experiments.augment import sample_generator
-        for i, ckpt in enumerate(args.gan_checkpoint):
+        for ckpt, label in zip(args.gan_checkpoint,
+                               source_labels(args.gan_checkpoint)):
             trainer.restore_checkpoint(ckpt)
-            augs.append(sample_generator(trainer, jax.random.PRNGKey(7 + i),
+            augs.append(sample_generator(trainer, source_sample_key(label),
                                          n_windows=args.n_gen_windows))
-            names.append(f"gen{i}_{os.path.basename(ckpt.rstrip(os.sep))}")
+            names.append(f"gen_{label}")
     elif args.h5_generator:
         from hfrep_tpu.experiments.augment import sample_keras_generator
-        for i, h5 in enumerate(args.h5_generator):
-            augs.append(sample_keras_generator(h5, jax.random.PRNGKey(7 + i),
+        for h5, label in zip(args.h5_generator,
+                             source_labels(args.h5_generator)):
+            augs.append(sample_keras_generator(h5, source_sample_key(label),
                                                panel,
                                                n_windows=args.n_gen_windows))
-            names.append(f"gen{i}_{os.path.splitext(os.path.basename(h5))[0]}")
+            names.append(f"gen_{label}")
     return augs, names
 
 
@@ -752,6 +848,18 @@ def _cmd_pipeline_impl(args) -> int:
                                "n_gen_windows": args.n_gen_windows})
             for i, ck in enumerate(args.gan_checkpoint)]
         consume_mode = "augment"
+    elif args.scenario_sources:
+        cfg = dataclasses.replace(cfg, n_factors=args.fixture_feats,
+                                  latent_dim=min(cfg.latent_dim,
+                                                 args.fixture_feats))
+        sources = [
+            SourceSpec(name=f"s{i}", mode="scenario",
+                       params={"rows": args.fixture_rows,
+                               "feats": args.fixture_feats,
+                               "regime": i % args.scenario_regimes,
+                               "n_regimes": args.scenario_regimes})
+            for i in range(args.scenario_sources)]
+        consume_mode = "direct"
     else:
         cfg = dataclasses.replace(cfg, n_factors=args.fixture_feats,
                                   latent_dim=min(cfg.latent_dim,
@@ -874,6 +982,133 @@ def _cmd_serve_impl(args) -> int:
             server.stop()
 
 
+def cmd_scenario(args) -> int:
+    import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu.resilience import Preempted
+    obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session(obs_dir, command="scenario", mode=args.mode):
+        try:
+            return _cmd_scenario_impl(args)
+        except Preempted as e:
+            print(f"preempted: {e}; re-run with --resume to continue "
+                  "(published blocks/windows are kept and verified)",
+                  file=sys.stderr)
+            return 75
+
+
+def _scenario_panel(args):
+    """(factors, hfd, rf) for the bank/walkforward modes: the real
+    cleaned panel, or the shared fabricated fixture under ``--fixture``."""
+    if args.fixture:
+        import shutil
+        import tempfile
+
+        from hfrep_tpu.core.data import load_panel
+        from hfrep_tpu.utils.fixture_data import write_cleaned_fixture
+        d = os.path.join(tempfile.gettempdir(),
+                         f"hfrep_scenario_fixture_{os.getuid()}")
+        if not os.path.isdir(d):
+            # build in a private tmp dir and publish with ONE rename: a
+            # killed first run must not leave a half-written dir that
+            # wedges every later --fixture run, and concurrent runs must
+            # not interleave writes (the loser just discards its copy)
+            tmp = f"{d}.tmp-{os.getpid()}"
+            write_cleaned_fixture(tmp)
+            try:
+                os.replace(tmp, d)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                if not os.path.isdir(d):
+                    raise
+        panel = load_panel(d)
+    else:
+        from hfrep_tpu.core.data import load_panel
+        panel = load_panel(args.cleaned_dir)
+    return panel
+
+
+def _cmd_scenario_impl(args) -> int:
+    import dataclasses as dc
+
+    import numpy as _np
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.scenario import regimes as reg
+    from hfrep_tpu.scenario.walkforward import WalkForwardSpec, run_walkforward
+    obs = get_obs()
+
+    if args.mode == "bank":
+        from hfrep_tpu.config import ModelConfig, TrainConfig
+        from hfrep_tpu.scenario.conditional import (
+            generate_bank,
+            sliding_windows,
+            train_conditional,
+        )
+        panel = _scenario_panel(args)
+        from hfrep_tpu.core import scaler as mm
+        x = _np.asarray(panel.factors, _np.float32)
+        labels = reg.label_regimes(x, window=args.regime_window,
+                                   n_regimes=args.n_regimes)
+        _, scaled = mm.fit_transform(x)
+        windows = sliding_windows(_np.asarray(scaled), args.gan_window)
+        conds = reg.window_conditions(labels, args.gan_window,
+                                      args.n_regimes)
+        mcfg = ModelConfig(family=args.family, features=x.shape[1],
+                           window=args.gan_window)
+        tcfg = TrainConfig(n_critic=1, seed=args.seed,
+                           steps_per_call=min(50, max(1, args.train_epochs)))
+        bundle = train_conditional(mcfg, tcfg, windows, conds,
+                                   args.train_epochs, seed=args.seed)
+        regimes = ([int(v) for v in args.regimes.split(",")]
+                   if args.regimes else None)
+        manifest = generate_bank(bundle, args.out, regimes=regimes,
+                                 blocks=args.blocks,
+                                 block_size=args.block_size,
+                                 stream_seed=args.stream_seed)
+        print(json.dumps({
+            "aggregate_digest": manifest["aggregate_digest"],
+            "blocks": len(manifest["block_digests"]),
+            "generated": manifest["generated"],
+            "regime_months": reg.regime_counts(
+                labels, args.n_regimes).tolist()}, indent=2))
+        print(f"bank: {os.path.join(args.out, 'bank.json')}")
+        return 0
+
+    cfg = AEConfig(seed=args.seed)
+    for field, value in (("epochs", args.epochs),
+                         ("chunk_epochs", args.chunk_epochs),
+                         ("ols_window", args.ols_window)):
+        if value is not None:
+            cfg = dc.replace(cfg, **{field: value})
+    latents = _parse_latents(args.latents)
+    spec = WalkForwardSpec(start=args.start, n_windows=args.windows,
+                           horizon=args.horizon, step=args.step)
+
+    if args.mode == "walkforward":
+        panel = _scenario_panel(args)
+        res = run_walkforward(panel.factors, panel.hf, panel.rf, spec,
+                              cfg, latents, args.out, resume=args.resume)
+    else:                                             # universe
+        from hfrep_tpu.scenario.universe import UniverseSpec, drive_universe
+        uspec = UniverseSpec(funds=args.funds, months=args.months,
+                             n_factors=args.n_factors, seed=args.seed)
+        res = drive_universe(uspec, spec, cfg, latents, args.out,
+                             resume=args.resume)
+    stats = res["stats"]
+    obs.annotate(config={"scenario": {
+        "funds": stats.get("funds"), "months": stats.get("months"),
+        "windows": spec.n_windows, "latents": len(latents)}})
+    for name in ("lanes", "pad_waste_frac", "windows_per_sec"):
+        if stats.get(name) is not None:
+            obs.gauge(f"scenario/{name}").set(float(stats[name]))
+    print(json.dumps({"stats": stats,
+                      "summary": res["manifest"]["summary"]},
+                     indent=2, default=str))
+    print(f"surface: {os.path.join(args.out, 'walkforward.csv')}")
+    return 0
+
+
 def cmd_sample_h5(args) -> int:
     import jax
     from hfrep_tpu.core.data import load_panel
@@ -900,9 +1135,11 @@ def main(argv=None) -> int:
     if args.cmd != "clean":            # clean is jax-free; keep startup light
         from hfrep_tpu.utils.xla_cache import enable_compilation_cache
         enable_compilation_cache()
-        if args.cmd not in ("train-gan", "sweep", "pipeline", "serve"):
+        if args.cmd not in ("train-gan", "sweep", "pipeline", "serve",
+                            "scenario"):
             # HFREP_OBS_DIR opt-in for the commands without an --obs-dir
-            # flag; train-gan/sweep/pipeline/serve manage their own lifecycle
+            # flag; train-gan/sweep/pipeline/serve/scenario manage their
+            # own lifecycle
             # (multi-host ordering + per-process dirs + run_end on the
             # error path)
             from hfrep_tpu.obs import maybe_enable_from_env
@@ -911,6 +1148,7 @@ def main(argv=None) -> int:
         return {"clean": cmd_clean, "train-gan": cmd_train_gan,
                 "eval-gan": cmd_eval_gan, "sweep": cmd_sweep,
                 "pipeline": cmd_pipeline, "serve": cmd_serve,
+                "scenario": cmd_scenario,
                 "sample-h5": cmd_sample_h5}[args.cmd](args)
     finally:
         from hfrep_tpu.obs import disable

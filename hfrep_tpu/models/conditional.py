@@ -1,0 +1,86 @@
+"""Regime-conditioned generator/discriminator variants (cGAN).
+
+The conditioning discipline is input concatenation: the condition
+vector (a regime one-hot, (B, C) or (C,)) is tiled over the window axis
+and concatenated onto the feature axis of the generator's noise input
+and of the discriminator's score-path input — both bodies are the
+UNCHANGED unconditional modules (their first Dense/LSTM layer simply
+initializes ``F + C`` wide).  The generator still emits ``features``
+columns, so a conditional sample cube is shape-compatible with every
+downstream consumer (augmentation, banks, the AE sweep).
+
+Identity discipline (the PR-6 ``Policy`` pattern):
+``build_conditional_gan(cfg, cond_dim=0)`` returns the literal
+:func:`~hfrep_tpu.models.registry.build_gan` pair — not a wrapper whose
+graph merely simplifies to it — so the conditioning-off fp32 program is
+the pre-scenario program by construction, pinned jaxpr-identical by
+``tests/test_scenario.py``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from hfrep_tpu.config import ModelConfig
+from hfrep_tpu.models.registry import GanPair, build_gan
+
+
+def concat_condition(x: jnp.ndarray, cond: jnp.ndarray) -> jnp.ndarray:
+    """(B, W, F) ⊕ condition → (B, W, F+C): the condition tiles over the
+    window axis (every timestep of a window lives in one regime).  Casts
+    the condition to the operand dtype so a bf16-policy body sees one
+    dtype (identity on fp32 — the one-hots are exact either way)."""
+    cond = jnp.asarray(cond, x.dtype)
+    if cond.ndim == 1:
+        cond = jnp.broadcast_to(cond, (x.shape[0], cond.shape[0]))
+    if cond.ndim != 2 or cond.shape[0] != x.shape[0]:
+        raise ValueError(f"condition {cond.shape} does not align with "
+                         f"batch {x.shape}")
+    tiled = jnp.broadcast_to(cond[:, None, :],
+                             (x.shape[0], x.shape[1], cond.shape[1]))
+    return jnp.concatenate([x, tiled], axis=-1)
+
+
+class ConditionalGenerator(nn.Module):
+    """The unconditional generator body behind a condition-concat input."""
+
+    body: nn.Module
+    cond_dim: int
+
+    @nn.compact
+    def __call__(self, z, cond, backend=None):
+        if cond.shape[-1] != self.cond_dim:
+            raise ValueError(f"condition width {cond.shape[-1]} != "
+                             f"cond_dim {self.cond_dim}")
+        return self.body(concat_condition(z, cond), backend=backend)
+
+
+class ConditionalDiscriminator(nn.Module):
+    """The unconditional discriminator/critic body scoring x ⊕ condition."""
+
+    body: nn.Module
+    cond_dim: int
+
+    @nn.compact
+    def __call__(self, x, cond, backend=None):
+        if cond.shape[-1] != self.cond_dim:
+            raise ValueError(f"condition width {cond.shape[-1]} != "
+                             f"cond_dim {self.cond_dim}")
+        return self.body(concat_condition(x, cond), backend=backend)
+
+
+def build_conditional_gan(cfg: ModelConfig, cond_dim: int) -> GanPair:
+    """A :class:`GanPair` whose members take ``(input, cond)`` when
+    ``cond_dim > 0`` — and the LITERAL unconditional pair when 0 (the
+    no-condition path is the pre-scenario program, same modules, same
+    jaxpr; pinned)."""
+    pair = build_gan(cfg)
+    if cond_dim <= 0:
+        return pair
+    return GanPair(
+        generator=ConditionalGenerator(body=pair.generator,
+                                       cond_dim=cond_dim),
+        discriminator=ConditionalDiscriminator(body=pair.discriminator,
+                                               cond_dim=cond_dim),
+        loss=pair.loss, family=pair.family, policy=pair.policy)

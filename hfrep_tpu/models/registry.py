@@ -47,6 +47,16 @@ FAMILIES = {
 }
 
 
+def build_conditional_gan(cfg: ModelConfig, cond_dim: int) -> GanPair:
+    """Regime-conditioned variant of :func:`build_gan` — the scenario
+    factory's entry point (``hfrep_tpu/models/conditional.py``).
+    ``cond_dim=0`` returns the literal unconditional pair (pinned
+    jaxpr-identical), so callers can thread one builder everywhere."""
+    from hfrep_tpu.models.conditional import (
+        build_conditional_gan as _build)
+    return _build(cfg, cond_dim)
+
+
 def build_gan(cfg: ModelConfig) -> GanPair:
     if cfg.family not in FAMILIES:
         raise KeyError(f"unknown GAN family {cfg.family!r}; available: {sorted(FAMILIES)}")

@@ -55,10 +55,11 @@ METRIC_FIELDS = (
 )
 
 #: gauge-name prefixes whose values ride into the record verbatim — the
-#: bench probes' ``bench/<name>`` emissions and the serving layer's
-#: ``serve/<name>`` gauges become first-class history metrics without
-#: the store having to know each probe's vocabulary
-GAUGE_PREFIXES = ("bench/", "serve/")
+#: bench probes' ``bench/<name>`` emissions, the serving layer's
+#: ``serve/<name>`` gauges and the scenario factory's ``scenario/<name>``
+#: gauges become first-class history metrics without the store having to
+#: know each probe's vocabulary
+GAUGE_PREFIXES = ("bench/", "serve/", "scenario/")
 BENCH_GAUGE_PREFIX = "bench/"          # back-compat alias
 
 #: deadline-class ladder for the serve shape signature: a 10ms-deadline
@@ -102,7 +103,18 @@ def _shape_sig(cfg: dict) -> Optional[str]:
     class>`` from the annotated ``serve`` section (batch bucket ×
     deadline class, e.g. ``svb8d250``) — so a serving run's latency/QPS
     series can never blend into a training run's steps/sec series even
-    when both annotate the same model family."""
+    when both annotate the same model family.
+
+    Scenario runs likewise — ``scnf<funds>m<months>w<windows>l<latents>``
+    from the annotated ``scenario`` section (the svb pattern): a
+    walk-forward/universe drive's windows-per-sec series must never
+    blend into a GAN training steps/sec series, and two universe sizes
+    are different workloads by construction."""
+    scenario = cfg.get("scenario") or {}
+    if scenario:
+        return "scnf{}m{}w{}l{}".format(
+            scenario.get("funds", "?"), scenario.get("months", "?"),
+            scenario.get("windows", "?"), scenario.get("latents", "?"))
     serve = cfg.get("serve") or {}
     if serve:
         return "svb{}{}".format(serve.get("max_batch", "?"),

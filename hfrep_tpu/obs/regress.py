@@ -89,6 +89,22 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                 "abs_tol": 0.05, "mad_mult": 5.0},
     "serve/queue_depth":       {"direction": "down", "rel_tol": 0.0,
                                 "abs_tol": 4.0, "mad_mult": 5.0},
+    # scenario-factory gauges (tools/bench_scenario.py; ISSUE 9).  Every
+    # entry is explicit — the ``shed_rate`` lesson: ``pad_waste_frac``
+    # has no cost suffix and would gate (and cross-host fold) INVERTED
+    # under the higher-is-better fallback.  ``lanes`` is structural (the
+    # fused program's window×latent grid): identical run to run at a
+    # fixed key, so a 0.5 absolute floor flags any silent shrink while
+    # config changes re-key the series anyway.  ``pad_waste_frac`` sits
+    # near 0 on a healthy schedule — absolute floor, not relative.
+    "scenario/windows_per_sec": {"direction": "up",   "rel_tol": 0.10,
+                                 "mad_mult": 5.0},
+    "scenario/lanes":           {"direction": "up",   "rel_tol": 0.0,
+                                 "abs_tol": 0.5, "mad_mult": 0.0},
+    "scenario/pad_waste_frac":  {"direction": "down", "rel_tol": 0.0,
+                                 "abs_tol": 0.05, "mad_mult": 5.0},
+    "scenario/bank_windows_per_sec": {"direction": "up", "rel_tol": 0.15,
+                                      "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

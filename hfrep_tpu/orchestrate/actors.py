@@ -60,14 +60,10 @@ def _fixture_panel(stream_seed: int, source_idx: int, seq: int,
     """Deterministic low-rank scaled panel for the fixture source — the
     selftest/bench stand-in for GAN synthesis.  Seeded by the full
     (stream, source, seq) coordinate so every item is unique yet
-    reproducible on any member."""
-    g = np.random.default_rng((stream_seed, source_idx, seq))
-    z = g.normal(size=(rows, rank))
-    x = (z @ g.normal(size=(rank, feats))
-         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
-    lo, hi = x.min(axis=0), x.max(axis=0)
-    scale = np.where(hi - lo == 0.0, 1.0, hi - lo)
-    return ((x - lo) / scale).astype(np.float32)
+    reproducible on any member (shared builder: utils/fixture_data)."""
+    from hfrep_tpu.utils.fixture_data import keyed_scaled_panel
+    return keyed_scaled_panel(stream_seed, source_idx, seq, rows, feats,
+                              rank=rank)
 
 
 def _make_generator(payload: dict):
@@ -88,6 +84,24 @@ def _make_generator(payload: dict):
                 time.sleep(gen_delay)
             return {"panel": _fixture_panel(stream_seed, source_idx, seq,
                                             rows, feats)}
+        return gen
+    if mode == "scenario":
+        # conditional scenario-bank blocks as pipeline items: each source
+        # streams ONE regime's blocks, so a bank's regimes fan out across
+        # the actor pool; items stay pure functions of
+        # (stream_seed, source, seq) — the regime folds into the block key
+        from hfrep_tpu.scenario.conditional import scenario_item_panel
+
+        rows, feats = int(payload["rows"]), int(payload["feats"])
+        regime = int(payload["regime"])
+        n_regimes = int(payload.get("n_regimes", 3))
+        window = int(payload.get("scenario_window", 12))
+
+        def gen(seq: int) -> Dict[str, np.ndarray]:
+            return {"panel": scenario_item_panel(
+                stream_seed, source_idx, seq, regime=regime,
+                n_regimes=n_regimes, rows=rows, feats=feats,
+                window=window)}
         return gen
     if mode == "gan":
         # build once per process: a restart pays one rebuild, items after

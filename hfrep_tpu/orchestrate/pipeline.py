@@ -59,8 +59,10 @@ class PipelineStateError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class SourceSpec:
     """One generator member's stream: ``mode`` "fixture" (deterministic
-    synthetic panels — selftest/bench) or "gan" (sample a trained
-    checkpoint); ``params`` feeds the worker's ``_make_generator``."""
+    synthetic panels — selftest/bench), "gan" (sample a trained
+    checkpoint) or "scenario" (one regime's conditional bank blocks —
+    the scenario factory fanning a bank out across the actor pool);
+    ``params`` feeds the worker's ``_make_generator``."""
 
     name: str
     mode: str = "fixture"

@@ -102,14 +102,10 @@ def _scenario_timeout(name: str, secs: float):
 
 
 def _fixture_panel(rows: int = 90, feats: int = 6):
-    import jax.numpy as jnp
-    from hfrep_tpu.core import scaler as mm
-    g = np.random.default_rng(11)
-    z = g.normal(size=(rows, 3))
-    x = (z @ g.normal(size=(3, feats))
-         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
-    _, scaled = mm.fit_transform(jnp.asarray(x))
-    return scaled
+    # shared builder (utils/fixture_data); seed 11 is this selftest's
+    # pinned stream — the kill→resume bit-identity references depend on it
+    from hfrep_tpu.utils.fixture_data import scaled_panel
+    return scaled_panel(rows, feats, seed=11)
 
 
 def _assert_results_identical(a, b, what: str) -> None:

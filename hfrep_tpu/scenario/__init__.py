@@ -1,0 +1,39 @@
+"""Scenario factory: conditional generation, walk-forward regime sweeps,
+and synthetic-universe stress banks.
+
+The paper answers one question on one 337-month panel of 13 indices;
+this package opens the workload up into families of questions:
+
+* :mod:`~hfrep_tpu.scenario.regimes` — host-side factor-regime / vol-
+  state labeling of a real panel (the condition vocabulary);
+* :mod:`~hfrep_tpu.scenario.conditional` — regime-conditioned GAN
+  variants (conditioning OFF is the literal unconditional program,
+  pinned at jaxpr level) and deterministic stress scenario banks;
+* :mod:`~hfrep_tpu.scenario.walkforward` — the AE sweep rolled forward
+  a month at a time, hundreds of (window-start × latent) instances as
+  lanes of ONE padded program;
+* :mod:`~hfrep_tpu.scenario.universe` — synthetic universes of F funds
+  × M months driven through the padded fabric to *measure* where lane
+  count / padding waste / memory break.
+
+CLI: ``python -m hfrep_tpu scenario {bank,walkforward,universe}``.
+"""
+
+from hfrep_tpu.scenario.regimes import (     # noqa: F401
+    label_regimes,
+    one_hot,
+    window_conditions,
+)
+from hfrep_tpu.scenario.conditional import (  # noqa: F401
+    generate_bank,
+    replay_block_digest,
+)
+from hfrep_tpu.scenario.walkforward import (  # noqa: F401
+    WalkForwardSpec,
+    run_walkforward,
+)
+from hfrep_tpu.scenario.universe import (     # noqa: F401
+    UniverseSpec,
+    drive_universe,
+    synthesize_universe,
+)

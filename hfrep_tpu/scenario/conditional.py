@@ -1,0 +1,317 @@
+"""Conditional generation: training drive + deterministic scenario banks.
+
+A *scenario bank* is a directory of conditional sample blocks, each the
+pure function of a ``(stream_seed, regime, seq)`` coordinate — the same
+determinism contract the orchestration fabric's items carry, so banks
+replay bit-identically, fan out across actor pools, and resume by
+skipping blocks that verify.  Every block publishes through the PR-5
+atomic artifact writer and ``bank.json`` records the per-block digests
+(:func:`hfrep_tpu.utils.checkpoint.aggregate_digest` — THE digest
+format) plus one aggregate over the bank.
+
+Layout under ``out_dir``::
+
+    blocks/r<regime>_<seq>/samples.npy   atomic per-block artifacts
+    bank.json                            manifest: digests + config
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import io
+import json
+import os
+from pathlib import Path
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from hfrep_tpu.config import ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import GanPair, build_conditional_gan
+from hfrep_tpu.scenario import regimes as reg
+
+BANK_MANIFEST = "bank.json"
+
+
+def block_name(regime: int, seq: int) -> str:
+    return f"r{int(regime)}_{int(seq):05d}"
+
+
+def sliding_windows(panel: np.ndarray, window: int) -> np.ndarray:
+    """(T, F) → (T-window+1, window, F) overlapping training windows."""
+    x = np.asarray(panel, np.float32)
+    if x.shape[0] < window:
+        raise ValueError(f"{x.shape[0]} rows < window {window}")
+    idx = np.arange(window)[None, :] + np.arange(x.shape[0] - window + 1)[:, None]
+    return x[idx]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConditionalBundle:
+    """A trained (or deterministically initialized) conditional
+    generator, everything bank generation needs in one picklable-free
+    handle."""
+
+    pair: GanPair
+    params: dict                 # generator params
+    window: int
+    features: int
+    n_regimes: int
+    family: str
+    train_epochs: int
+    seed: int
+
+
+def train_conditional(mcfg: ModelConfig, tcfg: TrainConfig,
+                      windows: np.ndarray, conditions: np.ndarray,
+                      epochs: int, seed: int = 0) -> ConditionalBundle:
+    """Train a regime-conditioned GAN on ``(windows, conditions)``.
+
+    ``epochs == 0`` returns the deterministic *initialized* bundle — the
+    fixture path the orchestration/bench drills use where convergence is
+    irrelevant and determinism is everything.  The drive is one jitted
+    multi-step scan (:func:`~hfrep_tpu.train.steps.make_multi_step` with
+    the conditional epoch step), pure in ``(seed, data, cfg)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu.train.states import init_conditional_state
+    from hfrep_tpu.train.steps import make_conditional_step, make_multi_step
+
+    n_regimes = int(np.asarray(conditions).shape[1])
+    pair = build_conditional_gan(mcfg, n_regimes)
+    state = init_conditional_state(jax.random.PRNGKey(seed), mcfg, tcfg,
+                                   pair, n_regimes)
+    if epochs > 0:
+        from hfrep_tpu import resilience
+
+        ds = jnp.asarray(windows, jnp.float32)
+        cond = jnp.asarray(conditions, jnp.float32)
+        step = make_conditional_step(pair, tcfg, ds, cond)
+        key = jax.random.PRNGKey(seed + 1)
+        done = 0
+        multis = {}                    # steps_per_call -> compiled multi
+        with resilience.graceful_drain():
+            while done < epochs:
+                # clamp the last dispatch so the drive trains EXACTLY
+                # `epochs` (an overshoot would change every bank digest
+                # downstream of the requested config)
+                spc = min(tcfg.steps_per_call, epochs - done)
+                if spc not in multis:
+                    multis[spc] = make_multi_step(
+                        pair, dataclasses.replace(tcfg, steps_per_call=spc),
+                        ds, step=step)
+                state, _ = multis[spc](state, jax.random.fold_in(key, done))
+                done += spc
+                if done < epochs:
+                    # a SIGTERM lands here as a clean Preempted (exit 75
+                    # via the CLI) instead of killing the process
+                    # mid-dispatch; after the final chunk the completed
+                    # bundle proceeds to (resumable) bank generation
+                    resilience.boundary("gan_block")
+    return ConditionalBundle(
+        pair=pair, params=jax.device_get(state.g_params),
+        window=int(windows.shape[1]), features=int(windows.shape[2]),
+        n_regimes=n_regimes, family=mcfg.family,
+        train_epochs=int(epochs), seed=int(seed))
+
+
+@functools.lru_cache(maxsize=4)
+def fixture_bundle(feats: int = 6, window: int = 12, n_regimes: int = 3,
+                   epochs: int = 2, rows: int = 90,
+                   seed: int = 0, family: str = "gan") -> ConditionalBundle:
+    """Deterministic small conditional bundle trained on the shared
+    fixture panel — the bank/bench/actor stand-in for a production
+    conditional checkpoint (cached per shape, like the serve fixture)."""
+    from hfrep_tpu.utils.fixture_data import scaled_panel
+
+    panel = np.asarray(scaled_panel(rows, feats, seed=seed + 29))
+    labels = reg.label_regimes(panel, window=min(window, 12),
+                               n_regimes=n_regimes)
+    windows = sliding_windows(panel, window)
+    conds = reg.window_conditions(labels, window, n_regimes)
+    mcfg = ModelConfig(family=family, features=feats, window=window,
+                       hidden=16)
+    tcfg = TrainConfig(batch_size=16, n_critic=1, seed=seed,
+                       steps_per_call=max(1, epochs))
+    return train_conditional(mcfg, tcfg, windows, conds, epochs, seed=seed)
+
+
+def _sample_fn(bundle: ConditionalBundle):
+    """The jitted conditional sampler ``fn(key, cond) -> (n, W, F)``;
+    noise is drawn inside the program from the block key so a block is a
+    pure function of its coordinate."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(key, cond, n):
+        z = jax.random.normal(key, (n, bundle.window, bundle.features))
+        return bundle.pair.generator.apply(
+            {"params": bundle.params}, z,
+            jnp.broadcast_to(cond, (n, cond.shape[-1])))
+
+    return jax.jit(sample, static_argnums=2)
+
+
+def block_key(stream_seed: int, regime: int, seq: int):
+    """THE key derivation of a bank block — exposed so replay and the
+    writer cannot drift."""
+    import jax
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(stream_seed), int(regime)),
+        int(seq))
+
+
+def _block_samples(bundle: ConditionalBundle, sample, stream_seed: int,
+                   regime: int, seq: int, block_size: int) -> np.ndarray:
+    import jax.numpy as jnp
+    cond = jnp.asarray(reg.one_hot([regime], bundle.n_regimes)[0])
+    cube = sample(block_key(stream_seed, regime, seq), cond, int(block_size))
+    return np.asarray(cube, np.float32)
+
+
+def _npy_digest(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return hashlib.sha256(buf.getvalue()).hexdigest()
+
+
+def replay_block_digest(bundle: ConditionalBundle, stream_seed: int,
+                        regime: int, seq: int, block_size: int) -> str:
+    """Regenerate one block in memory and return the aggregate digest
+    its on-disk artifact would carry — the determinism pin
+    (same seed+regime ⇒ identical digest) without touching the bank."""
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    arr = _block_samples(bundle, _sample_fn(bundle), stream_seed, regime,
+                         seq, block_size)
+    return ckpt.aggregate_digest({"samples.npy": _npy_digest(arr)})
+
+
+def _bank_fingerprint(bundle: ConditionalBundle, stream_seed: int,
+                      block_size: int) -> dict:
+    """Everything that determines a block's BYTES: the block key inputs
+    plus the generator's identity.  Written into every block's metadata
+    and compared before a verified block is reused — a dir banked under
+    a different seed/config must refuse, not silently keep old bytes
+    under a manifest claiming the new config (the walk-forward
+    foreign-state discipline)."""
+    return {"stream_seed": int(stream_seed), "block_size": int(block_size),
+            "family": bundle.family, "window": int(bundle.window),
+            "features": int(bundle.features),
+            "n_regimes": int(bundle.n_regimes),
+            "train_epochs": int(bundle.train_epochs),
+            "seed": int(bundle.seed)}
+
+
+def generate_bank(bundle: ConditionalBundle, out_dir, *,
+                  regimes: Optional[Sequence[int]] = None,
+                  blocks: int = 4, block_size: int = 16,
+                  stream_seed: int = 0) -> dict:
+    """Write the stress scenario bank: ``blocks`` deterministic sample
+    blocks per regime, each atomically published and digest-indexed in
+    ``bank.json``.
+
+    Idempotent/resumable: a block that already exists, VERIFIES, and
+    carries THIS bank's fingerprint is skipped (degrade-don't-trust: a
+    rotted one is regenerated; a block from a different seed/config
+    refuses loudly), and a SIGTERM drains at the block boundary
+    (:func:`hfrep_tpu.resilience.graceful_drain` +
+    :func:`~hfrep_tpu.resilience.boundary`, site ``bank_block``) so a
+    SIGTERM'd bank run exits 75 and a re-run completes only the gap.
+    """
+    from hfrep_tpu import resilience
+    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    out = Path(out_dir)
+    blocks_dir = out / "blocks"
+    blocks_dir.mkdir(parents=True, exist_ok=True)
+    regime_list = (list(regimes) if regimes is not None
+                   else list(range(bundle.n_regimes)))
+    fp = _bank_fingerprint(bundle, stream_seed, block_size)
+    sample = _sample_fn(bundle)
+    obs = get_obs()
+    digests: Dict[str, str] = {}
+    generated = 0
+    with resilience.graceful_drain():
+        for regime in regime_list:
+            if not 0 <= int(regime) < bundle.n_regimes:
+                raise ValueError(f"regime {regime} outside "
+                                 f"[0, {bundle.n_regimes})")
+            for seq in range(blocks):
+                dst = blocks_dir / block_name(regime, seq)
+                meta = None
+                if (dst / ckpt.META_NAME).exists():
+                    try:
+                        meta = ckpt.verify(dst)
+                    except ckpt.CheckpointCorrupt:
+                        meta = None
+                    if meta is not None and meta.get("bank") != fp:
+                        raise ValueError(
+                            f"{dst} holds a block from a DIFFERENT bank "
+                            "(stream seed / block size / generator "
+                            "config differ) — remove the out dir or "
+                            "use a fresh one")
+                if meta is None:
+                    arr = _block_samples(bundle, sample, stream_seed,
+                                         regime, seq, block_size)
+                    meta_doc = {"regime": int(regime), "seq": int(seq),
+                                "bank": fp}
+                    ckpt.write_atomic(dst,
+                                      lambda tmp, a=arr: np.save(
+                                          tmp / "samples.npy", a),
+                                      metadata=meta_doc,
+                                      io_site="bank_save",
+                                      fault_site="bank")
+                    meta = ckpt.read_meta(dst)
+                    generated += 1
+                    if obs.enabled:
+                        obs.event("scenario_bank_block",
+                                  regime=int(regime), seq=int(seq),
+                                  digest=meta["checksum"]["digest"])
+                digests[block_name(regime, seq)] = \
+                    meta["checksum"]["digest"]
+                resilience.boundary("bank_block")
+    manifest = {
+        "stream_seed": int(stream_seed),
+        "n_regimes": int(bundle.n_regimes),
+        "regimes": [int(r) for r in regime_list],
+        "blocks": int(blocks), "block_size": int(block_size),
+        "family": bundle.family, "window": int(bundle.window),
+        "features": int(bundle.features),
+        "train_epochs": int(bundle.train_epochs), "seed": int(bundle.seed),
+        "block_digests": digests,
+        "aggregate_digest": ckpt.aggregate_digest(digests),
+    }
+    tmp = out / f".{BANK_MANIFEST}.tmp-{os.getpid()}"
+    tmp.write_text(json.dumps(manifest, indent=2, sort_keys=True))
+    os.replace(tmp, out / BANK_MANIFEST)
+    manifest["generated"] = generated
+    return manifest
+
+
+def scenario_item_panel(stream_seed: int, source_idx: int, seq: int, *,
+                        regime: int, n_regimes: int = 3, rows: int = 96,
+                        feats: int = 6, window: int = 12) -> np.ndarray:
+    """One pipeline item: a conditional bank block flattened into a
+    MinMax-scaled (rows, feats) panel an AE sweep consumer can train on.
+
+    Pure function of ``(stream_seed, source, seq)`` — the orchestration
+    fabric's determinism contract — with the regime folded into the
+    block key, so scenario sources fan a bank's regimes out across actor
+    pools and kill→resume stays bit-identical.
+    """
+    bundle = fixture_bundle(feats=feats, window=window,
+                            n_regimes=n_regimes)
+    n_windows = -(-int(rows) // window)          # ceil: enough rows
+    cube = _block_samples(bundle, _sample_fn(bundle),
+                          stream_seed + 7919 * source_idx, regime, seq,
+                          n_windows)
+    x = cube.reshape(-1, feats)[:rows]
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    scale = np.where(hi - lo == 0.0, 1.0, hi - lo)
+    return ((x - lo) / scale).astype(np.float32)

@@ -1,0 +1,108 @@
+"""Synthetic-universe scaling: find where the padded fabric breaks.
+
+The real panel is 337 months × 13 indices.  This module synthesizes
+universes of F funds × M months (F to hundreds, M to thousands) — from
+the deterministic fixture generator or from a trained (conditional) GAN
+— and drives the walk-forward sweep fabric across them so lane count,
+padding waste and throughput are *measured*, not asserted
+(``tools/bench_scenario.py`` gates the numbers under the ``scn*``
+comparability key).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from hfrep_tpu.config import AEConfig
+from hfrep_tpu.scenario.walkforward import WalkForwardSpec, run_walkforward
+
+
+@dataclasses.dataclass(frozen=True)
+class UniverseSpec:
+    """F funds × M months over ``n_factors`` synthetic factor columns."""
+
+    funds: int
+    months: int
+    n_factors: int = 22
+    seed: int = 0
+    rank: int = 4
+
+
+class Universe(NamedTuple):
+    factors: np.ndarray       # (months, n_factors)
+    hfd: np.ndarray           # (months, funds)
+    rf: np.ndarray            # (months,)
+
+
+def synthesize_universe(spec: UniverseSpec,
+                        factor_sampler: Optional[Callable[[int, int],
+                                                          np.ndarray]] = None
+                        ) -> Universe:
+    """Deterministic universe from the fixture factor model, or — when
+    ``factor_sampler(months, n_factors)`` is given (e.g.
+    :func:`generator_factor_sampler` over a trained GAN) — from sampled
+    factor paths.  Both paths share
+    :func:`~hfrep_tpu.utils.fixture_data.fund_cross_section` (whose mix/
+    noise stream is seeded independently of the factor values), so
+    swapping the factor source leaves the fund cross-section
+    construction unchanged."""
+    from hfrep_tpu.utils.fixture_data import (
+        fund_cross_section,
+        low_rank_returns,
+    )
+
+    if factor_sampler is not None:
+        factors = np.asarray(factor_sampler(spec.months, spec.n_factors),
+                             np.float32)
+        if factors.shape != (spec.months, spec.n_factors):
+            raise ValueError(f"factor_sampler returned {factors.shape}, "
+                             f"want {(spec.months, spec.n_factors)}")
+    else:
+        g_fac = np.random.default_rng((spec.seed, spec.months,
+                                       spec.n_factors, 0))
+        factors = low_rank_returns(g_fac, spec.months, spec.n_factors,
+                                   spec.rank)
+    hfd, rf = fund_cross_section(factors, spec.seed, spec.funds)
+    return Universe(factors=factors, hfd=hfd, rf=rf)
+
+
+def generator_factor_sampler(bundle, regime: int = 0,
+                             stream_seed: int = 0):
+    """``factor_sampler`` over a conditional bundle: sample enough
+    regime-conditioned windows to cover ``months`` rows and stitch them
+    (blocks keyed by the bank derivation, so universes built from a
+    generator inherit the bank's determinism contract)."""
+    from hfrep_tpu.scenario.conditional import (
+        _block_samples,
+        _sample_fn,
+    )
+
+    def sampler(months: int, n_factors: int) -> np.ndarray:
+        if n_factors != bundle.features:
+            raise ValueError(f"bundle emits {bundle.features} factors, "
+                             f"universe wants {n_factors}")
+        n_windows = -(-months // bundle.window)
+        cube = _block_samples(bundle, _sample_fn(bundle), stream_seed,
+                              regime, 0, n_windows)
+        return cube.reshape(-1, bundle.features)[:months]
+
+    return sampler
+
+
+def drive_universe(spec: UniverseSpec, wf: WalkForwardSpec,
+                   cfg: AEConfig, latent_dims: Sequence[int], out_dir,
+                   resume: bool = False,
+                   factor_sampler=None) -> dict:
+    """Synthesize the universe and drive the walk-forward fabric across
+    it; returns the walk-forward result with the universe's structural
+    stats folded in (``lanes``, ``pad_waste_frac``, ``windows_per_sec``,
+    funds/months) — the numbers the bench probe gauges and gates."""
+    uni = synthesize_universe(spec, factor_sampler)
+    res = run_walkforward(uni.factors, uni.hfd, uni.rf, wf, cfg,
+                          latent_dims, out_dir, resume=resume)
+    res["stats"].update(funds=spec.funds, months=spec.months,
+                        n_factors=spec.n_factors)
+    return res

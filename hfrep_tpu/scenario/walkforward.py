@@ -1,0 +1,404 @@
+"""Walk-forward regime sweeps: the AE sweep rolled forward in time.
+
+The paper estimates once on one split.  Here the sweep re-estimates at
+every roll of an expanding window — window *w* trains on the first
+``start + w·step`` months and is scored out-of-sample on the next
+``horizon`` months — and ALL (window × latent) instances train as lanes
+of ONE padded program (:func:`~hfrep_tpu.replication.engine.
+sweep_autoencoders_multi`): the ragged per-window row counts are
+exactly what the padded fabric's mask operand exists for.  Evaluation
+runs at a FIXED horizon so one compiled program scores every window.
+
+Resume discipline (PR-5): the fused training drive snapshots at chunk
+boundaries (``ChunkSnapshot``, fingerprint-guarded), the trained lane
+grid is persisted once as an atomic artifact so an eval-phase kill
+never retrains, and per-window scores publish atomically — a resumed
+run recomputes only the gap and the final surface is bit-identical to
+an uninterrupted one (pinned by ``tests/test_scenario.py`` and the
+``tools/bench_scenario.py --self-test`` replay).
+
+Artifacts under ``out_dir``::
+
+    windows/w_<i>/scores.npz     per-window sharpe surfaces (atomic)
+    walkforward.json             spec + per-window digests + summary
+    walkforward.csv              sharpe_post surface (window × latent)
+    walkforward_ante.csv         sharpe_ante surface
+    _resume/                     chunk snapshot + trained-grid artifact
+                                 (cleared on completion)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hfrep_tpu.config import AEConfig
+
+TRAINED_GRID = "trained_grid"
+MANIFEST = "walkforward.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class WalkForwardSpec:
+    """The roll schedule.  ``start``: training months of the first
+    window; ``step``: months the training window grows per roll;
+    ``horizon``: fixed OOS months scored per window (fixed ⇒ one
+    compiled eval program serves every window)."""
+
+    start: int
+    n_windows: int
+    horizon: int
+    step: int = 1
+
+    def train_rows(self, w: int) -> int:
+        return self.start + w * self.step
+
+    @property
+    def lanes_per_window_note(self) -> str:
+        return "lanes = n_windows x len(latent_dims)"
+
+
+def validate_spec(spec: WalkForwardSpec, cfg: AEConfig,
+                  total_months: int) -> None:
+    """Refuse schedules the padded semantics would silently corrupt.
+
+    In particular a window shorter than its own validation split — zero
+    fit rows or zero validation rows under the Keras
+    ``validation_split`` boundary — must raise here, not truncate into a
+    lane that trains on nothing (the padded program would happily run
+    it: every batch fully masked, NaN-free, wrong).
+    """
+    if spec.start < 1 or spec.n_windows < 1 or spec.step < 1:
+        raise ValueError(f"degenerate walk-forward spec {spec}")
+    if spec.horizon < cfg.ols_window + 2:
+        raise ValueError(
+            f"horizon {spec.horizon} too short: the ex-ante strategy "
+            f"needs > ols_window + 1 = {cfg.ols_window + 1} OOS months "
+            "(rolling betas plus one realized month)")
+    need = spec.train_rows(spec.n_windows - 1) + spec.horizon
+    if need > total_months:
+        raise ValueError(
+            f"walk-forward needs {need} months (last window "
+            f"{spec.train_rows(spec.n_windows - 1)} train + "
+            f"{spec.horizon} horizon) but the panel has {total_months}")
+    for w in (0, spec.n_windows - 1):
+        rows = spec.train_rows(w)
+        n_fit = int(rows * (1.0 - cfg.val_split))
+        if n_fit < 1 or rows - n_fit < 1:
+            raise ValueError(
+                f"window {w} has {rows} training months — shorter than "
+                f"its own validation split (val_split={cfg.val_split} "
+                f"leaves fit={n_fit}, val={rows - n_fit}); walk-forward "
+                "refuses rather than truncating the split")
+
+
+def _fingerprint(spec: WalkForwardSpec, cfg: AEConfig,
+                 latent_dims: Sequence[int], x, y, rf) -> dict:
+    from hfrep_tpu.resilience.snapshot import digest_arrays
+    return {"spec": list(dataclasses.astuple(spec)),
+            "cfg": [str(v) for v in dataclasses.astuple(cfg)],
+            "latent_dims": [int(d) for d in latent_dims],
+            "data": digest_arrays(x, y, rf)}
+
+
+def _train_grid(key, x, spec: WalkForwardSpec, cfg: AEConfig,
+                latent_dims: Sequence[int],
+                resume_dir: Optional[str] = None):
+    """Train every (window, latent) lane as ONE padded program.
+
+    Expanding prefixes are MinMax-scaled each with their OWN train-set
+    params (ReplicationEngine semantics), stacked ragged
+    (:func:`~hfrep_tpu.replication.engine.stack_padded`) and driven
+    through the multi-dataset fabric.  Returns ``(AEResult, ChunkStats,
+    n_rows)`` with the result's arrays leading ``(n_windows, L)``.
+    Exposed for the padded-vs-dense numerics pin: lane *w* is
+    bit-identical to ``sweep_autoencoders_padded`` of the same prefix
+    padded to the same T_max under ``jax.random.split(key,
+    n_windows)[w]`` (the PR-4 equivalence, re-pinned for ragged
+    expanding windows by ``tests/test_scenario.py``).
+    """
+    import jax.numpy as jnp
+
+    from hfrep_tpu.core import scaler as mm
+    from hfrep_tpu.replication.engine import (
+        stack_padded,
+        sweep_autoencoders_multi,
+    )
+
+    prefixes = []
+    for w in range(spec.n_windows):
+        _, scaled = mm.fit_transform(jnp.asarray(x[:spec.train_rows(w)],
+                                                 jnp.float32))
+        prefixes.append(scaled)
+    x_stack, n_rows = stack_padded(prefixes)
+    res, stats = sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
+                                          list(latent_dims),
+                                          resume_dir=resume_dir)
+    return res, stats, n_rows
+
+
+def _save_grid(path, res, fingerprint: dict) -> None:
+    import jax
+
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    arrays = {f"param_{k}": np.asarray(jax.device_get(v))
+              for k, v in sorted(res.params.items())}
+    arrays["stop_epoch"] = np.asarray(jax.device_get(res.stop_epoch))
+    arrays["train_loss"] = np.asarray(jax.device_get(res.train_loss))
+    arrays["val_loss"] = np.asarray(jax.device_get(res.val_loss))
+
+    def writer(tmp: Path) -> None:
+        np.savez(tmp / "grid.npz", **arrays)
+
+    ckpt.write_atomic(path, writer,
+                      metadata={"fingerprint": fingerprint},
+                      io_site="snapshot_save", fault_site="snapshot")
+
+
+def _load_grid(path, fingerprint: dict):
+    """The persisted trained lane grid, or None when absent / corrupt /
+    from a different (spec, cfg, data) — degrade to retraining, never
+    trust a foreign artifact."""
+    from hfrep_tpu.replication.engine import AEResult
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    p = Path(path)
+    if not (p / ckpt.META_NAME).exists():
+        return None
+    try:
+        meta = ckpt.verify(p)
+    except ckpt.CheckpointCorrupt:
+        return None
+    if meta is None or meta.get("fingerprint") != fingerprint:
+        return None
+    with np.load(p / "grid.npz") as z:
+        arrays = {k: z[k] for k in z.files}
+    params = {k[len("param_"):]: arrays[k] for k in arrays
+              if k.startswith("param_")}
+    return AEResult(params=params, stop_epoch=arrays["stop_epoch"],
+                    train_loss=arrays["train_loss"],
+                    val_loss=arrays["val_loss"])
+
+
+def _make_window_eval(cfg: AEConfig):
+    """ONE jitted program scoring a whole window's latent lanes:
+    ``fn(params, masks, x_test, y_test, rf_t, factor_tail) →
+    (sharpe_ante (L, S), sharpe_post (L, S))``.  Every operand is traced
+    (never baked), and the horizon is fixed across windows, so the
+    program compiles once and serves all of them."""
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu.core import costs
+    from hfrep_tpu.replication import perf_stats
+    from hfrep_tpu.replication.engine import _ae_model, ante_weights
+
+    model = _ae_model(cfg)
+    window = cfg.ols_window
+
+    def one(params, mask, x_test, y_test, rf_t, factor_tail):
+        ante, weights = ante_weights(model, cfg, params, mask, x_test,
+                                     y_test, rf_t, window)
+        post = costs.ex_post_return(ante, window,
+                                    jnp.transpose(weights, (2, 0, 1)),
+                                    factor_tail)
+        p = ante.shape[0]
+        rf_tail = jnp.reshape(rf_t, (-1,))[-p:]
+        return (perf_stats.annualized_sharpe(ante, rf_tail),
+                perf_stats.annualized_sharpe(post, rf_tail))
+
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, None, None, None)))
+
+
+def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
+                    latent_dims: Sequence[int], out_dir,
+                    resume: bool = False,
+                    key=None) -> dict:
+    """The full drive: batched padded training → per-window scoring →
+    surface assembly.  Returns ``{"surface_post", "surface_ante",
+    "manifest", "stats"}``; raises
+    :class:`~hfrep_tpu.resilience.Preempted` on a drain (state is
+    always on disk — chunk snapshots, the trained grid, per-window
+    scores — so ANY re-run continues from the last boundary with final
+    artifacts bit-identical to an uninterrupted run, pinned; foreign
+    state refuses).  ``resume`` is accepted for CLI symmetry; reuse is
+    fingerprint-gated either way."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu import resilience
+    from hfrep_tpu.models.autoencoder import latent_mask
+    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    latent_dims = [int(d) for d in latent_dims]
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.float32)
+    rf = np.asarray(rf, np.float32).reshape(-1)
+    validate_spec(spec, cfg, x.shape[0])
+    if y.shape[0] != x.shape[0] or rf.shape[0] != x.shape[0]:
+        raise ValueError(f"x/y/rf months disagree: {x.shape[0]}, "
+                         f"{y.shape[0]}, {rf.shape[0]}")
+    cfg = dataclasses.replace(cfg, n_factors=int(x.shape[1]),
+                              latent_dim=max(latent_dims))
+    out = Path(out_dir)
+    windows_dir = out / "windows"
+    windows_dir.mkdir(parents=True, exist_ok=True)
+    resume_root = out / "_resume"
+    fingerprint = _fingerprint(spec, cfg, latent_dims, x, y, rf)
+    obs = get_obs()
+
+    # State persistence is unconditional — chunk snapshots during
+    # training, the trained grid once after it — so the documented
+    # fresh-run → SIGTERM → ``--resume`` flow really resumes (a first
+    # run without the flag must not silently discard its progress).
+    # ``resume`` itself is advisory: same-fingerprint state is always
+    # safe to reuse (bit-identical by construction), foreign state is
+    # always refused.
+    key = key if key is not None else jax.random.PRNGKey(cfg.seed)
+    t0 = time.perf_counter()
+    grid = _load_grid(resume_root / TRAINED_GRID, fingerprint)
+    stats = None
+    if grid is None:
+        resume_root.mkdir(parents=True, exist_ok=True)
+        grid, stats, _ = _train_grid(
+            key, x, spec, cfg, latent_dims,
+            resume_dir=str(resume_root / "chunks"))
+        _save_grid(resume_root / TRAINED_GRID, grid, fingerprint)
+    train_secs = time.perf_counter() - t0
+
+    masks = jnp.stack([latent_mask(d, cfg.latent_dim)
+                       for d in latent_dims])
+    eval_fn = _make_window_eval(cfg)
+    horizon, ols = spec.horizon, cfg.ols_window
+    p_months = horizon - ols - 1
+    digests: Dict[str, str] = {}
+    surface_post = np.empty((spec.n_windows, len(latent_dims), y.shape[1]),
+                            np.float32)
+    surface_ante = np.empty_like(surface_post)
+    t1 = time.perf_counter()
+    with resilience.graceful_drain():
+        for w in range(spec.n_windows):
+            name = f"w_{w:04d}"
+            dst = windows_dir / name
+            meta = None
+            if (dst / ckpt.META_NAME).exists():
+                try:
+                    meta = ckpt.verify(dst)
+                except ckpt.CheckpointCorrupt:
+                    meta = None
+                if meta is not None and meta.get("fingerprint") != \
+                        fingerprint:
+                    raise ValueError(
+                        f"{dst} holds scores from a DIFFERENT walk-"
+                        "forward (spec/cfg/data differ) — remove the "
+                        "out dir or use a fresh one")
+            if meta is None:
+                e = spec.train_rows(w)
+                params_w = jax.tree_util.tree_map(lambda a, d=w: a[d],
+                                                  grid.params)
+                sa, sp = eval_fn(
+                    params_w, masks,
+                    jnp.asarray(x[e:e + horizon]),
+                    jnp.asarray(y[e:e + horizon]),
+                    jnp.asarray(rf[e:e + horizon]),
+                    jnp.asarray(x[e + horizon - (p_months + ols):
+                                  e + horizon]))
+                sa = np.asarray(jax.device_get(sa), np.float32)
+                sp = np.asarray(jax.device_get(sp), np.float32)
+
+                def writer(tmp: Path, a=sa, p=sp, d=w) -> None:
+                    np.savez(tmp / "scores.npz", sharpe_ante=a,
+                             sharpe_post=p,
+                             stop_epoch=np.asarray(grid.stop_epoch[d]))
+
+                ckpt.write_atomic(dst, writer,
+                                  metadata={"fingerprint": fingerprint,
+                                            "window": w,
+                                            "train_rows": int(e)},
+                                  io_site="snapshot_save",
+                                  fault_site="snapshot")
+                meta = ckpt.read_meta(dst)
+                if obs.enabled:
+                    obs.event("walkforward_window", window=w,
+                              train_rows=int(e),
+                              digest=meta["checksum"]["digest"])
+            with np.load(dst / "scores.npz") as z:
+                surface_ante[w] = z["sharpe_ante"]
+                surface_post[w] = z["sharpe_post"]
+            digests[name] = meta["checksum"]["digest"]
+            # the window boundary: a requested drain exits here with
+            # every published score intact (resume recomputes the gap)
+            resilience.boundary("window")
+    eval_secs = time.perf_counter() - t1
+
+    manifest = _assemble(out, spec, cfg, latent_dims, digests,
+                         surface_post, surface_ante)
+    shutil.rmtree(resume_root, ignore_errors=True)
+    lanes = spec.n_windows * len(latent_dims)
+    rows = [spec.train_rows(w) for w in range(spec.n_windows)]
+    run_stats = {
+        # panel dimensions ride along so the comparability-key
+        # annotation is never None-shaped (a real-panel walk-forward and
+        # a fixture one must index different scn* series)
+        "funds": int(y.shape[1]),
+        "months": int(x.shape[0]),
+        "lanes": lanes,
+        "pad_waste_frac": float(1.0 - (sum(rows) / (len(rows)
+                                                    * max(rows)))),
+        "train_secs": round(train_secs, 3),
+        "eval_secs": round(eval_secs, 3),
+        "windows_per_sec": round(spec.n_windows
+                                 / max(train_secs + eval_secs, 1e-9), 3),
+        "chunk_stats": stats._asdict() if stats is not None else None,
+    }
+    return {"surface_post": surface_post, "surface_ante": surface_ante,
+            "manifest": manifest, "stats": run_stats}
+
+
+def _assemble(out: Path, spec: WalkForwardSpec, cfg: AEConfig,
+              latent_dims: List[int], digests: Dict[str, str],
+              surface_post: np.ndarray,
+              surface_ante: np.ndarray) -> dict:
+    """The deterministic outputs: mean-over-strategy sharpe surfaces as
+    CSV (window-start rows × latent columns) and the digest-indexed
+    ``walkforward.json`` — byte-stable across resumes (no timings, no
+    host identity; the bit-identity pin compares these files)."""
+    import pandas as pd
+
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    idx = pd.Index([spec.train_rows(w) for w in range(spec.n_windows)],
+                   name="train_rows")
+    cols = [f"latent_{d}" for d in latent_dims]
+    for fname, surf in (("walkforward.csv", surface_post),
+                        ("walkforward_ante.csv", surface_ante)):
+        pd.DataFrame(surf.mean(axis=2), index=idx, columns=cols).to_csv(
+            out / fname)
+    mean_post = surface_post.mean(axis=2)
+    best = [{"train_rows": int(idx[w]),
+             "latent": int(latent_dims[int(np.argmax(mean_post[w]))]),
+             "sharpe_post": round(float(np.max(mean_post[w])), 9)}
+            for w in range(spec.n_windows)]
+    manifest = {
+        "spec": dataclasses.asdict(spec),
+        "latent_dims": latent_dims,
+        "ols_window": cfg.ols_window,
+        "windows": digests,
+        "aggregate_digest": ckpt.aggregate_digest(digests),
+        "summary": {"best_latent_by_window": best,
+                    "mean_sharpe_post": round(float(mean_post.mean()), 9)},
+    }
+    tmp = out / f".{MANIFEST}.tmp-{os.getpid()}"
+    tmp.write_text(json.dumps(manifest, indent=2, sort_keys=True))
+    os.replace(tmp, out / MANIFEST)
+    return manifest

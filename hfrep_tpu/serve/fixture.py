@@ -26,15 +26,12 @@ def fixture_ae_model(feats: int = 16, rows: int = 96, latent: int = 8,
     """Train the fixture replication head (cached per shape — the bench
     and the self-test reuse one training)."""
     import jax
-    import jax.numpy as jnp
-    from hfrep_tpu.core import scaler as mm
     from hfrep_tpu.replication.engine import train_autoencoder_chunked
+    from hfrep_tpu.utils.fixture_data import scaled_panel
 
-    g = np.random.default_rng(seed + 17)
-    z = g.normal(size=(rows, 3))
-    x = (z @ g.normal(size=(3, feats))
-         + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
-    _, scaled = mm.fit_transform(jnp.asarray(x))
+    # shared builder; seed+17 is this fixture's pinned stream (the AOT
+    # export round-trip pins compare programs built on these exact bytes)
+    scaled = scaled_panel(rows, feats, seed=seed + 17)
     cfg = AEConfig(n_factors=feats, latent_dim=min(latent, feats),
                    epochs=epochs, batch_size=32, patience=3, seed=seed,
                    chunk_epochs=10)

@@ -38,6 +38,28 @@ def make_optimizers(pair: GanPair, tcfg: TrainConfig) -> Tuple[optax.GradientTra
     return opt(), opt()
 
 
+def init_conditional_state(key: jax.Array, mcfg: ModelConfig,
+                           tcfg: TrainConfig, pair: GanPair,
+                           cond_dim: int) -> GanState:
+    """:func:`init_gan_state` for a conditional pair: init traces the
+    ``(input, cond)`` signature so the first Dense/LSTM layers come up
+    ``features + cond_dim`` wide.  Same key discipline (kg for G, kd for
+    D) as the unconditional init."""
+    kg, kd = jax.random.split(key)
+    dummy = jnp.zeros((1, mcfg.window, mcfg.features), jnp.float32)
+    cond = jnp.zeros((1, cond_dim), jnp.float32)
+    g_params = pair.generator.init(kg, dummy, cond)["params"]
+    d_params = pair.discriminator.init(kd, dummy, cond)["params"]
+    g_tx, d_tx = make_optimizers(pair, tcfg)
+    return GanState(
+        g_params=g_params,
+        d_params=d_params,
+        g_opt=g_tx.init(g_params),
+        d_opt=d_tx.init(d_params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
 def init_gan_state(key: jax.Array, mcfg: ModelConfig, tcfg: TrainConfig,
                    pair: GanPair | None = None) -> GanState:
     if pair is None:

@@ -400,6 +400,134 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     return {"bce": bce_step, "wgan_clip": wgan_step, "wgan_gp": wgan_gp_step}[pair.loss]
 
 
+def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
+                          dataset: jnp.ndarray,
+                          conditions: jnp.ndarray) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
+    """Conditional (cGAN) epoch step for the scenario factory.
+
+    ``pair`` is a :func:`~hfrep_tpu.models.registry.build_conditional_gan`
+    pair whose members take ``(input, cond)``; ``conditions`` is the
+    (N, C) per-window condition matrix aligned row-for-row with
+    ``dataset`` (:func:`hfrep_tpu.scenario.regimes.window_conditions`).
+    Real batches ride with their own condition vectors (one gather
+    serves both), fakes are generated — and scored — under the same
+    conditions, so the critic only ever compares real and synthetic
+    windows *of the same regime*.  Loss semantics per family are the
+    unconditional step's; this builder deliberately leaves out the
+    mesh/fusion machinery (the scenario drives are single-host by
+    design), and the unconditional :func:`make_train_step` is untouched
+    — conditioning OFF remains the literal pre-scenario program (pinned
+    at jaxpr level by ``tests/test_scenario.py``).
+    """
+    g_tx, d_tx = make_optimizers(pair, tcfg)
+    acc = pair.policy.accum
+    be = resolve_lstm_backend(tcfg.lstm_backend)
+    conditions = jnp.asarray(conditions, jnp.float32)
+    if conditions.ndim != 2 or conditions.shape[0] != dataset.shape[0]:
+        raise ValueError(
+            f"conditions {conditions.shape} do not align with dataset "
+            f"{dataset.shape}: one condition vector per training window")
+    g_apply = lambda p, z, c: pair.generator.apply({"params": p}, z, c,
+                                                   backend=be)
+    d_apply = lambda p, x, c: pair.discriminator.apply({"params": p}, x, c,
+                                                       backend=be)
+    batch = tcfg.batch_size
+    window, features = dataset.shape[1], dataset.shape[2]
+
+    def _real(key):
+        idx = jax.random.randint(key, (batch,), 0, dataset.shape[0])
+        return (jnp.take(dataset, idx, axis=0),
+                jnp.take(conditions, idx, axis=0))
+
+    def _noise(key):
+        return jax.random.normal(key, (batch, window, features))
+
+    def d_update(d_params, d_opt, loss_fn):
+        loss, grads = jax.value_and_grad(loss_fn)(d_params)
+        updates, d_opt = d_tx.update(grads, d_opt, d_params)
+        return optax.apply_updates(d_params, updates), d_opt, loss
+
+    def g_update(state: GanState, loss_fn):
+        loss, grads = jax.value_and_grad(loss_fn)(state.g_params)
+        updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
+        return state.replace(
+            g_params=optax.apply_updates(state.g_params, updates),
+            g_opt=g_opt, step=state.step + 1), loss
+
+    def bce_step(state: GanState, key: jax.Array):
+        k_idx, k_z1, k_z2 = jax.random.split(key, 3)
+        real, cond = _real(k_idx)
+        fake = lax.stop_gradient(g_apply(state.g_params, _noise(k_z1), cond))
+        d_params, d_opt, l_real = d_update(
+            state.d_params, state.d_opt,
+            lambda p: _bce_logits(acc(d_apply(p, real, cond)), 1.0))
+        d_params, d_opt, l_fake = d_update(
+            d_params, d_opt,
+            lambda p: _bce_logits(acc(d_apply(p, fake, cond)), 0.0))
+        state = state.replace(d_params=d_params, d_opt=d_opt)
+        state, g_loss = g_update(state, lambda p: _bce_logits(
+            acc(d_apply(state.d_params, g_apply(p, _noise(k_z2), cond),
+                        cond)), 1.0))
+        return state, {"d_loss": 0.5 * (l_real + l_fake), "g_loss": g_loss}
+
+    clip, gp_w = tcfg.clip_value, tcfg.gp_weight
+
+    def _wasserstein_step(state: GanState, key: jax.Array, with_gp: bool):
+        def critic_iter(i, carry):
+            d_params, d_opt, _ = carry
+            ki = jax.random.fold_in(key, i)
+            k_idx, k_z, k_a = jax.random.split(ki, 3)
+            real, cond = _real(k_idx)
+            fake = lax.stop_gradient(
+                g_apply(state.g_params, _noise(k_z), cond))
+            if with_gp:
+                alpha = jax.random.uniform(k_a, (batch, 1, 1))
+                interp = alpha * real + (1.0 - alpha) * fake
+
+                def loss_fn(p):
+                    scores = acc(d_apply(
+                        p, jnp.concatenate([real, fake], axis=0),
+                        jnp.concatenate([cond, cond], axis=0)))
+                    gp = gradient_penalty(
+                        lambda pp, x: d_apply(pp, x, cond), p, interp)
+                    return (jnp.mean(-scores[:batch])
+                            + jnp.mean(scores[batch:]) + gp_w * gp)
+
+                d_params, d_opt, loss = d_update(d_params, d_opt, loss_fn)
+            else:
+                d_params, d_opt, l_real = d_update(
+                    d_params, d_opt,
+                    lambda p: jnp.mean(-acc(d_apply(p, real, cond))))
+                d_params, d_opt, l_fake = d_update(
+                    d_params, d_opt,
+                    lambda p: jnp.mean(acc(d_apply(p, fake, cond))))
+                d_params = jax.tree_util.tree_map(
+                    lambda w: jnp.clip(w, -clip, clip), d_params)
+                loss = 0.5 * (l_real + l_fake)
+            return d_params, d_opt, loss
+
+        d_params, d_opt, d_loss = lax.fori_loop(
+            0, tcfg.n_critic, critic_iter,
+            (state.d_params, state.d_opt, jnp.zeros(())))
+        state = state.replace(d_params=d_params, d_opt=d_opt)
+        # the generator trains on the final critic iteration's sampling
+        # streams, mirroring the unconditional step's noise reuse
+        kl = jax.random.fold_in(key, tcfg.n_critic - 1)
+        k_idx, k_z, _ = jax.random.split(kl, 3)
+        _, cond_g = _real(k_idx)
+        noise_g = _noise(k_z)
+        state, g_loss = g_update(state, lambda p: jnp.mean(
+            -acc(d_apply(state.d_params, g_apply(p, noise_g, cond_g),
+                         cond_g))))
+        return state, {"d_loss": d_loss, "g_loss": g_loss}
+
+    if pair.loss == "bce":
+        return bce_step
+    if pair.loss == "wgan_clip":
+        return lambda state, key: _wasserstein_step(state, key, False)
+    return lambda state, key: _wasserstein_step(state, key, True)
+
+
 def make_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                     axis_name: Optional[str] = None, jit: bool = True,
                     sample_batch: Optional[int] = None,

@@ -1,0 +1,115 @@
+"""Shared fabricated-data fixture builders.
+
+One home for the deterministic synthetic panels every selftest, CLI
+fixture and test suite previously hand-rolled (three byte-divergent
+copies: ``tests/test_orchestrate.py``, ``resilience/selftest.py``,
+``serve/fixture.py``).  The RNG streams here are pinned: each builder
+consumes its generator in exactly the order the original copies did, so
+the artifacts (and every bit-identity pin built on them) are
+byte-compatible with the pre-dedupe fixtures.
+
+Stdlib + numpy only at import time; jax/pandas are imported inside the
+builders that need them so the module stays cheap for worker bootstrap.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def low_rank_returns(g: np.random.Generator, rows: int, feats: int,
+                     rank: int = 3) -> np.ndarray:
+    """The shared return-panel core: ``rank`` latent factors mixed into
+    ``feats`` observed columns plus idiosyncratic noise, scaled to
+    monthly-return magnitude.  Consumes ``g`` in the pinned order
+    (z, mix, noise) — every caller's byte-compatibility depends on it."""
+    z = g.normal(size=(rows, rank))
+    return (z @ g.normal(size=(rank, feats))
+            + 0.05 * g.normal(size=(rows, feats))).astype(np.float32) * 0.02
+
+
+def scaled_panel(rows: int, feats: int, *, seed: int, rank: int = 3):
+    """MinMax-scaled low-rank panel as a jnp array — the resilience
+    selftest's ``_fixture_panel`` (seed 11) and the serve fixture's
+    training panel (seed+17) share this builder."""
+    import jax.numpy as jnp
+
+    from hfrep_tpu.core import scaler as mm
+
+    x = low_rank_returns(np.random.default_rng(seed), rows, feats, rank)
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    return scaled
+
+
+def keyed_scaled_panel(stream_seed: int, source_idx: int, seq: int,
+                       rows: int, feats: int, rank: int = 3) -> np.ndarray:
+    """Numpy-scaled low-rank panel seeded by a full (stream, source, seq)
+    coordinate — the orchestration fabric's fixture item: unique per
+    coordinate yet reproducible on any member (the kill→resume
+    bit-identity contract)."""
+    g = np.random.default_rng((stream_seed, source_idx, seq))
+    x = low_rank_returns(g, rows, feats, rank)
+    lo, hi = x.min(axis=0), x.max(axis=0)
+    scale = np.where(hi - lo == 0.0, 1.0, hi - lo)
+    return ((x - lo) / scale).astype(np.float32)
+
+
+def write_cleaned_fixture(d, months: int = 96, seed: int = 5) -> None:
+    """A fabricated ``cleaned_data/`` directory shaped like the real one
+    (22 factors, 13 HF indices, 1 rf, Date index) — loadable by
+    ``core.data.load_panel``.  Seed 5 reproduces the byte-exact fixture
+    the orchestration CLI tests pinned their artifacts against."""
+    import pandas as pd
+
+    from hfrep_tpu.core.data import dic_save
+    from pathlib import Path
+
+    d = Path(d)
+    d.mkdir(parents=True, exist_ok=True)
+    g = np.random.default_rng(seed)
+    dates = pd.date_range("2000-01-31", periods=months, freq="ME")
+    fac = [f"F{j}" for j in range(22)]
+    hf = [f"H{j}" for j in range(13)]
+    mix = g.normal(size=(22, 13)) * 0.3
+    x = g.normal(0, 0.03, (months, 22))
+    y = x @ mix + g.normal(0, 0.01, (months, 13))
+    for name, cols, vals in (
+            ("factor_etf_data.csv", fac, x),
+            ("hfd.csv", hf, y),
+            ("rf.csv", ["RF"], np.abs(g.normal(0.002, 5e-4, (months, 1))))):
+        df = pd.DataFrame(vals.astype(np.float32), columns=cols)
+        df.insert(0, "Date", dates)
+        df.to_csv(d / name, index=False)
+    dic_save({c: c for c in hf}, d / "hfd_fullname.pkl")
+    dic_save({c: c for c in fac}, d / "factor_etf_name.pkl")
+
+
+def fund_cross_section(factors: np.ndarray, seed: int,
+                       funds: int) -> Tuple[np.ndarray, np.ndarray]:
+    """``(hfd, rf)`` for an arbitrary factor panel: the fund mix/noise
+    stream is seeded independently of the factor VALUES, so swapping the
+    factor source (fixture model vs GAN samples) leaves the fund
+    cross-section construction unchanged — the one implementation both
+    universe paths share (its draw order is part of the determinism
+    contract)."""
+    months, n_factors = factors.shape
+    g_mix = np.random.default_rng((seed, months, funds, 1))
+    mix = (g_mix.normal(size=(n_factors, funds)) * 0.3).astype(np.float32)
+    hfd = (factors @ mix
+           + 0.01 * g_mix.normal(size=(months, funds))).astype(np.float32)
+    rf = np.abs(g_mix.normal(0.002, 5e-4, months)).astype(np.float32)
+    return hfd, rf
+
+
+def universe_arrays(seed: int, funds: int, months: int,
+                    n_factors: int = 22,
+                    rank: int = 4) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+    """Synthetic ``(factors, hfd, rf)`` universe of arbitrary size — the
+    scenario factory's fixture generator (``scenario/universe.py``)."""
+    g_fac = np.random.default_rng((seed, months, n_factors, 0))
+    factors = low_rank_returns(g_fac, months, n_factors, rank)
+    hfd, rf = fund_cross_section(factors, seed, funds)
+    return factors, hfd, rf

@@ -147,6 +147,40 @@ class TestPublishedParity:
         assert max(counts, key=counts.get) == "21"
 
 
+class TestSourceLabels:
+    """Regression (ISSUE 9 satellite): per-dataset output subdirs and
+    sampling keys derive from a STABLE source label, not the flag
+    position — reordering --gan-checkpoint flags must not remap which
+    seed samples which generator or which subdir holds whose artifacts."""
+
+    def test_labels_are_stems_not_positions(self):
+        paths = ["/ck/run_a/ckpt_500", "/ck/run_b/model.h5"]
+        assert aug_mod.source_labels(paths) == ["ckpt_500", "model"]
+
+    def test_reordering_preserves_label_and_key_mapping(self):
+        paths = ["/ck/alpha/ckpt_100", "/ck/beta/ckpt_200"]
+        fwd = dict(zip(paths, aug_mod.source_labels(paths)))
+        rev = dict(zip(paths[::-1], aug_mod.source_labels(paths[::-1])))
+        assert fwd == rev
+        for p in paths:
+            k1 = aug_mod.source_sample_key(fwd[p])
+            k2 = aug_mod.source_sample_key(rev[p])
+            assert np.array_equal(np.asarray(k1), np.asarray(k2))
+        # distinct sources draw distinct sampling streams
+        keys = [np.asarray(aug_mod.source_sample_key(v))
+                for v in fwd.values()]
+        assert not np.array_equal(keys[0], keys[1])
+
+    def test_colliding_stems_disambiguate_by_path_not_order(self):
+        paths = ["/ck/run_a/ckpt_500", "/ck/run_b/ckpt_500"]
+        fwd = dict(zip(paths, aug_mod.source_labels(paths)))
+        rev = dict(zip(paths[::-1], aug_mod.source_labels(paths[::-1])))
+        assert fwd == rev
+        assert len(set(fwd.values())) == 2
+        with pytest.raises(ValueError, match="duplicate"):
+            aug_mod.source_labels(["/same", "/same"])
+
+
 class TestAugment:
     def test_split_cube_with_rf(self):
         cube = jnp.arange(2 * 4 * 36, dtype=jnp.float32).reshape(2, 4, 36)

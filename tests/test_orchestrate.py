@@ -343,28 +343,10 @@ class TestMalformedSpecRaisesPerDrive:
 
 
 # --------------------------------------- CLI: second SIGTERM mid-drain
-def _write_cleaned_fixture(d: Path, months: int = 96) -> None:
-    """A fabricated cleaned_data/ directory shaped like the real one
-    (22 factors, 13 HF indices, 1 rf, Date index)."""
-    from hfrep_tpu.core.data import dic_save
-
-    d.mkdir(parents=True, exist_ok=True)
-    g = np.random.default_rng(5)
-    dates = pd.date_range("2000-01-31", periods=months, freq="ME")
-    fac = [f"F{j}" for j in range(22)]
-    hf = [f"H{j}" for j in range(13)]
-    mix = g.normal(size=(22, 13)) * 0.3
-    x = g.normal(0, 0.03, (months, 22))
-    y = x @ mix + g.normal(0, 0.01, (months, 13))
-    for name, cols, vals in (
-            ("factor_etf_data.csv", fac, x),
-            ("hfd.csv", hf, y),
-            ("rf.csv", ["RF"], np.abs(g.normal(0.002, 5e-4, (months, 1))))):
-        df = pd.DataFrame(vals.astype(np.float32), columns=cols)
-        df.insert(0, "Date", dates)
-        df.to_csv(d / name, index=False)
-    dic_save({c: c for c in hf}, d / "hfd_fullname.pkl")
-    dic_save({c: c for c in fac}, d / "factor_etf_name.pkl")
+# the fabricated cleaned_data/ builder lives in utils/fixture_data now
+# (shared with the resilience selftest and the serve fixture); the seed-5
+# stream keeps this module's pinned artifacts byte-identical
+from hfrep_tpu.utils.fixture_data import write_cleaned_fixture as _write_cleaned_fixture  # noqa: E501
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,481 @@
+"""Scenario factory (ISSUE 9): regime labeling, conditional-off jaxpr
+identity, conditional train-step plumbing, deterministic scenario banks,
+walk-forward validation + padded-vs-dense numerics (ragged expanding
+windows through the multi fabric), CLI preempt→exit-75→resume
+bit-identity, scenario pipeline sources, and the obs schema (scn* key,
+gauge prefixes, explicit regress directions)."""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.resilience as res
+from hfrep_tpu.config import AEConfig, ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_conditional_gan, build_gan
+from hfrep_tpu.scenario import regimes as reg
+from hfrep_tpu.scenario import conditional as cond_mod
+from hfrep_tpu.scenario.walkforward import (
+    WalkForwardSpec,
+    _train_grid,
+    run_walkforward,
+    validate_spec,
+)
+from hfrep_tpu.utils import checkpoint as ckpt
+from hfrep_tpu.utils.fixture_data import universe_arrays
+
+
+@pytest.fixture(autouse=True)
+def _pristine_fault_state(monkeypatch):
+    res.clear_plan()
+    monkeypatch.setattr(res, "_env_consumed", False)
+    monkeypatch.delenv(res.ENV_FAULTS, raising=False)
+    yield
+    res.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def small_universe():
+    return universe_arrays(0, funds=6, months=64, n_factors=6)
+
+
+SMALL_CFG = AEConfig(n_factors=6, latent_dim=4, epochs=6, batch_size=16,
+                     chunk_epochs=3, ols_window=6, patience=2)
+SMALL_SPEC = WalkForwardSpec(start=24, n_windows=6, horizon=10, step=2)
+SMALL_LATENTS = [1, 2, 4]
+
+
+# ------------------------------------------------------------------ regimes
+class TestRegimes:
+    def test_labels_shape_determinism_coverage(self):
+        x = np.random.default_rng(0).normal(size=(80, 6))
+        a = reg.label_regimes(x, 12, 3)
+        b = reg.label_regimes(x, 12, 3)
+        assert a.shape == (80,) and a.dtype == np.int32
+        assert np.array_equal(a, b)
+        # quantile edges come from the sample: every regime populated
+        assert set(np.unique(a)) == {0, 1, 2}
+
+    def test_one_hot_and_window_conditions(self):
+        oh = reg.one_hot([0, 2, 1], 3)
+        assert oh.shape == (3, 3) and oh.sum() == 3.0
+        assert np.array_equal(oh.argmax(axis=1), [0, 2, 1])
+        with pytest.raises(ValueError):
+            reg.one_hot([3], 3)
+        labels = np.array([0, 1, 2, 1, 0])
+        wc = reg.window_conditions(labels, window=3, n_regimes=3)
+        # window w is conditioned on the regime of its LAST month
+        assert np.array_equal(wc.argmax(axis=1), labels[2:])
+
+    def test_degenerate_inputs_raise(self):
+        with pytest.raises(ValueError):
+            reg.label_regimes(np.zeros((1, 3)), 12, 3)
+        with pytest.raises(ValueError):
+            reg.label_regimes(np.zeros((10, 3)), 12, 1)
+
+
+# ---------------------------------------------- conditional identity + step
+class TestConditionalIdentity:
+    @pytest.mark.parametrize("family", ["gan", "mtss_wgan_gp"])
+    def test_cond_off_is_the_literal_unconditional_jaxpr(self, family):
+        """cond_dim=0 must be the pre-scenario fp32 program — pinned at
+        jaxpr level for a dense and an LSTM family, generator AND
+        discriminator."""
+        cfg = ModelConfig(family=family, features=5, window=6, hidden=8)
+        base, off = build_gan(cfg), build_conditional_gan(cfg, 0)
+        z = jnp.zeros((2, 6, 5))
+        for get in (lambda p: p.generator, lambda p: p.discriminator):
+            params = get(base).init(jax.random.PRNGKey(0), z)["params"]
+            jx_base = str(jax.make_jaxpr(
+                lambda p, x: get(base).apply({"params": p}, x))(params, z))
+            jx_off = str(jax.make_jaxpr(
+                lambda p, x: get(off).apply({"params": p}, x))(params, z))
+            assert jx_base == jx_off
+
+    def test_cond_on_widens_the_input(self):
+        cfg = ModelConfig(family="gan", features=5, window=6, hidden=8)
+        pair = build_conditional_gan(cfg, 3)
+        z = jnp.zeros((2, 6, 5))
+        c = jnp.asarray(reg.one_hot([1, 2], 3))
+        params = pair.generator.init(jax.random.PRNGKey(0), z, c)["params"]
+        out = pair.generator.apply({"params": params}, z, c)
+        assert out.shape == (2, 6, 5)          # still emits `features`
+        # first dense layer initialized features + cond_dim = 8 wide
+        k0 = params["body"]["KerasDense_0"]["Dense_0"]["kernel"]
+        assert k0.shape == (8, 8)
+        with pytest.raises(ValueError):
+            pair.generator.apply({"params": params}, z, jnp.zeros((2, 2)))
+
+    @pytest.mark.parametrize("family", ["gan", "wgan", "wgan_gp"])
+    def test_conditional_step_trains(self, family):
+        from hfrep_tpu.train.states import init_conditional_state
+        from hfrep_tpu.train.steps import make_conditional_step
+
+        mcfg = ModelConfig(family=family, features=4, window=5, hidden=8)
+        tcfg = TrainConfig(batch_size=8, n_critic=2, seed=0)
+        pair = build_conditional_gan(mcfg, 2)
+        g = np.random.default_rng(1)
+        ds = jnp.asarray(g.normal(size=(32, 5, 4)).astype(np.float32))
+        conds = jnp.asarray(reg.one_hot(g.integers(0, 2, 32), 2))
+        state = init_conditional_state(jax.random.PRNGKey(0), mcfg, tcfg,
+                                       pair, 2)
+        step = jax.jit(make_conditional_step(pair, tcfg, ds, conds))
+        new, metrics = step(state, jax.random.PRNGKey(1))
+        assert np.isfinite(float(metrics["d_loss"]))
+        assert np.isfinite(float(metrics["g_loss"]))
+        before = jax.tree_util.tree_leaves(state.g_params)
+        after = jax.tree_util.tree_leaves(new.g_params)
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(before, after)), "G never updated"
+
+    def test_conditional_step_rejects_misaligned_conditions(self):
+        from hfrep_tpu.train.steps import make_conditional_step
+        mcfg = ModelConfig(family="gan", features=4, window=5, hidden=8)
+        pair = build_conditional_gan(mcfg, 2)
+        ds = jnp.zeros((32, 5, 4))
+        with pytest.raises(ValueError):
+            make_conditional_step(pair, TrainConfig(), ds,
+                                  jnp.zeros((31, 2)))
+
+
+# ------------------------------------------------------------------- banks
+class TestScenarioBank:
+    @pytest.fixture(scope="class")
+    def bundle(self):
+        return cond_mod.fixture_bundle(feats=6, window=12, n_regimes=3,
+                                       epochs=2)
+
+    def test_bank_deterministic_and_replayable(self, bundle, tmp_path):
+        m = cond_mod.generate_bank(bundle, tmp_path / "bank", blocks=2,
+                                   block_size=4, stream_seed=3)
+        # same seed+regime ⇒ identical digest, re-derived in memory
+        assert cond_mod.replay_block_digest(bundle, 3, 1, 0, 4) == \
+            m["block_digests"]["r1_00000"]
+        m2 = cond_mod.generate_bank(bundle, tmp_path / "bank", blocks=2,
+                                    block_size=4, stream_seed=3)
+        assert m2["generated"] == 0, "verified blocks must be skipped"
+        assert m2["aggregate_digest"] == m["aggregate_digest"]
+        manifest = json.loads((tmp_path / "bank" / "bank.json").read_text())
+        assert manifest["aggregate_digest"] == m["aggregate_digest"]
+
+    def test_rotted_block_regenerates(self, bundle, tmp_path):
+        out = tmp_path / "bank"
+        m = cond_mod.generate_bank(bundle, out, blocks=1, block_size=4,
+                                   stream_seed=3)
+        victim = out / "blocks" / "r0_00000" / "samples.npy"
+        victim.write_bytes(b"rot")
+        m2 = cond_mod.generate_bank(bundle, out, blocks=1, block_size=4,
+                                    stream_seed=3)
+        assert m2["generated"] == 1, "rotted block must regenerate"
+        assert m2["block_digests"] == m["block_digests"]
+
+    def test_bank_rejects_unknown_regime(self, bundle, tmp_path):
+        with pytest.raises(ValueError):
+            cond_mod.generate_bank(bundle, tmp_path, regimes=[7],
+                                   blocks=1, block_size=2)
+
+    def test_foreign_bank_state_refused(self, bundle, tmp_path):
+        """A dir banked under a different stream seed (or block size)
+        must refuse, not silently keep the old bytes under a manifest
+        claiming the new config."""
+        out = tmp_path / "bank"
+        cond_mod.generate_bank(bundle, out, blocks=1, block_size=4,
+                               stream_seed=3)
+        with pytest.raises(ValueError, match="DIFFERENT bank"):
+            cond_mod.generate_bank(bundle, out, blocks=1, block_size=4,
+                                   stream_seed=4)
+        with pytest.raises(ValueError, match="DIFFERENT bank"):
+            cond_mod.generate_bank(bundle, out, blocks=1, block_size=8,
+                                   stream_seed=3)
+
+    def test_train_conditional_deterministic_and_epoch_exact(self):
+        """Same args ⇒ same params, and the chunked drive must train
+        EXACTLY the requested epochs (the overshoot would change every
+        bank digest): epochs=0 is the literal initialized state."""
+        from hfrep_tpu.config import ModelConfig, TrainConfig
+        mcfg = ModelConfig(family="gan", features=4, window=5, hidden=8)
+        tcfg = TrainConfig(batch_size=8, n_critic=1, steps_per_call=2)
+        g = np.random.default_rng(2)
+        w = g.normal(size=(20, 5, 4)).astype(np.float32)
+        c = reg.one_hot(g.integers(0, 2, 20), 2)
+        b1 = cond_mod.train_conditional(mcfg, tcfg, w, c, 3, seed=1)
+        b2 = cond_mod.train_conditional(mcfg, tcfg, w, c, 3, seed=1)
+        for l1, l2 in zip(jax.tree_util.tree_leaves(b1.params),
+                          jax.tree_util.tree_leaves(b2.params)):
+            assert np.array_equal(l1, l2)
+        b0 = cond_mod.train_conditional(mcfg, tcfg, w, c, 0, seed=1)
+        from hfrep_tpu.train.states import init_conditional_state
+        init = init_conditional_state(jax.random.PRNGKey(1), mcfg, tcfg,
+                                      b0.pair, 2)
+        for l1, l2 in zip(jax.tree_util.tree_leaves(b0.params),
+                          jax.tree_util.tree_leaves(
+                              jax.device_get(init.g_params))):
+            assert np.array_equal(l1, l2)
+
+    def test_scenario_item_panel_is_pure_and_regime_keyed(self):
+        a = cond_mod.scenario_item_panel(5, 0, 1, regime=0, rows=24,
+                                         feats=6)
+        b = cond_mod.scenario_item_panel(5, 0, 1, regime=0, rows=24,
+                                         feats=6)
+        c = cond_mod.scenario_item_panel(5, 0, 1, regime=1, rows=24,
+                                         feats=6)
+        assert a.shape == (24, 6) and np.array_equal(a, b)
+        assert not np.array_equal(a, c), "regime must key the stream"
+
+    def test_actor_generator_scenario_mode(self):
+        from hfrep_tpu.orchestrate.actors import _make_generator
+        gen = _make_generator({"mode": "scenario", "stream_seed": 5,
+                               "source_idx": 0, "regime": 1,
+                               "n_regimes": 3, "rows": 24, "feats": 6})
+        item = gen(2)
+        assert item["panel"].shape == (24, 6)
+        assert np.array_equal(
+            item["panel"],
+            cond_mod.scenario_item_panel(5, 0, 2, regime=1, n_regimes=3,
+                                         rows=24, feats=6))
+
+
+# ------------------------------------------------------------- walk-forward
+class TestWalkForwardValidation:
+    def test_window_shorter_than_validation_split_raises(self):
+        # 2 training months under val_split=0.25: fit=1, val=1 is the
+        # floor; 1 month (fit=0) must raise, not truncate
+        cfg = AEConfig(n_factors=4, val_split=0.25, ols_window=6)
+        with pytest.raises(ValueError, match="validation split"):
+            validate_spec(WalkForwardSpec(start=1, n_windows=2,
+                                          horizon=10), cfg, 100)
+        # high split: 3 rows → fit = int(3*0.2) = 0
+        cfg = AEConfig(n_factors=4, val_split=0.8, ols_window=6)
+        with pytest.raises(ValueError, match="validation split"):
+            validate_spec(WalkForwardSpec(start=3, n_windows=1,
+                                          horizon=10), cfg, 100)
+
+    def test_short_horizon_and_short_panel_raise(self):
+        cfg = AEConfig(n_factors=4, ols_window=6)
+        with pytest.raises(ValueError, match="horizon"):
+            validate_spec(WalkForwardSpec(start=24, n_windows=2,
+                                          horizon=7), cfg, 100)
+        with pytest.raises(ValueError, match="months"):
+            validate_spec(WalkForwardSpec(start=24, n_windows=10,
+                                          horizon=10), cfg, 40)
+
+    def test_misaligned_inputs_raise(self, small_universe, tmp_path):
+        x, y, rf = small_universe
+        with pytest.raises(ValueError, match="disagree"):
+            run_walkforward(x, y[:-1], rf, SMALL_SPEC, SMALL_CFG,
+                            SMALL_LATENTS, tmp_path)
+
+
+class TestWalkForwardNumerics:
+    def test_ragged_lane_matches_dense_padded_sweep(self, small_universe):
+        """The padded-fabric discipline re-pinned for ragged expanding
+        windows: lane w of the fused (windows × latents) program is
+        BIT-identical to a standalone padded sweep of the same prefix
+        padded to the same T_max (the PR-4 equivalence + the `_rows_info`
+        float64 boundary discipline)."""
+        from hfrep_tpu.core import scaler as mm
+        from hfrep_tpu.replication.engine import (
+            sweep_autoencoders_padded,
+        )
+
+        x, _, _ = small_universe
+        spec = WalkForwardSpec(start=24, n_windows=3, horizon=10, step=3)
+        cfg = AEConfig(n_factors=6, latent_dim=4, epochs=6, batch_size=16,
+                       chunk_epochs=3, ols_window=6, patience=2)
+        key = jax.random.PRNGKey(cfg.seed)
+        grid, _, n_rows = _train_grid(key, x, spec, cfg, SMALL_LATENTS)
+
+        t_max = spec.train_rows(spec.n_windows - 1)
+        dkeys = jax.random.split(key, spec.n_windows)
+        for w in (0, 2):
+            rows = spec.train_rows(w)
+            _, scaled = mm.fit_transform(jnp.asarray(x[:rows]))
+            pad = jnp.concatenate(
+                [scaled, jnp.zeros((t_max - rows, x.shape[1]))])
+            ref, _ = sweep_autoencoders_padded(dkeys[w], pad, rows,
+                                               cfg, SMALL_LATENTS)
+            for name in ("encoder_kernel", "decoder_kernel"):
+                assert np.array_equal(np.asarray(grid.params[name][w]),
+                                      np.asarray(ref.params[name])), \
+                    f"window {w} {name} diverged from the dense padded sweep"
+            assert np.array_equal(np.asarray(grid.stop_epoch[w]),
+                                  np.asarray(ref.stop_epoch))
+
+    def test_surface_artifacts_and_stats(self, small_universe, tmp_path):
+        x, y, rf = small_universe
+        out = tmp_path / "wf"
+        r = run_walkforward(x, y, rf, SMALL_SPEC, SMALL_CFG,
+                            SMALL_LATENTS, out)
+        assert r["surface_post"].shape == (6, 3, y.shape[1])
+        assert np.isfinite(r["surface_post"]).all()
+        assert r["stats"]["lanes"] == 18
+        assert 0.0 <= r["stats"]["pad_waste_frac"] < 1.0
+        man = json.loads((out / "walkforward.json").read_text())
+        assert man["aggregate_digest"] == ckpt.aggregate_digest(
+            man["windows"])
+        assert len(man["windows"]) == 6
+        # window artifacts verify (atomic + checksummed)
+        for name in man["windows"]:
+            ckpt.verify(out / "windows" / name)
+
+    def test_fresh_run_preempted_then_plain_rerun_resumes(
+            self, small_universe, tmp_path):
+        """State persistence is unconditional: a FIRST run (no resume
+        flag) that gets preempted mid-training leaves chunk snapshots a
+        plain re-run picks up — and the final surface matches an
+        undisturbed run byte for byte."""
+        from hfrep_tpu.resilience.faults import FaultPlan
+        x, y, rf = small_universe
+        base, other = tmp_path / "base", tmp_path / "kill"
+        run_walkforward(x, y, rf, SMALL_SPEC, SMALL_CFG, SMALL_LATENTS,
+                        base)
+        res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(res.Preempted):
+                run_walkforward(x, y, rf, SMALL_SPEC, SMALL_CFG,
+                                SMALL_LATENTS, other)
+        finally:
+            res.clear_plan()
+        assert (other / "_resume").exists()
+        run_walkforward(x, y, rf, SMALL_SPEC, SMALL_CFG, SMALL_LATENTS,
+                        other)
+        for f in ("walkforward.json", "walkforward.csv"):
+            assert (other / f).read_bytes() == (base / f).read_bytes()
+
+    def test_foreign_window_scores_refused(self, small_universe, tmp_path):
+        x, y, rf = small_universe
+        out = tmp_path / "wf"
+        run_walkforward(x, y, rf, SMALL_SPEC, SMALL_CFG, SMALL_LATENTS,
+                        out)
+        other_cfg = AEConfig(n_factors=6, latent_dim=4, epochs=4,
+                             batch_size=16, chunk_epochs=2, ols_window=6,
+                             patience=2)
+        with pytest.raises(ValueError, match="DIFFERENT walk-forward"):
+            run_walkforward(x, y, rf, SMALL_SPEC, other_cfg,
+                            SMALL_LATENTS, out)
+
+
+class TestCliWalkForwardDrainResume:
+    def _run(self, cleaned, out):
+        from hfrep_tpu.experiments.cli import main
+        return main(["scenario", "walkforward", "--cleaned-dir", cleaned,
+                     "--out", out, "--latents", "1,2", "--start", "30",
+                     "--step", "2", "--windows", "6", "--horizon", "10",
+                     "--ols-window", "6", "--epochs", "6",
+                     "--chunk-epochs", "3", "--resume"])
+
+    def test_preempt_exit75_resume_bit_identical(self, tmp_path,
+                                                 monkeypatch):
+        """The drain contract end to end through the real CLI: a REAL
+        SIGTERM (injected at a training chunk boundary, caught by the
+        graceful-drain handler) → exit 75 → re-run resumes → final
+        surface bit-identical to an undisturbed run."""
+        from hfrep_tpu.utils.fixture_data import write_cleaned_fixture
+        cleaned = tmp_path / "cleaned_data"
+        write_cleaned_fixture(cleaned, months=64)
+        base, out = tmp_path / "base", tmp_path / "drained"
+        assert self._run(str(cleaned), str(base)) == 0
+
+        monkeypatch.setenv(res.ENV_FAULTS, "sigterm@chunk=1")
+        monkeypatch.setattr(res, "_plan", None)
+        monkeypatch.setattr(res, "_env_consumed", False)
+        assert self._run(str(cleaned), str(out)) == 75
+        assert (out / "_resume").exists(), \
+            "drained run must leave resumable state"
+
+        monkeypatch.delenv(res.ENV_FAULTS)
+        monkeypatch.setattr(res, "_plan", None)
+        monkeypatch.setattr(res, "_env_consumed", False)
+        assert self._run(str(cleaned), str(out)) == 0
+        assert not (out / "_resume").exists()
+        for f in ("walkforward.json", "walkforward.csv",
+                  "walkforward_ante.csv"):
+            assert (out / f).read_bytes() == (base / f).read_bytes(), \
+                f"{f} differs from the undisturbed run"
+
+
+# ------------------------------------------------------------------ universe
+class TestUniverse:
+    def test_synthesis_deterministic_and_sized(self):
+        from hfrep_tpu.scenario.universe import (
+            UniverseSpec,
+            synthesize_universe,
+        )
+        spec = UniverseSpec(funds=10, months=48, n_factors=5, seed=2)
+        a, b = synthesize_universe(spec), synthesize_universe(spec)
+        assert a.factors.shape == (48, 5) and a.hfd.shape == (48, 10)
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_factor_sampler_replaces_factors_only(self):
+        from hfrep_tpu.scenario.universe import (
+            UniverseSpec,
+            synthesize_universe,
+        )
+        spec = UniverseSpec(funds=10, months=48, n_factors=5, seed=2)
+        base = synthesize_universe(spec)
+        flat = synthesize_universe(
+            spec, factor_sampler=lambda m, f: np.full((m, f), 0.01,
+                                                      np.float32))
+        assert not np.array_equal(base.factors, flat.factors)
+        assert np.array_equal(base.rf, flat.rf)
+        with pytest.raises(ValueError, match="factor_sampler"):
+            synthesize_universe(
+                spec, factor_sampler=lambda m, f: np.zeros((m, f + 1)))
+
+
+# ------------------------------------------------------------------ obs glue
+class TestScenarioObsSchema:
+    def test_scn_comparability_key(self):
+        from hfrep_tpu.obs.history import _shape_sig, run_key
+        sig = _shape_sig({"scenario": {"funds": 64, "months": 360,
+                                       "windows": 48, "latents": 8}})
+        assert sig == "scnf64m360w48l8"
+        # a scenario annotation wins even when a model section rides along
+        sig = _shape_sig({"scenario": {"funds": 8, "months": 96,
+                                       "windows": 25, "latents": 4},
+                          "model": {"window": 48, "features": 35,
+                                    "hidden": 100},
+                          "train": {"batch_size": 32}})
+        assert sig.startswith("scn")
+        key = run_key({"config": {"scenario": {"funds": 8, "months": 96,
+                                               "windows": 25,
+                                               "latents": 4}}})
+        assert key["shape"] == "scnf8m96w25l4"
+
+    def test_scenario_gauges_ingest(self):
+        from hfrep_tpu.obs.history import GAUGE_PREFIXES, record_from_summary
+        assert "scenario/" in GAUGE_PREFIXES
+        rec = record_from_summary(
+            {"run_id": "r", "run_dir": "d",
+             "gauges": {"scenario/windows_per_sec": 1.5,
+                        "scenario/pad_waste_frac": 0.3,
+                        "other/x": 9.0}},
+            {"config": {}})
+        assert rec["metrics"]["scenario/windows_per_sec"] == 1.5
+        assert "other/x" not in rec["metrics"]
+
+    def test_explicit_directions_no_suffix_heuristics(self):
+        """Every scenario gauge has an explicit direction entry — the
+        shed_rate inversion lesson: pad_waste_frac would gate (and
+        cross-host fold) higher-is-better under the fallback rule."""
+        from hfrep_tpu.obs.regress import DEFAULT_THRESHOLDS, _rule_for
+        for name, direction in (
+                ("scenario/windows_per_sec", "up"),
+                ("scenario/lanes", "up"),
+                ("scenario/pad_waste_frac", "down"),
+                ("scenario/bank_windows_per_sec", "up")):
+            assert name in DEFAULT_THRESHOLDS, f"{name} must be explicit"
+            assert _rule_for(name, None)["direction"] == direction
+        # and the fold direction follows the same rule table
+        from hfrep_tpu.obs.history import fold_gauges
+        folded = fold_gauges([
+            {"gauges": {"scenario/pad_waste_frac": 0.1,
+                        "scenario/windows_per_sec": 2.0}},
+            {"gauges": {"scenario/pad_waste_frac": 0.4,
+                        "scenario/windows_per_sec": 1.0}}])
+        assert folded["scenario/pad_waste_frac"] == 0.4    # cost: max
+        assert folded["scenario/windows_per_sec"] == 1.0   # rate: min

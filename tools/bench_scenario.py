@@ -1,0 +1,252 @@
+"""Scenario-factory probe: where does the padded fabric actually break?
+
+Drives synthetic universes (F funds × M months) through the walk-forward
+sweep fabric and conditional bank generation, and *measures* the
+structural numbers the ROADMAP's scale claims rest on:
+
+* ``scenario/lanes`` — the (window × latent) grid trained as ONE padded
+  program;
+* ``scenario/pad_waste_frac`` — the fraction of the padded cube that is
+  zero rows (what ragged expanding windows cost);
+* ``scenario/windows_per_sec`` — walk-forward throughput end to end
+  (train + score);
+* ``scenario/bank_windows_per_sec`` — conditional sampling throughput.
+
+``--self-test`` (wired into ``tools/check.sh``, env-stripped) is the CI
+fast path: a small universe, the bank determinism replay (same
+seed+regime ⇒ identical ``aggregate_digest``, re-derived in memory), and
+the walk-forward ≥100-lane preempt→resume bit-identity drill (injected
+``preempt`` at a chunk boundary and at a window boundary; the resumed
+surface must match an undisturbed run byte for byte).
+
+Prints ONE JSON line.  Exit 0 = self-checks passed, 1 = a check (or a
+history regression) failed, 2 = tooling failure.
+
+Telemetry: with ``HFREP_OBS_DIR`` the run annotates a ``scenario``
+config section, so the history store indexes it under the scenario
+comparability key (``scnf<funds>m<months>w<windows>l<latents>``) — a
+universe drive's windows/sec series never blends into a GAN training
+steps/sec series.  With a history store on top, the run gates against
+the rolling baseline and auto-ingests on pass, exactly like ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+if __name__ == "__main__":               # `python tools/bench_scenario.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import hfrep_tpu.obs as obs_pkg
+
+
+def _bank_check(problems: list, feats: int, window: int,
+                blocks: int, block_size: int) -> dict:
+    """Bank determinism: generate, replay one block's digest in memory,
+    regenerate into a second directory — three independent derivations
+    of the same bytes must agree."""
+    from hfrep_tpu.scenario.conditional import (
+        fixture_bundle,
+        generate_bank,
+        replay_block_digest,
+    )
+
+    bundle = fixture_bundle(feats=feats, window=window, n_regimes=3,
+                            epochs=2)
+    d1 = tempfile.mkdtemp(prefix="scn_bank1_")
+    d2 = tempfile.mkdtemp(prefix="scn_bank2_")
+    try:
+        t0 = time.perf_counter()
+        m1 = generate_bank(bundle, d1, blocks=blocks,
+                           block_size=block_size, stream_seed=5)
+        bank_secs = time.perf_counter() - t0
+        replay = replay_block_digest(bundle, 5, 1, 0, block_size)
+        if replay != m1["block_digests"]["r1_00000"]:
+            problems.append("bank: in-memory replay digest diverged from "
+                            "the published block")
+        m2 = generate_bank(bundle, d2, blocks=blocks,
+                           block_size=block_size, stream_seed=5)
+        if m2["aggregate_digest"] != m1["aggregate_digest"]:
+            problems.append("bank: regeneration changed the aggregate "
+                            "digest (determinism broken)")
+        m3 = generate_bank(bundle, d1, blocks=blocks,
+                           block_size=block_size, stream_seed=5)
+        if m3["generated"] != 0:
+            problems.append(f"bank: re-run regenerated {m3['generated']} "
+                            "verified blocks (idempotence broken)")
+        n_windows = 3 * blocks * block_size
+        return {"aggregate_digest": m1["aggregate_digest"],
+                "bank_secs": round(bank_secs, 3),
+                "bank_windows_per_sec": round(n_windows
+                                              / max(bank_secs, 1e-9), 3)}
+    finally:
+        shutil.rmtree(d1, ignore_errors=True)
+        shutil.rmtree(d2, ignore_errors=True)
+
+
+def _resume_check(problems: list, spec, cfg, latents,
+                  x, y, rf) -> dict:
+    """The walk-forward SIGTERM→resume bit-identity drill: an
+    uninterrupted reference run, then a run hit by a REAL SIGTERM at a
+    training chunk boundary (the ``sigterm`` fault kind fires the actual
+    signal through the graceful-drain handler) and a signal-free preempt
+    at a scoring window boundary, resumed to completion — final surfaces
+    must match byte for byte."""
+    import hfrep_tpu.resilience as res
+    from hfrep_tpu.resilience.faults import FaultPlan
+    from hfrep_tpu.scenario.walkforward import run_walkforward
+
+    base = tempfile.mkdtemp(prefix="scn_wf_base_")
+    other = tempfile.mkdtemp(prefix="scn_wf_resume_")
+    try:
+        ref = run_walkforward(x, y, rf, spec, cfg, latents, base)
+        preempts = 0
+        for plan in ("sigterm@chunk=2", "preempt@window=2"):
+            res.install_plan(FaultPlan.parse(plan))
+            try:
+                run_walkforward(x, y, rf, spec, cfg, latents, other,
+                                resume=True)
+                problems.append(f"resume: injected {plan} did not preempt")
+            except res.Preempted:
+                preempts += 1
+            finally:
+                res.clear_plan()
+        final = run_walkforward(x, y, rf, spec, cfg, latents, other,
+                                resume=True)
+        for f in ("walkforward.json", "walkforward.csv",
+                  "walkforward_ante.csv"):
+            a = open(os.path.join(base, f), "rb").read()
+            b = open(os.path.join(other, f), "rb").read()
+            if a != b:
+                problems.append(f"resume: {f} differs from the "
+                                "undisturbed run")
+        lanes = spec.n_windows * len(latents)
+        if final["stats"]["lanes"] != lanes:
+            problems.append(f"resume: lanes {final['stats']['lanes']} != "
+                            f"expected {lanes}")
+        if not np.isfinite(ref["surface_post"]).all():
+            problems.append("resume: reference surface carries non-finite "
+                            "scores")
+        return {"preempts": preempts, "lanes": lanes,
+                "ref_stats": ref["stats"]}
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+        shutil.rmtree(other, ignore_errors=True)
+
+
+def run_probe(obs, self_test: bool) -> int:
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.scenario.universe import (
+        UniverseSpec,
+        drive_universe,
+        synthesize_universe,
+    )
+    from hfrep_tpu.scenario.walkforward import WalkForwardSpec
+
+    problems: list = []
+    doc: dict = {"metric": "scenario", "self_test": bool(self_test)}
+
+    if self_test:
+        # ≥100 lanes as one padded drive — the acceptance floor — at
+        # fixture shapes: 25 expanding windows × 4 latent lanes
+        uspec = UniverseSpec(funds=8, months=96, n_factors=6, seed=3)
+        spec = WalkForwardSpec(start=30, n_windows=25, horizon=10, step=2)
+        latents = [1, 2, 3, 4]
+        cfg = AEConfig(epochs=6, batch_size=16, chunk_epochs=3,
+                       ols_window=6, patience=2)
+        bank_args = dict(feats=6, window=12, blocks=2, block_size=4)
+    else:
+        uspec = UniverseSpec(funds=64, months=480, n_factors=22, seed=3)
+        spec = WalkForwardSpec(start=240, n_windows=48, horizon=60,
+                               step=4)
+        latents = list(range(1, 9))
+        cfg = AEConfig(epochs=200, chunk_epochs=50)
+        bank_args = dict(feats=22, window=24, blocks=4, block_size=32)
+
+    # the scenario comparability key: this drive's windows/sec can never
+    # blend into a training steps/sec series (the svb* pattern)
+    obs.annotate(config={"scenario": {
+        "funds": uspec.funds, "months": uspec.months,
+        "windows": spec.n_windows, "latents": len(latents)}})
+
+    # universe determinism (same spec ⇒ same bytes)
+    u1 = synthesize_universe(uspec)
+    u2 = synthesize_universe(uspec)
+    if not all(np.array_equal(a, b) for a, b in zip(u1, u2)):
+        problems.append("universe: synthesis is not deterministic")
+
+    doc["bank"] = _bank_check(problems, **bank_args)
+
+    u = u1
+    if self_test:
+        doc["walkforward"] = _resume_check(problems, spec, cfg, latents,
+                                           u.factors, u.hfd, u.rf)
+        stats = doc["walkforward"]["ref_stats"]
+    else:
+        out = tempfile.mkdtemp(prefix="scn_wf_bench_")
+        try:
+            stats = drive_universe(uspec, spec, cfg, latents, out)["stats"]
+        finally:
+            shutil.rmtree(out, ignore_errors=True)
+        doc["walkforward"] = {"stats": stats}
+
+    lanes = spec.n_windows * len(latents)
+    if lanes < 100:
+        problems.append(f"config: only {lanes} lanes (< 100 floor)")
+    if not 0.0 <= stats["pad_waste_frac"] < 1.0:
+        problems.append(f"pad_waste_frac {stats['pad_waste_frac']} "
+                        "outside [0, 1)")
+    for name, value in (
+            ("scenario/lanes", stats["lanes"]),
+            ("scenario/pad_waste_frac", stats["pad_waste_frac"]),
+            ("scenario/windows_per_sec", stats["windows_per_sec"]),
+            ("scenario/bank_windows_per_sec",
+             doc["bank"]["bank_windows_per_sec"])):
+        if value is not None and np.isfinite(value):
+            obs.gauge(name).set(float(value))
+    obs.memory_snapshot(phase="bench_scenario_end")
+
+    doc["self_check"] = "ok" if not problems else "; ".join(problems)
+    print(json.dumps(doc, default=str))
+    if problems:
+        print(f"bench_scenario: SELF-CHECK FAILED: {'; '.join(problems)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_scenario",
+        description="scenario-factory probe: padded walk-forward "
+                    "throughput, bank determinism, universe scaling")
+    ap.add_argument("--self-test", action="store_true",
+                    help="small universe + bank determinism replay + "
+                         "the 100-lane walk-forward preempt→resume "
+                         "bit-identity drill (the CI fast path)")
+    args = ap.parse_args(argv)
+
+    obs_dir = os.environ.get("HFREP_OBS_DIR")
+    with obs_pkg.session_or_off(obs_dir, "bench_scenario",
+                                command="bench_scenario") as obs:
+        if obs_dir and not obs.enabled:
+            obs_dir = None               # degraded: nothing to gate below
+        rc = run_probe(obs, args.self_test)
+    from hfrep_tpu.obs import history as hist_mod
+    hist = hist_mod.resolve_history(obs_dir)
+    if obs_dir and hist:
+        rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,3 +62,11 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
 # stay out of the gate.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
     python tools/bench_serve.py --self-test 1>&2
+# scenario-factory gate: bank determinism replay (same seed+regime ⇒
+# identical aggregate digest, re-derived three independent ways), the
+# 100-lane walk-forward preempt→resume bit-identity drill (injected
+# preempt at a training chunk boundary AND a scoring window boundary;
+# resumed surface byte-identical to an undisturbed run), universe
+# synthesis determinism.  Env-stripped + CPU-pinned like the others.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+    python tools/bench_scenario.py --self-test 1>&2
