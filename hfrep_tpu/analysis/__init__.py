@@ -1,12 +1,19 @@
-"""hfrep_tpu.analysis — JAX-aware static lint & shape-contract checking.
+"""hfrep_tpu.analysis — JAX-aware static lint, shape contracts &
+cross-layer invariant checking.
 
 A pure-AST analyzer (no jax import, no tracing) for the silent-failure
 bug classes that TPU JAX code grows: host ops on tracers inside jitted
 functions, PRNG key reuse, collective/mesh axis-name drift, donated
 buffers read after donation, Python-side mutation of traced pytrees,
-and shape/dtype contract violations.  See ``hfrep_tpu/analysis/README.md``
-for the rule catalogue and ``python -m hfrep_tpu.analysis check --help``
-for the CLI.
+and shape/dtype contract violations (JAX001–006) — plus, since ISSUE
+11, a two-phase whole-project pass (:mod:`hfrep_tpu.analysis.project`)
+behind six cross-layer rules (HF001–006) for the string-protocol
+invariants a per-file linter structurally cannot see: history-store
+gauge directions, fault-site registry round-trips, atomic-publish
+discipline, obs schema/doc sync, version-gated jax APIs, and
+signal/lock safety.  See ``hfrep_tpu/analysis/README.md`` for the rule
+catalogue and ``python -m hfrep_tpu.analysis check --help`` for the
+CLI (JSON/SARIF output, ``--changed`` git scoping, fingerprint cache).
 
 The package is import-light by design: everything here runs on a bare
 CPython, so the checker can gate CI before any accelerator runtime is

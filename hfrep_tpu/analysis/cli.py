@@ -2,7 +2,10 @@
 
 Exit codes: 0 — clean (every finding fixed, suppressed, or baselined);
 1 — non-baselined findings; 2 — usage or analyzer error.  ``--format
-json`` emits a machine-readable report for CI annotation;
+json`` emits a machine-readable report for CI annotation; ``--format
+sarif`` emits SARIF 2.1.0 for code-scanning UIs; ``--changed`` scopes
+the *reported* files to the git working-set diff (the project pre-pass
+still covers the whole tree, so cross-layer facts stay whole-project);
 ``--write-baseline`` snapshots the current findings so existing debt can
 be burned down incrementally without blocking the gate.
 """
@@ -11,14 +14,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from collections import Counter
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from hfrep_tpu.analysis.engine import (
-    AnalysisError, Finding, analyze_paths, apply_baseline, load_baseline,
-    write_baseline,
+    AnalysisError, Finding, REPO_ROOT, analyze_paths, apply_baseline,
+    load_baseline, write_baseline,
 )
 from hfrep_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
 
@@ -34,7 +38,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
     check = sub.add_parser("check", help="analyze files/directories")
     check.add_argument("paths", nargs="+", help=".py files or directories")
-    check.add_argument("--format", choices=("human", "json"), default="human")
+    check.add_argument("--format", choices=("human", "json", "sarif"),
+                       default="human")
     check.add_argument("--select", default=None,
                        help="comma-separated rule ids (default: all)")
     check.add_argument("--baseline", default=None,
@@ -47,6 +52,17 @@ def _build_parser() -> argparse.ArgumentParser:
     check.add_argument("--known-axes", default=None,
                        help="comma-separated mesh axis names to trust in "
                             "addition to the declared ones (JAX003)")
+    check.add_argument("--changed", action="store_true",
+                       help="report findings only for files changed vs git "
+                            "HEAD (+ untracked); the project pre-pass still "
+                            "covers every path given, and project-level "
+                            "findings always report")
+    check.add_argument("--no-cache", action="store_true",
+                       help="ignore and don't write the per-file "
+                            "fingerprint cache")
+    check.add_argument("--cache", default=None,
+                       help="fingerprint cache file (default: "
+                            "<repo>/.analysis-cache.json)")
 
     sub.add_parser("rules", help="list rule ids and descriptions")
     return p
@@ -84,6 +100,78 @@ def _report_human(new: List[Finding], baselined: List[Finding],
             print(f"  {fp}", file=out)
 
 
+def changed_files() -> Set[str]:
+    """Repo-relative posix paths of .py files changed vs HEAD (staged,
+    unstaged and untracked) — the ``--changed`` scope.  Raises
+    :class:`AnalysisError` outside a git checkout: an empty scope would
+    read as "clean", which is worse than an error."""
+    out: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise AnalysisError(f"--changed needs git: {e}")
+        if proc.returncode != 0:
+            raise AnalysisError(
+                f"--changed: {' '.join(cmd)} failed: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return out
+
+
+def _report_sarif(new: List[Finding], baselined: List[Finding],
+                  stale: Counter, out) -> None:
+    """SARIF 2.1.0 — one run, one result per non-baselined finding, so
+    code-scanning UIs (and ``sarif``-aware CI annotators) ingest the
+    gate without a custom adapter."""
+    rules = {}
+    for r in ALL_RULES:
+        rules[r.id] = {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.description or r.name},
+        }
+    results = []
+    for f in new:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "partialFingerprints": {"hfrepFingerprint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1,
+                               "snippet": {"text": f.snippet}},
+                },
+            }],
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hfrep-analysis",
+                # no informationUri: SARIF 2.1.0 wants an absolute URI
+                # and the docs live in-repo (hfrep_tpu/analysis/README.md)
+                "rules": sorted(rules.values(), key=lambda r: r["id"]),
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": REPO_ROOT.as_uri() + "/"}},
+            "results": results,
+            "properties": {"baselined": len(baselined),
+                           "staleBaseline": sorted(stale.elements())},
+        }],
+    }
+    json.dump(doc, out, indent=2)
+    out.write("\n")
+
+
 def _report_json(new: List[Finding], baselined: List[Finding],
                  stale: Counter, out) -> None:
     payload = {
@@ -114,7 +202,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "--write-baseline requires a full-rule run; drop --select")
         axes = (set(s.strip() for s in args.known_axes.split(",") if s.strip())
                 if args.known_axes else None)
-        findings = analyze_paths(args.paths, rules=rules, known_axes=axes)
+        restrict = changed_files() if args.changed else None
+        if args.changed and args.write_baseline:
+            raise AnalysisError(
+                "--write-baseline needs the full finding set; drop --changed")
+        findings = analyze_paths(
+            args.paths, rules=rules, known_axes=axes,
+            cache_path=args.cache, use_cache=not args.no_cache,
+            restrict_to=restrict)
 
         baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
         if args.write_baseline:
@@ -148,11 +243,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     fp: n for fp, n in baseline.items()
                     if fp.split("::", 1)[0] in selected})
         new, matched, stale = apply_baseline(findings, baseline)
+        if args.changed:
+            # a diff-scoped run never saw the unchanged files' findings,
+            # so their baseline entries are not stale, just unchecked
+            stale = Counter()
     except AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    report = _report_json if args.format == "json" else _report_human
+    report = {"json": _report_json, "sarif": _report_sarif,
+              "human": _report_human}[args.format]
     report(new, matched, stale, sys.stdout)
     return 1 if new else 0
 

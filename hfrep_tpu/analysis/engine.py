@@ -3,12 +3,26 @@
 The engine is deliberately flake8-shaped — parse once per file, hand the
 tree to every rule, post-filter by per-line suppressions — because that
 shape is what lets new JAX rules be ~50-line visitors instead of
-frameworks.  Two extensions matter here:
+frameworks.  Three extensions matter here:
 
-* a **project pre-pass** (:func:`analyze_paths`) that collects mesh axis
-  declarations across *all* files before any rule runs, so the
-  axis-consistency rule can cross-check a ``lax.psum(x, 'dp')`` call in
-  ``train/steps.py`` against the axes declared in ``parallel/mesh.py``;
+* a **project pre-pass** (:func:`analyze_paths`): every file is
+  summarized once (axes declared, instruments/events emitted, fault
+  sites referenced — :mod:`hfrep_tpu.analysis.project`), the summaries
+  plus the extracted registries (fault sites, ``DEFAULT_THRESHOLDS``,
+  ``GAUGE_PREFIXES``, the ``obs/README.md`` schema, the atomic-writer
+  entry points, the absent-jax-API table) are assembled into a
+  :class:`~hfrep_tpu.analysis.project.ProjectModel`, and every rule
+  runs with it in context — so a gauge emitted in
+  ``tools/bench_serve.py`` checks against the table in
+  ``obs/regress.py``.  Rules may additionally implement
+  ``check_project(model)`` for findings that belong to no single
+  analyzed file (a dead registry entry, an undocumented-schema row);
+* a **fingerprint cache** (:data:`DEFAULT_CACHE`): per-file summaries
+  and findings keyed by (file sha, analyzer self-hash, project digest),
+  so the repo-wide two-phase run costs parse+rules only for files that
+  changed — the whole-tree gate stays inside the tier-1 budget as the
+  codebase grows.  Any registry/doc edit changes the project digest and
+  invalidates every cached verdict: correctness over cleverness;
 * a **baseline file** keyed by content fingerprints (rule + path +
   normalized source line, with multiplicity) so pre-existing violations
   can be burned down incrementally without blocking CI on day one —
@@ -20,6 +34,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import re
@@ -31,6 +46,10 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 #: Repo root assumed two levels above this package (``<root>/hfrep_tpu/analysis``);
 #: fingerprint paths are made relative to it so baselines are CWD-independent.
 REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: default per-file fingerprint cache (gitignored; safe to delete any time)
+DEFAULT_CACHE = REPO_ROOT / ".analysis-cache.json"
+CACHE_VERSION = 1
 
 _NOQA_RE = re.compile(
     r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
@@ -84,13 +103,21 @@ class FileContext:
 
     def __init__(self, path, source: str,
                  known_axes: Optional[Set[str]] = None,
-                 relpath: Optional[str] = None):
+                 relpath: Optional[str] = None,
+                 project=None, tree: Optional[ast.AST] = None):
         self.path = str(path)
         self.relpath = relpath if relpath is not None else _normalize_path(path)
         self.source = source
         self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=self.path)
+        # ``tree`` lets the two-phase runner hand over its phase-1 parse
+        # instead of paying ast.parse twice per file
+        self.tree = tree if tree is not None \
+            else ast.parse(source, filename=self.path)
         self.known_axes: Set[str] = set(known_axes or ())
+        #: the assembled ProjectModel on whole-project runs; None on
+        #: single-snippet runs, where the cross-layer rules no-op unless
+        #: handed a model explicitly (the unit-test path)
+        self.project = project
         #: line -> comment text (tokenizer-accurate, so ``# noqa`` or
         #: ``# shape:`` *inside a docstring* never counts)
         self.comments: Dict[int, str] = self._scan_comments()
@@ -177,51 +204,196 @@ def _run_rules(ctx: "FileContext", rules: Sequence) -> List[Finding]:
 def analyze_source(source: str, path: str = "<string>",
                    rules: Optional[Sequence] = None,
                    known_axes: Optional[Set[str]] = None,
-                   relpath: Optional[str] = None) -> List[Finding]:
+                   relpath: Optional[str] = None,
+                   project=None) -> List[Finding]:
     """Run ``rules`` (default: all) over one source blob.  Returns findings
     already filtered by ``# noqa`` suppressions.  A syntax error yields a
     single JAX000 finding rather than raising, so one broken file can't
-    take down a whole-tree run."""
+    take down a whole-tree run.  ``project`` injects a
+    :class:`~hfrep_tpu.analysis.project.ProjectModel` for the
+    cross-layer rules (they no-op without one)."""
     from hfrep_tpu.analysis.rules import ALL_RULES
 
     rules = list(rules) if rules is not None else list(ALL_RULES)
     try:
-        ctx = FileContext(path, source, known_axes=known_axes, relpath=relpath)
+        ctx = FileContext(path, source, known_axes=known_axes,
+                          relpath=relpath, project=project)
     except SyntaxError as e:
         rel = relpath if relpath is not None else _normalize_path(path)
         return [_syntax_finding(e, rel)]
     return _run_rules(ctx, rules)
 
 
+# ---------------------------------------------------------------- caching
+def _self_hash() -> str:
+    """Hash of the analyzer's own source: any rule/engine/project edit
+    must invalidate every cached verdict, without anyone remembering to
+    bump a version constant."""
+    h = hashlib.sha256()
+    pkg = Path(__file__).resolve().parent
+    for f in sorted(pkg.rglob("*.py")):
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    return h.hexdigest()
+
+
+def load_cache(path) -> dict:
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("version") != CACHE_VERSION:
+        return {}
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return {}
+    # a malformed per-file entry (hand-edited, foreign writer) is a
+    # cache MISS, never a crash — degrade-to-cold is the contract
+    return {rel: e for rel, e in entries.items() if isinstance(e, dict)}
+
+
+def save_cache(path, entries: dict) -> None:
+    """Best-effort: an unwritable cache degrades to a cold run, never an
+    error.  Published by rename so a killed run cannot leave a torn
+    cache (a corrupt cache also just degrades to cold — belt and
+    braces, not load-bearing)."""
+    import os
+    p = Path(path)
+    tmp = p.parent / f".{p.name}.tmp-{os.getpid()}"
+    try:
+        tmp.write_text(
+            json.dumps({"version": CACHE_VERSION, "entries": entries}),
+            encoding="utf-8")
+        os.replace(tmp, p)
+    except OSError:
+        tmp.unlink(missing_ok=True)
+
+
 def analyze_paths(paths: Sequence, rules: Optional[Sequence] = None,
-                  known_axes: Optional[Set[str]] = None) -> List[Finding]:
-    """Two-pass whole-project run: every file is parsed ONCE into a
-    FileContext, mesh-axis declarations are collected across all of them,
-    then the rules run with the union in context — so a collective in
-    ``train/steps.py`` checks against the axes ``parallel/mesh.py``
-    declares."""
+                  known_axes: Optional[Set[str]] = None,
+                  cache_path=None, use_cache: bool = True,
+                  restrict_to: Optional[Set[str]] = None) -> List[Finding]:
+    """Two-phase whole-project run.
+
+    Phase 1 summarizes every file (axes, emissions, fault-site
+    references — from the cache when the file is unchanged) and
+    assembles the :class:`~hfrep_tpu.analysis.project.ProjectModel`
+    (registries are read from their canonical files, so a scoped run
+    still sees them).  Phase 2 runs the per-file rules with the model in
+    context, then each rule's ``check_project`` hook once.
+
+    ``restrict_to``: repo-relative posix paths — when given, per-file
+    findings are reported only for those files (the ``--changed`` mode);
+    phase 1 still covers the full path set so cross-layer facts stay
+    whole-project.  Project-level findings are always reported: they are
+    global invariants, not properties of any one changed file.
+    """
+    from hfrep_tpu.analysis.project import (FileSummary, ProjectModel,
+                                            summarize_file)
     from hfrep_tpu.analysis.rules import ALL_RULES
-    from hfrep_tpu.analysis.rules.jax_axes import collect_declared_axes
 
     rules = list(rules) if rules is not None else list(ALL_RULES)
+    cache_file = Path(cache_path) if cache_path else DEFAULT_CACHE
+    cache = load_cache(cache_file) if use_cache else {}
+    self_hash = _self_hash()
+
     findings: List[Finding] = []
-    ctxs: List[FileContext] = []
-    axes: Set[str] = set(known_axes or ())
+    sources: Dict[str, str] = {}          # relpath -> source text
+    shas: Dict[str, str] = {}
+    trees: Dict[str, ast.AST] = {}
+    summaries: Dict[str, FileSummary] = {}
+
+    # ------------------------------------------------------------ phase 1
     for f in _iter_py_files(paths):
         try:
             text = f.read_text(encoding="utf-8")
         except OSError as e:
             raise AnalysisError(f"cannot read {f}: {e}")
+        rel = _normalize_path(f)
+        sources[rel] = text
+        shas[rel] = hashlib.sha256(text.encode()).hexdigest()
+        entry = cache.get(rel)
+        if (entry and entry.get("sha") == shas[rel]
+                and entry.get("self") == self_hash
+                and not entry.get("syntax_error")
+                and isinstance(entry.get("summary"), dict)):
+            try:
+                summaries[rel] = FileSummary.from_dict(entry["summary"])
+                continue
+            except (KeyError, TypeError, AttributeError):
+                cache.pop(rel, None)      # malformed inner shape: a MISS
         try:
-            ctx = FileContext(f, text)
+            tree = ast.parse(text, filename=str(f))
         except SyntaxError as e:
-            findings.append(_syntax_finding(e, _normalize_path(f)))
+            if restrict_to is None or rel in restrict_to:
+                # same reporting scope as phase 2: a --changed run must
+                # not fail on an unchanged file's (pre-existing) error
+                findings.append(_syntax_finding(e, rel))
+            summaries[rel] = FileSummary()
+            cache[rel] = {"sha": shas[rel], "self": self_hash,
+                          "summary": summaries[rel].to_dict(),
+                          "syntax_error": True}
             continue
-        ctxs.append(ctx)
-        axes |= collect_declared_axes(ctx.tree)
-    for ctx in ctxs:
-        ctx.known_axes = axes
-        findings.extend(_run_rules(ctx, rules))
+        trees[rel] = tree
+        summaries[rel] = summarize_file(tree)
+        cache[rel] = {"sha": shas[rel], "self": self_hash,
+                      "summary": summaries[rel].to_dict()}
+
+    model = ProjectModel.from_file_summaries(summaries)
+    model.known_axes |= set(known_axes or ())
+    rule_ids = ",".join(r.id for r in rules)
+    digest = hashlib.sha256(
+        f"{self_hash}:{rule_ids}:{model.digest()}".encode()).hexdigest()
+
+    # ------------------------------------------------------------ phase 2
+    for rel, text in sources.items():
+        if restrict_to is not None and rel not in restrict_to:
+            continue
+        entry = cache.get(rel, {})
+        if entry.get("syntax_error"):
+            continue                      # the JAX000 finding is emitted above
+        if entry.get("digest") == digest and isinstance(
+                entry.get("findings"), list):
+            try:
+                cached = [Finding(**fd) for fd in entry["findings"]]
+            except TypeError:             # malformed inner shape: a MISS
+                pass
+            else:
+                findings.extend(cached)
+                continue
+        try:
+            ctx = FileContext(REPO_ROOT / rel, text, relpath=rel,
+                              known_axes=model.known_axes, project=model,
+                              tree=trees.get(rel))
+        except SyntaxError as e:          # unreachable after phase 1, belt
+            findings.append(_syntax_finding(e, rel))
+            continue
+        file_findings = _run_rules(ctx, rules)
+        findings.extend(file_findings)
+        entry = cache.setdefault(rel, {"sha": shas[rel], "self": self_hash,
+                                       "summary": summaries[rel].to_dict()})
+        entry["digest"] = digest
+        entry["findings"] = [dataclasses.asdict(f) for f in file_findings]
+
+    # ------------------------------------------------- project-level pass
+    for rule in rules:
+        check_project = getattr(rule, "check_project", None)
+        if check_project is None:
+            continue
+        for finding in check_project(model):
+            # project findings carry no per-file noqa scope; they are
+            # suppressed only by fixing the registry/doc they point at
+            findings.append(finding)
+
+    if use_cache:
+        # keep entries for files OUTSIDE this run's scope (a scoped
+        # `check hfrep_tpu/serve` must not wipe the repo-wide warm
+        # cache); prune only entries whose file is gone from disk, so
+        # the cache cannot grow without bound
+        save_cache(cache_file, {
+            rel: e for rel, e in cache.items()
+            if rel in sources or (REPO_ROOT / rel).exists()})
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 

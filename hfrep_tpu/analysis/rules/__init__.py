@@ -10,6 +10,12 @@ from hfrep_tpu.analysis.rules.jax_axes import AxisConsistencyRule
 from hfrep_tpu.analysis.rules.jax_donation import DonationReuseRule
 from hfrep_tpu.analysis.rules.py_mutation import MutationRule
 from hfrep_tpu.analysis.rules.shape_contracts import ShapeContractRule
+from hfrep_tpu.analysis.rules.hf_gauge_thresholds import GaugeThresholdRule
+from hfrep_tpu.analysis.rules.hf_fault_sites import FaultSiteRule
+from hfrep_tpu.analysis.rules.hf_atomic_writes import AtomicWriteRule
+from hfrep_tpu.analysis.rules.hf_obs_doc import ObsDocRule
+from hfrep_tpu.analysis.rules.hf_version_gate import VersionGateRule
+from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
 
 ALL_RULES = (
     HostOpsInJitRule(),
@@ -18,6 +24,14 @@ ALL_RULES = (
     DonationReuseRule(),
     MutationRule(),
     ShapeContractRule(),
+    # cross-layer rules (ISSUE 11): whole-project string-protocol
+    # invariants, fed by the ProjectModel pre-pass
+    GaugeThresholdRule(),
+    FaultSiteRule(),
+    AtomicWriteRule(),
+    ObsDocRule(),
+    VersionGateRule(),
+    ThreadSignalRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,158 @@
+"""HF005 — version-gated JAX API use.
+
+The seed tier-1 failure set (38F/5E) had ONE root cause: ``from jax
+import shard_map`` at module top of four launch-path modules, on a
+pinned runtime (jax 0.4.37) where the attribute does not exist — each
+import killed its whole module, every module importing it, and five
+entire test files at collection.  That class is statically detectable:
+the absent-API registry (:data:`hfrep_tpu.analysis.project.
+ABSENT_JAX_APIS`, curated against the pinned runtime and verified
+against the installed jax by the test suite) names every such
+attribute, and this rule flags any *unguarded* static reference.
+
+Guarded references are the sanctioned pattern and never flagged:
+
+* inside a ``try`` whose handlers catch ``ImportError`` /
+  ``ModuleNotFoundError`` / ``AttributeError`` (or a bare/``Exception``
+  handler) — the ``_compat`` gate and ``utils.vma.vma_of`` idioms;
+* inside an ``if hasattr(jax, "...")`` (or equivalently-guarded)
+  branch.
+
+The findings over ``hfrep_tpu/parallel/`` are the ROADMAP item-1 kill
+list — committed as ``hfrep_tpu/analysis/HF005_KILL_LIST.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import Rule, dotted_name, import_aliases
+
+_GUARD_EXCEPTIONS = {"ImportError", "ModuleNotFoundError",
+                     "AttributeError", "Exception", "BaseException"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Set[str]:
+    t = handler.type
+    if t is None:
+        return {"Exception"}                 # bare except
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: Set[str] = set()
+    for e in elts:
+        name = dotted_name(e)
+        if name:
+            out.add(name.split(".")[-1])
+    return out
+
+
+def _is_guard_test(test: ast.AST) -> bool:
+    """``hasattr(jax, "shard_map")``-shaped truth tests (possibly
+    parenthesized into bool ops)."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname and fname.split(".")[-1] in ("hasattr", "getattr"):
+                return True
+    return False
+
+
+def _guard_branches(test: ast.AST):
+    """Which branches of an ``if`` a hasattr-shaped test guards:
+    ``(body_guarded, orelse_guarded)``.  Polarity matters —
+    ``if hasattr(...):`` blesses the body, ``if not hasattr(...):``
+    blesses the *else* branch (the body is the degraded path)."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        if _is_guard_test(test.operand):
+            return False, True
+        return False, False
+    if _is_guard_test(test):
+        return True, False
+    return False, False
+
+
+class VersionGateRule(Rule):
+    id = "HF005"
+    name = "version-gated-jax-api"
+    description = ("unguarded references to jax APIs absent on the "
+                   "pinned runtime (the dead-module import class)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        project = ctx.project
+        if project is None or not project.absent_jax:
+            return []
+        absent = project.absent_jax
+        findings: List[Finding] = []
+
+        # dotted-prefix aliases for normalization: {"jnp": "jax.numpy"}
+        alias_of: Dict[str, str] = {}
+        roots = {api.rsplit(".", 1)[0] for api in absent}
+        for module in sorted(roots):
+            for alias in import_aliases(ctx.tree, module):
+                alias_of[alias] = module
+
+        def visit(node: ast.AST, guarded: bool) -> None:
+            if isinstance(node, ast.Try):
+                handlers = {n for h in node.handlers
+                            for n in _handler_names(h)}
+                body_guarded = guarded or bool(handlers & _GUARD_EXCEPTIONS)
+                for child in node.body:
+                    visit(child, body_guarded)
+                for h in node.handlers:
+                    for child in h.body:
+                        visit(child, guarded)
+                for child in node.orelse + node.finalbody:
+                    visit(child, guarded)
+                return
+            if isinstance(node, ast.If):
+                body_ok, orelse_ok = _guard_branches(node.test)
+                if body_ok or orelse_ok:
+                    visit(node.test, guarded)
+                    for child in node.body:
+                        visit(child, guarded or body_ok)
+                    for child in node.orelse:
+                        visit(child, guarded or orelse_ok)
+                    return
+            self._check_node(ctx, node, guarded, alias_of, absent, findings)
+            for child in ast.iter_child_nodes(node):
+                visit(child, guarded)
+
+        for top in ast.iter_child_nodes(ctx.tree):
+            visit(top, False)
+        return findings
+
+    def _check_node(self, ctx, node, guarded, alias_of, absent,
+                    findings) -> None:
+        if guarded:
+            return
+        api = None
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                candidate = f"{node.module}.{a.name}"
+                if candidate in absent:
+                    api = candidate
+                    break
+        elif isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+            if name:
+                root, _, rest = name.partition(".")
+                normalized = (f"{alias_of[root]}.{rest}"
+                              if root in alias_of and rest else name)
+                # longest-prefix match so jax.lax.axis_size resolves even
+                # as part of a longer chain (jax.lax.axis_size("dp") is a
+                # Call over the Attribute, handled; attribute-of-result
+                # chains match on their prefix)
+                for candidate in absent:
+                    if normalized == candidate or \
+                            normalized.startswith(candidate + "."):
+                        api = candidate
+                        break
+        if api is None:
+            return
+        from hfrep_tpu.analysis.project import PINNED_JAX
+        findings.append(ctx.finding(
+            "HF005", node,
+            f"{api} does not exist on the pinned runtime "
+            f"(jax {PINNED_JAX}) and the reference is unguarded — "
+            f"this code path is dead here; {absent[api]}"))

@@ -71,6 +71,45 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                 "mad_mult": 5.0},
     "bench/js_div_regenerated": {"direction": "down", "rel_tol": 0.25,
                                  "mad_mult": 5.0},
+    # bench.py's headline gauges (ISSUE 11 / HF001: every statically-named
+    # bench/serve/scenario gauge carries an explicit entry — the suffix
+    # heuristic guessed these right, but "right by heuristic" is exactly
+    # the class that folded serve/shed_rate and scenario/pad_waste_frac
+    # inverted; rates regress down = direction "up")
+    "bench/headline_steps_per_sec":     {"direction": "up", "rel_tol": 0.05,
+                                         "mad_mult": 5.0},
+    "bench/headline_f32_steps_per_sec": {"direction": "up", "rel_tol": 0.05,
+                                         "mad_mult": 5.0},
+    "bench/prod_168x36_steps_per_sec":  {"direction": "up", "rel_tol": 0.05,
+                                         "mad_mult": 5.0},
+    "bench/dp_shard_map_steps_per_sec": {"direction": "up", "rel_tol": 0.08,
+                                         "mad_mult": 5.0},
+    "bench/sp_prod_steps_per_sec":      {"direction": "up", "rel_tol": 0.08,
+                                         "mad_mult": 5.0},
+    "bench/bf16_headline_speedup":      {"direction": "up", "rel_tol": 0.05,
+                                         "mad_mult": 5.0},
+    # tools/bench_ae.py (chunked early-exit + multi-dataset fabric)
+    "bench/ae_chunk_speedup":   {"direction": "up",   "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    "bench/ae_full_scan_s":     {"direction": "down", "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    "bench/ae_chunked_exit_s":  {"direction": "down", "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    "bench/ae_epochs_per_sec":  {"direction": "up",   "rel_tol": 0.10,
+                                 "mad_mult": 5.0},
+    "bench/ae_multi_batched_s": {"direction": "down", "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    "bench/ae_multi_serial_s":  {"direction": "down", "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    "bench/ae_multi_speedup":   {"direction": "up",   "rel_tol": 0.15,
+                                 "mad_mult": 5.0},
+    # tools/bench_async.py (actor-fabric overlap probe)
+    "bench/async_overlap_speedup": {"direction": "up",   "rel_tol": 0.15,
+                                    "mad_mult": 5.0},
+    "bench/async_sequential_s":    {"direction": "down", "rel_tol": 0.15,
+                                    "mad_mult": 5.0},
+    "bench/async_overlapped_s":    {"direction": "down", "rel_tol": 0.15,
+                                    "mad_mult": 5.0},
     # serving-layer gauges (tools/bench_serve.py; ISSUE 8).  These rules
     # also decide the cross-host gauge FOLD direction in
     # history.fold_gauges (min where higher-better / max for costs), so
@@ -89,6 +128,12 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                 "abs_tol": 0.05, "mad_mult": 5.0},
     "serve/queue_depth":       {"direction": "down", "rel_tol": 0.0,
                                 "abs_tol": 4.0, "mad_mult": 5.0},
+    # serve/compiles is a counter (it never rides into the history store,
+    # which indexes gauges only) but it still cross-host FOLDS through
+    # fold_gauges' direction lookup if a future summary carries it, and
+    # HF001 requires the explicit row: compile counts are costs, ±2 noise
+    "serve/compiles":          {"direction": "down", "rel_tol": 0.0,
+                                "abs_tol": 2.0, "mad_mult": 5.0},
     # scenario-factory gauges (tools/bench_scenario.py; ISSUE 9).  Every
     # entry is explicit — the ``shed_rate`` lesson: ``pad_waste_frac``
     # has no cost suffix and would gate (and cross-host fold) INVERTED

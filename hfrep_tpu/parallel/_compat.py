@@ -1,0 +1,17 @@
+"""The parallel-package face of the version-gated JAX API gate.
+
+The real gate lives in :mod:`hfrep_tpu.utils.jax_compat` (utils has no
+eager package ``__init__``, so ``train/steps.py`` can import it without
+cycling through ``hfrep_tpu.parallel``'s submodule re-exports).  The
+launch-path modules and tests import from here — the parallel package
+is where the gated APIs are consumed.
+"""
+
+from __future__ import annotations
+
+from hfrep_tpu.utils.jax_compat import (  # noqa: F401
+    HAS_SHARD_MAP,
+    ShardMapUnavailable,
+    axis_size,
+    shard_map,
+)

@@ -32,8 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from hfrep_tpu.parallel._compat import shard_map
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
 from hfrep_tpu.train.states import GanState

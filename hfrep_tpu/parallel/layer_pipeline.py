@@ -55,9 +55,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hfrep_tpu.parallel._compat import shard_map
 from hfrep_tpu.ops.layers import ACTIVATIONS
 from hfrep_tpu.utils.vma import match_vma
 from hfrep_tpu.parallel.sequence import (_local_chunk_scan, _sp_head_impl,

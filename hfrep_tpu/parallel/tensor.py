@@ -60,9 +60,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from hfrep_tpu.parallel._compat import axis_size, shard_map
 from hfrep_tpu.ops.layers import ACTIVATIONS
 from hfrep_tpu.utils.vma import match_vma
 
@@ -162,7 +162,7 @@ def _tp_lstm_local(params: dict, x: jnp.ndarray, axis_name: str, *,
     recurrence is :func:`tp_chunk_scan` from the zero carry.
     """
     h = params["recurrent_kernel"].shape[0]
-    hl = _check_width(h, lax.axis_size(axis_name))
+    hl = _check_width(h, axis_size(axis_name))
     act = ACTIVATIONS[activation]
     rec_act = ACTIVATIONS[recurrent_activation]
 
@@ -189,7 +189,7 @@ def _tp_assemble(y_loc: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     is typed varying even though the values agree (poisoning every
     downstream loss type), and the psum's invariant output is what lets
     AD see that the next layer's slice needs its transpose-psum."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     hl = y_loc.shape[-1]
     buf = jnp.zeros(y_loc.shape[:-1] + (hl * n_dev,), y_loc.dtype)
     buf = lax.dynamic_update_slice_in_dim(
@@ -239,7 +239,7 @@ def _tp_critic_local(d_params: dict, x: jnp.ndarray,
 
     dense = d_params["KerasDense_0"]["Dense_0"]
     bb, w, hl = h1_loc.shape
-    h = hl * lax.axis_size(axis_name)
+    h = hl * axis_size(axis_name)
     k_loc = lax.dynamic_slice_in_dim(
         dense["kernel"].reshape(w, h, -1),
         lax.axis_index(axis_name) * hl, hl, axis=1)       # (W, Hl, 1)

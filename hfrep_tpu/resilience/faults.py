@@ -19,13 +19,14 @@ Spec grammar (semicolon-separated directives)::
 ======== ===================== ==========================================
 kind     sites                 effect at the Nth occurrence
 ======== ===================== ==========================================
-sigterm  boundary (``chunk``,  a REAL ``os.kill(getpid(), SIGTERM)`` —
-         ``block``,            caught by the graceful-drain handler.
-         ``supervise``,        Also valid at io sites: the signal then
-         ``drain_barrier``,    lands DURING that host I/O call (e.g.
-         ``batcher``)          ``sigterm@snapshot_save=1`` = SIGTERM
-         or io                 mid-way through the final drain snapshot)
-preempt  boundary or io        set the drain flag directly (no signal)
+sigterm  boundary              a REAL ``os.kill(getpid(), SIGTERM)`` —
+         (:data:`BOUNDARY_    caught by the graceful-drain handler.
+         SITES`) or io         Also valid at io sites: the signal then
+                               lands DURING that host I/O call (e.g.
+                               ``sigterm@snapshot_save=1`` = SIGTERM
+                               mid-way through the final drain snapshot)
+preempt  boundary, io or       set the drain flag directly (no signal)
+         actor
 stall    boundary              sleep :data:`STALL_SECS` at the boundary —
                                a member that hangs instead of draining
                                (drives the supervisor's drain-barrier
@@ -34,19 +35,26 @@ stall    boundary              sleep :data:`STALL_SECS` at the boundary —
                                formation, turning queued requests into
                                a deadline storm the batcher must cancel
                                typed (never dispatch-and-forget)
-io_fail  io (``ckpt_save``,    raise ``OSError(EIO)`` from that I/O call
-         ``snapshot_save``,    (at ``serve_result``: the server's
-         ``obs_append``,       result-publish boundary — the request
-         ``manifest``,         must fail TYPED, never silently)
+io_fail  io (:data:`IO_SITES`: raise ``OSError(EIO)`` from that I/O call
+         ``ckpt_save``,        (at ``serve_result``: the server's
+         ``snapshot_save``,    result-publish boundary — the request
+         ``result_save``,      must fail TYPED, never silently)
+         ``bank_save``,
+         ``obs_append``,
+         ``manifest``,
          ``queue_put``,
          ``queue_get``,
          ``serve_result``)
-torn     post-save (``ckpt``,  truncate the just-written payload — a
-         ``snapshot``)         torn write that survived the process
+torn     post-save             truncate the just-written payload — a
+         (:data:`POST_SAVE_    torn write that survived the process
+         SITES`: ``ckpt``,
+         ``snapshot``,
+         ``queue_item``,
+         ``result``, ``bank``)
 corrupt  post-save             flip bytes mid-payload (bit rot)
-kill     actor,                tell the caller that owns the victim to
-         ``serve_worker``      kill it: the orchestration supervisor
-                               SIGKILLs the actor behind the Nth
+kill     actor (:data:`ACTOR_  tell the caller that owns the victim to
+         SITES`: ``actor``,    kill it: the orchestration supervisor
+         ``serve_worker``)     SIGKILLs the actor behind the Nth
                                observed queue item
                                (:func:`FaultPlan.actor` returns True;
                                only the supervisor knows the pids), the
@@ -55,6 +63,11 @@ kill     actor,                tell the caller that owns the victim to
                                mid-flight (its requests must still
                                reach typed terminal outcomes)
 ======== ===================== ==========================================
+
+The full per-group site vocabulary lives in the module-level registries
+:data:`BOUNDARY_SITES` / :data:`IO_SITES` / :data:`POST_SAVE_SITES` /
+:data:`ACTOR_SITES` — the single source of truth the static analyzer
+(HF002) round-trips every hook call and spec literal against.
 
 Examples::
 
@@ -86,6 +99,56 @@ IO_KINDS = ("io_fail",)
 POST_SAVE_KINDS = ("torn", "corrupt")
 ACTOR_KINDS = ("kill",)
 KINDS = BOUNDARY_KINDS + IO_KINDS + POST_SAVE_KINDS + ACTOR_KINDS
+
+#: THE site registry — every site each hook group fires at, one tuple per
+#: group.  This is the round-trip contract the cross-layer analyzer
+#: (rule HF002) enforces in both directions: a site string at an
+#: injection/hook call (``resilience.boundary("chunk")``,
+#: ``write_atomic(..., io_site="ckpt_save")``) or inside an
+#: ``HFREP_FAULTS`` spec must appear here, and an entry here that no
+#: hook call references is a dead registry row.  A typo'd site would
+#: otherwise just never fire — the silently-disarmed-injection failure
+#: mode — so :meth:`FaultPlan.parse` also rejects unknown sites at
+#: runtime.
+BOUNDARY_SITES = (
+    "chunk",          # chunked AE engine / scenario training chunk boundary
+    "block",          # GAN trainer / multi-seed epoch-block boundary
+    "window",         # walk-forward scoring-window boundary
+    "item",           # actor produce/consume item boundary
+    "idle",           # actor idle-poll boundary
+    "supervise",      # orchestration supervisor poll loop
+    "drain_barrier",  # coordinated pod-drain barrier crossing
+    "batcher",        # serving micro-batch formation loop
+    "serve_drive",    # serving selftest drive loop
+    "gan_block",      # conditional-GAN bank training block
+    "bank_block",     # stress-bank block publication boundary
+)
+IO_SITES = (
+    "ckpt_save",      # checkpoint directory writes (utils/checkpoint.py)
+    "snapshot_save",  # chunk/sub-block resume snapshots
+    "result_save",    # actor result artifact publication
+    "bank_save",      # scenario stress-bank block publication
+    "obs_append",     # telemetry event-stream appends
+    "manifest",       # run.json manifest writes
+    "queue_put",      # spool-queue item publication
+    "queue_get",      # spool-queue item claim/read
+    "serve_result",   # serving result-publish boundary
+)
+POST_SAVE_SITES = (
+    "ckpt",           # a published checkpoint directory
+    "snapshot",       # a published resume snapshot
+    "queue_item",     # a published spool-queue item
+    "result",         # a published actor result artifact
+    "bank",           # a published stress-bank block
+)
+ACTOR_SITES = (
+    "actor",          # orchestration fabric members (supervisor SIGKILLs)
+    "serve_worker",   # serving dispatch worker threads
+)
+#: every site any hook may be called with; boundary kinds (sigterm /
+#: preempt / stall) may target io and actor sites too (the signal lands
+#: during that I/O call / at that observed item)
+KNOWN_SITES = BOUNDARY_SITES + IO_SITES + POST_SAVE_SITES + ACTOR_SITES
 
 #: how long an injected ``stall`` holds its boundary — long enough that
 #: any realistic drain-barrier timeout fires first (the stalled member is
@@ -134,10 +197,18 @@ class FaultPlan:
             if kind not in KINDS:
                 raise FaultSpecError(
                     f"unknown fault kind {kind!r} (one of {', '.join(KINDS)})")
+            site = m.group("site")
+            if site not in KNOWN_SITES:
+                # an unknown site would parse fine and then never fire —
+                # the silently-disarmed injection the registry exists to
+                # prevent; fail the spec as loudly as an unknown kind
+                raise FaultSpecError(
+                    f"unknown fault site {site!r} (registry: "
+                    f"{', '.join(KNOWN_SITES)})")
             n = int(m.group("n"))
             if n < 1:
                 raise FaultSpecError(f"{part!r}: N is 1-based, got {n}")
-            directives.append(Directive(kind=kind, site=m.group("site"), n=n,
+            directives.append(Directive(kind=kind, site=site, n=n,
                                         count=int(m.group("count") or 1)))
         return cls(directives)
 

@@ -94,11 +94,14 @@ def _seed_shard(step, mesh, jit: bool = True):
     steps_per_call=1 block: the block scan folds the key per epoch,
     a different stream than the standalone remainder epoch consumes).
 
-    ``jax.shard_map`` is imported here, not at module top: runtimes
-    without it (this image's jax) can still use the vmap path and the
-    checkpoint/resume machinery — only seed-sharded execution needs it.
+    ``shard_map`` comes through the one guarded gate
+    (:mod:`hfrep_tpu.parallel._compat`): runtimes without it (this
+    image's jax) can still use the vmap path and the checkpoint/resume
+    machinery — only seed-sharded execution needs it, and it fails
+    typed (:class:`~hfrep_tpu.parallel._compat.ShardMapUnavailable`)
+    right here instead of an ImportError.
     """
-    from jax import shard_map
+    from hfrep_tpu.parallel._compat import shard_map
     (axis,) = mesh.axis_names
 
     def per_device(states, keys):

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from hfrep_tpu.utils.jax_compat import axis_size
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
 from hfrep_tpu.train.states import GanState, make_optimizers
@@ -85,7 +86,7 @@ def _psum_if(axis_name: Optional[str], grads, loss):
     if axis_name is None:
         return grads
     from hfrep_tpu.utils.vma import vma_of
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n > 1 and axis_name not in vma_of(loss):
         # On a >1 mesh the loss always varies under check_vma=True typing
         # (it depends on per-device data); an empty vma means the typing
@@ -212,7 +213,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         """Global (sample_b, …) tensor → this device's (batch, …) rows."""
         if sample_b == batch:
             return x
-        n = lax.axis_size(axis_name)    # static at trace time
+        n = axis_size(axis_name)    # static at trace time
         if sample_b != batch * n:
             raise ValueError(
                 f"sample_batch={sample_b} must equal batch_size={batch} × "

@@ -213,10 +213,38 @@ def write_atomic(path, writer: Callable[[Path], Optional[dict]],
     return dst
 
 
+def atomic_text(path, text: str) -> Path:
+    """Crash-consistent single-FILE publication: write to a hidden tmp
+    sibling, fsync, and ``os.replace`` into place — the file-shaped
+    sibling of :func:`write_atomic` for result/summary JSON that a
+    crashed process must never leave torn (rule HF003 enforces that
+    artifact writes go through one of the sanctioned writers).  No
+    retry/checksum machinery: callers that need the full durability
+    model (metadata, verification, fault hooks) want
+    :func:`write_atomic`."""
+    dst = Path(path).absolute()
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dst.parent / f".{dst.name}.tmp-{os.getpid()}"
+    try:
+        tmp.write_text(text, encoding="utf-8")
+        _fsync_path(tmp)
+        os.replace(tmp, dst)
+    finally:
+        if tmp.exists():
+            tmp.unlink(missing_ok=True)
+    try:
+        _fsync_path(dst.parent)
+    except OSError:
+        pass
+    return dst
+
+
 # ------------------------------------------------------------- save/restore
-def _write_msgpack(dst: Path, pytree: Any) -> None:
+def _write_msgpack(tmp: Path, pytree: Any) -> None:
+    """Stage the msgpack payload into ``tmp`` — always a
+    :func:`write_atomic` staging dir; the publish is the caller's."""
     import flax.serialization as ser
-    (dst / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
+    (tmp / "checkpoint.msgpack").write_bytes(ser.to_bytes(pytree))
 
 
 def save(path: str, pytree: Any, metadata: Optional[dict] = None,

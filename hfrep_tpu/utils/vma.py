@@ -13,7 +13,11 @@ the mesh axis names (and outside `shard_map` it is a no-op).
 from __future__ import annotations
 
 import jax
-from jax import lax
+
+try:                   # vma types and pcast exist together (newer jax)
+    from jax.lax import pcast as _pcast
+except ImportError:    # pinned jax 0.4.37: vma_of() is always ∅ there,
+    _pcast = None      # so match_vma's early return means this never runs
 
 
 def vma_of(x) -> frozenset:
@@ -55,6 +59,6 @@ def match_vma(x, like):
 
     def cast(leaf):
         missing = target - vma_of(leaf)
-        return lax.pcast(leaf, tuple(missing), to="varying") if missing else leaf
+        return _pcast(leaf, tuple(missing), to="varying") if missing else leaf
 
     return jax.tree_util.tree_map(cast, x)

@@ -839,3 +839,486 @@ class TestCli:
                         "    return jax.random.normal(k1, (2,)),"
                         " jax.random.normal(k2, (2,))\n")
         assert cli_main(["check", str(good), "--no-baseline"]) == 0
+
+
+# ====================================================================
+# Cross-layer rules (ISSUE 11): HF001–HF006 against a synthetic
+# ProjectModel.  Positive fixtures pin the historical bug each rule
+# encodes; negative fixtures pin the false-positive classes found while
+# burning the real repo down to zero.
+# ====================================================================
+from hfrep_tpu.analysis.project import (  # noqa: E402
+    DocRow, DocSchema, FileSummary, ProjectModel)
+from hfrep_tpu.analysis.rules.hf_fault_sites import FaultSiteRule
+from hfrep_tpu.analysis.rules.hf_obs_doc import ObsDocRule
+from hfrep_tpu.analysis.project import FAULTS_PATH
+
+
+def hf_model(**overrides):
+    base = dict(
+        gauge_prefixes=("bench/", "serve/", "scenario/"),
+        thresholds={"serve/qps": 1, "bench/known_rate": 2},
+        fault_sites={"boundary": {"chunk": 10}, "io": {"ckpt_save": 20},
+                     "post_save": {"ckpt": 30}, "actor": {"actor": 40}},
+        fault_kinds={"sigterm": "boundary", "preempt": "boundary",
+                     "io_fail": "io", "torn": "post_save",
+                     "kill": "actor"},
+        doc=DocSchema(rows=[DocRow("serve_drain", 5)],
+                      mentioned={"serve_drain", "serve/qps",
+                                 "bench/known_rate"}),
+        atomic_writers={"write_atomic", "atomic_text",
+                        "_write_with_retry"},
+    )
+    base.update(overrides)
+    return ProjectModel(**base)
+
+
+def run_hf(src, rule, relpath=None, **overrides):
+    return analyze_source(textwrap.dedent(src), path=relpath or "snippet.py",
+                          relpath=relpath or "snippet.py",
+                          rules=[RULES_BY_ID[rule]],
+                          project=hf_model(**overrides))
+
+
+# ------------------------------------------------------------------ HF001
+class TestGaugeThresholds:
+    def test_positive_missing_threshold_entry(self):
+        # THE bug: serve/shed_rate would gate and pod-fold inverted
+        # under the `_rate` higher-is-better suffix heuristic
+        fs = run_hf('obs.gauge("serve/shed_rate").set(1.0)\n', "HF001")
+        assert codes(fs) == ["HF001"]
+        assert "serve/shed_rate" in fs[0].message
+
+    def test_positive_counter_and_loop_resolved_fstring(self):
+        fs = run_hf("""
+            def emit(obs, a, b):
+                for name, value in (("x_rate", a), ("y_ms", b)):
+                    obs.gauge(f"bench/{name}").set(value)
+                obs.counter("scenario/widgets").inc()
+            """, "HF001")
+        assert codes(fs) == ["HF001"] * 3
+        named = {f.message.split("'")[1] for f in fs}
+        assert named == {"bench/x_rate", "bench/y_ms", "scenario/widgets"}
+
+    def test_negative_entry_exists_and_unprefixed(self):
+        fs = run_hf("""
+            def emit(obs):
+                obs.gauge("serve/qps").set(1.0)
+                obs.gauge("steps_per_sec").set(2.0)   # not a store prefix
+            """, "HF001")
+        assert fs == []
+
+    def test_negative_dynamic_open_vocabulary(self):
+        # bf16_probe-style per-cell series: open-ended by design, covered
+        # by README wildcard rows — never flagged
+        fs = run_hf("""
+            def emit(obs, h, tag):
+                obs.gauge(f"bench/bf16_probe_h{h}_{tag}").set(1.0)
+            """, "HF001")
+        assert fs == []
+
+    def test_negative_tests_are_exempt(self):
+        fs = run_hf('obs.gauge("serve/shed_rate").set(1.0)\n', "HF001",
+                    relpath="tests/test_fixture.py")
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf(
+            'obs.gauge("serve/shed_rate").set(1.0)  # noqa: HF001\n',
+            "HF001")
+        assert fs == []
+
+    def test_no_project_no_findings(self):
+        fs = analyze_source('obs.gauge("serve/shed_rate").set(1.0)\n',
+                            rules=[RULES_BY_ID["HF001"]])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ HF002
+class TestFaultSites:
+    def test_positive_unknown_hook_site(self):
+        fs = run_hf("""
+            from hfrep_tpu import resilience
+            resilience.boundary("chnk")
+            """, "HF002")
+        assert codes(fs) == ["HF002"]
+        assert "chnk" in fs[0].message
+
+    def test_positive_spec_unknown_site_and_kind(self):
+        fs = run_hf("""
+            import os
+            os.environ["HFREP_FAULTS"] = "sigterm@chnk=1"
+            SPEC = "zap@chunk=1"
+            """, "HF002")
+        assert codes(fs) == ["HF002", "HF002"]
+
+    def test_positive_kind_site_group_mismatch(self):
+        # torn (post-save kind) cannot fire at an io site
+        fs = run_hf('SPEC = "torn@ckpt_save=1"\n', "HF002")
+        assert codes(fs) == ["HF002"]
+
+    def test_negative_known_sites_and_cross_group_boundary_kind(self):
+        # sigterm landing mid-I/O (sigterm@ckpt_save) is sanctioned
+        fs = run_hf("""
+            from hfrep_tpu import resilience
+            resilience.boundary("chunk")
+            resilience.io_point("ckpt_save")
+            SPEC = "sigterm@ckpt_save=1;torn@ckpt=2;kill@actor=1"
+            """, "HF002")
+        assert fs == []
+
+    def test_negative_prose_with_at_sign(self):
+        fs = run_hf('EMAIL = "ops@example.com"\nDOC = "see kind@site"\n',
+                    "HF002")
+        assert fs == []
+
+    def test_negative_tests_exempt_for_malformed_specs(self):
+        fs = run_hf('SPEC = "what@chunk=1"\n', "HF002",
+                    relpath="tests/test_faults_fixture.py")
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf('SPEC = "zap@chunk=1"  # noqa: HF002\n', "HF002")
+        assert fs == []
+
+    def test_project_orphan_registry_entry(self):
+        model = hf_model(
+            fault_sites={"boundary": {"chunk": 7, "dead_site": 9}})
+        model.files = {
+            FAULTS_PATH: FileSummary(),
+            "x.py": FileSummary(fault_sites_used=[("boundary", "chunk", 3)]),
+        }
+        fs = FaultSiteRule().check_project(model)
+        assert [f.rule for f in fs] == ["HF002"]
+        assert "dead_site" in fs[0].message and fs[0].path == FAULTS_PATH
+        assert fs[0].line == 9
+
+    def test_project_orphans_need_registry_in_scope(self):
+        model = hf_model(
+            fault_sites={"boundary": {"dead_site": 9}})
+        model.files = {"x.py": FileSummary()}      # faults.py not analyzed
+        assert FaultSiteRule().check_project(model) == []
+
+
+# ------------------------------------------------------------------ HF003
+class TestAtomicPublish:
+    def test_positive_open_write_into_results(self):
+        fs = run_hf("""
+            import json
+            def main(rows):
+                with open("results/bench.json", "w") as f:
+                    json.dump(rows, f)
+            """, "HF003")
+        assert codes(fs) == ["HF003"]
+        assert "results" in fs[0].message
+
+    def test_positive_write_text_into_ckpt_dir(self):
+        fs = run_hf("""
+            def publish(ckpt_dir, s):
+                (ckpt_dir / "meta.json").write_text(s)
+            """, "HF003")
+        assert codes(fs) == ["HF003"]
+
+    def test_negative_staging_tmp_is_the_mechanism(self):
+        # the writer(tmp) callback convention: staging writes ARE atomic
+        # publication, not a violation of it
+        fs = run_hf("""
+            import numpy as np
+            def writer(tmp):
+                np.savez(tmp / "snapshot.npz", a=1)
+                (tmp / "manifest.json").write_text("{}")
+            """, "HF003")
+        assert fs == []
+
+    def test_negative_checkpoint_save_is_not_np_save(self):
+        # dotted ckpt.save() IS the atomic writer — only real numpy
+        # aliases count as raw array dumps
+        fs = run_hf("""
+            from hfrep_tpu.utils import checkpoint as ckpt
+            def f(path, tree):
+                ckpt.save(path + "/checkpoints/c1", tree)
+            """, "HF003")
+        assert fs == []
+
+    def test_negative_append_mode_and_sanctioned_fn(self):
+        fs = run_hf("""
+            import json
+            def append(path, rec):
+                with open(path / "history" / "history.jsonl", "a") as fh:
+                    fh.write(json.dumps(rec))
+            def write_atomic(path, writer):
+                open(path / "checkpoints" / "x", "w").write("staged")
+            """, "HF003")
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf("""
+            def main(rows):
+                open("results/bench.json", "w").write(rows)  # noqa: HF003
+            """, "HF003")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ HF004
+class TestObsDocSync:
+    def test_positive_undocumented_event(self):
+        fs = run_hf('def f(obs):\n    obs.event("mystery_event")\n',
+                    "HF004")
+        assert codes(fs) == ["HF004"]
+        assert "mystery_event" in fs[0].message
+
+    def test_positive_event_through_local_wrapper(self):
+        # the serve/server.py _emit pattern: one level of indirection
+        # must not hide an undocumented event
+        fs = run_hf("""
+            def _emit(name, **attrs):
+                from hfrep_tpu.obs import get_obs
+                get_obs().event(name, **attrs)
+            def g():
+                _emit("ghost_event", a=1)
+            """, "HF004")
+        assert codes(fs) == ["HF004"]
+        assert "ghost_event" in fs[0].message
+
+    def test_positive_undocumented_namespaced_instrument(self):
+        fs = run_hf('def f(obs):\n'
+                    '    obs.gauge("serve/undocumented").set(1)\n',
+                    "HF004")
+        assert codes(fs) == ["HF004"]
+
+    def test_negative_documented_and_unnamespaced(self):
+        fs = run_hf("""
+            def f(obs):
+                obs.event("serve_drain")
+                obs.gauge("serve/qps").set(1)
+                obs.gauge("steps_per_sec").set(2)    # un-namespaced: exempt
+            """, "HF004")
+        assert fs == []
+
+    def test_negative_wildcard_doc_row_covers_family(self):
+        model_doc = DocSchema(rows=[], mentioned={"train/<key>"})
+        fs = run_hf("""
+            def f(obs, k):
+                obs.gauge(f"train/{k}").set(1)
+            """, "HF004", doc=model_doc)
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf('def f(obs):\n'
+                    '    obs.event("mystery_event")  # noqa: HF004\n',
+                    "HF004")
+        assert fs == []
+
+    def test_project_stale_doc_row(self):
+        model = hf_model(doc=DocSchema(
+            rows=[DocRow("serve_drain", 5), DocRow("renamed_away", 9)],
+            mentioned={"serve_drain", "renamed_away"}),
+            doc_surface_complete=True)
+        from hfrep_tpu.analysis.project import Emission
+        model.files = {"s.py": FileSummary(emissions=[
+            Emission(kind="event", line=1, names=("serve_drain",))])}
+        fs = ObsDocRule().check_project(model)
+        assert [f.rule for f in fs] == ["HF004"]
+        assert "renamed_away" in fs[0].message and fs[0].line == 9
+
+    def test_project_stale_check_needs_full_surface(self):
+        # without full doc-surface coverage the stale check must not
+        # judge (a scoped run flags nothing) — exercised both via the
+        # explicit test knob and the real on-disk comparison
+        model = hf_model(doc=DocSchema(rows=[DocRow("renamed_away", 9)],
+                                       mentioned={"renamed_away"}),
+                         doc_surface_complete=False)
+        model.files = {"only_one.py": FileSummary()}
+        assert ObsDocRule().check_project(model) == []
+        model.doc_surface_complete = None     # decide from disk coverage
+        assert not model.covers_doc_surface()
+        assert ObsDocRule().check_project(model) == []
+
+    def test_project_wildcard_row_matches_dynamic_prefix(self):
+        from hfrep_tpu.analysis.project import Emission
+        model = hf_model(doc=DocSchema(
+            rows=[DocRow("bench/serve_qps_c{1k,10k,100k}", 3)],
+            mentioned=set()), doc_surface_complete=True)
+        model.files = {"t.py": FileSummary(emissions=[
+            Emission(kind="gauge", line=1, names=(),
+                     prefix="bench/serve_")])}
+        assert ObsDocRule().check_project(model) == []
+
+
+# ------------------------------------------------------------------ HF005
+class TestVersionGatedApi:
+    def test_positive_module_top_import(self):
+        # THE seed-failure class: from jax import shard_map at module
+        # top killed four modules and five test files at collection
+        fs = run_hf("from jax import shard_map\n", "HF005")
+        assert codes(fs) == ["HF005"]
+        assert "jax.shard_map" in fs[0].message
+
+    def test_positive_unguarded_attribute_references(self):
+        fs = run_hf("""
+            import jax
+            from jax import lax
+            def f(x, ax):
+                return jax.typeof(x), lax.axis_size(ax)
+            """, "HF005")
+        assert codes(fs) == ["HF005", "HF005"]
+
+    def test_negative_guarded_idioms(self):
+        # the _compat gate, the vma_of try/except, and hasattr branches
+        fs = run_hf("""
+            import jax
+            try:
+                from jax import shard_map
+            except ImportError:
+                shard_map = None
+            def f(x):
+                try:
+                    return jax.typeof(x).vma
+                except (AttributeError, TypeError):
+                    return None
+            def g():
+                if hasattr(jax, "shard_map"):
+                    return jax.shard_map
+            """, "HF005")
+        assert fs == []
+
+    def test_negative_experimental_path_not_in_registry(self):
+        fs = run_hf(
+            "from jax.experimental.shard_map import shard_map\n", "HF005")
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf("from jax import shard_map  # noqa: HF005\n", "HF005")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ HF006
+class TestSignalThreadSafety:
+    def test_positive_io_in_registered_handler(self):
+        fs = run_hf("""
+            import signal
+            def _h(signum, frame):
+                open("/tmp/log", "a").write("dying")
+            signal.signal(signal.SIGTERM, _h)
+            """, "HF006")
+        assert codes(fs) and set(codes(fs)) == {"HF006"}
+
+    def test_positive_lock_protected_attr_written_bare(self):
+        fs = run_hf("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._depth = 0
+                def a(self):
+                    with self._lock:
+                        self._depth += 1
+                def b(self):
+                    self._depth -= 1
+            """, "HF006")
+        assert codes(fs) == ["HF006"]
+        assert "_depth" in fs[0].message
+
+    def test_negative_flag_setting_handler(self):
+        fs = run_hf("""
+            import signal
+            def _h(signum, frame):
+                request_drain(f"signal {signum}")
+            signal.signal(signal.SIGTERM, _h)
+            def _alarm(signum, frame):
+                raise TimeoutError("watchdog")
+            signal.signal(signal.SIGALRM, _alarm)
+            """, "HF006")
+        assert fs == []
+
+    def test_negative_caller_holds_lock_helper(self):
+        # CircuitBreaker._trip: a private helper whose every call site
+        # holds the lock runs under it by contract
+        fs = run_hf("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = "closed"
+                def a(self):
+                    with self._lock:
+                        self._trip()
+                def _trip(self):
+                    self._state = "open"
+            """, "HF006")
+        assert fs == []
+
+    def test_negative_condition_aliases_the_lock(self):
+        # with self._idle: IS with self._lock: (server._idle pattern)
+        fs = run_hf("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._idle = threading.Condition(self._lock)
+                    self._n = 0
+                def a(self):
+                    with self._lock:
+                        self._n += 1
+                def b(self):
+                    with self._idle:
+                        self._n -= 1
+            """, "HF006")
+        assert fs == []
+
+    def test_noqa(self):
+        fs = run_hf("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._depth = 0
+                def a(self):
+                    with self._lock:
+                        self._depth += 1
+                def b(self):
+                    self._depth -= 1  # noqa: HF006
+            """, "HF006")
+        assert fs == []
+
+
+# -------------------------------------------- review-hardening regressions
+class TestReviewHardening:
+    def test_hf005_not_hasattr_polarity(self):
+        # `if not hasattr(...):` blesses the ELSE branch; a reference in
+        # the not-branch runs exactly when the API is absent and is a
+        # genuine finding
+        fs = run_hf("""
+            import jax
+            def f(x):
+                if not hasattr(jax, "shard_map"):
+                    return jax.shard_map(x)
+                else:
+                    return jax.shard_map(x)
+            """, "HF005")
+        assert [f.line for f in fs] == [5]
+
+    def test_doc_schema_survives_unbalanced_backtick_prose(self):
+        from hfrep_tpu.analysis.project import expand_doc_name
+        schema = DocSchema(mentioned={"p95 <= deadline", "x < y"})
+        assert schema.documents("p95 <= deadline")
+        assert not schema.documents("serve/qps")      # and no ValueError
+        assert expand_doc_name("p95 <= deadline")     # literal, no raise
+
+    def test_scoped_run_preserves_other_cache_entries(self, tmp_path):
+        # a `check one/` run must not wipe the warm cache of files
+        # outside its scope (the repo-wide gate's budget depends on it)
+        from hfrep_tpu.analysis.engine import analyze_paths, load_cache
+        d1, d2 = tmp_path / "one", tmp_path / "two"
+        d1.mkdir(), d2.mkdir()
+        (d1 / "a.py").write_text("x = 1\n")
+        (d2 / "b.py").write_text("y = 2\n")
+        cache = tmp_path / "cache.json"
+        analyze_paths([d1, d2], cache_path=cache)
+        assert len(load_cache(cache)) == 2
+        analyze_paths([d1], cache_path=cache)          # scoped
+        entries = load_cache(cache)
+        assert len(entries) == 2                       # b.py retained
+        (d2 / "b.py").unlink()
+        analyze_paths([d1], cache_path=cache)
+        assert len(load_cache(cache)) == 1             # pruned once gone

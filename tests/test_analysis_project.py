@@ -1,0 +1,290 @@
+"""The project-model pass pinned against the LIVE modules.
+
+The cross-layer rules are only as good as the registries the AST
+extractors pull out of ``faults.py`` / ``regress.py`` / ``history.py`` /
+``obs/README.md``.  These tests compare every extraction against the
+imported module's actual values, so a registry refactor (rename, move,
+re-shape) breaks the analyzer LOUDLY here instead of silently emptying
+a rule into a green no-op — the disarmed-sentinel failure mode the
+analyzer itself exists to prevent.
+
+Stdlib + repo imports only on the extraction side; the HF005 pin
+introspects the installed jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+from pathlib import Path
+
+import pytest
+
+from hfrep_tpu.analysis.engine import REPO_ROOT
+from hfrep_tpu.analysis.project import (
+    ABSENT_JAX_APIS,
+    ATOMIC_WRITER_DEFS,
+    doc_surface_files,
+    DocSchema,
+    ProjectModel,
+    collect_emissions,
+    collect_fault_sites,
+    expand_doc_name,
+    loop_constant_bindings,
+    parse_obs_readme,
+    resolve_names,
+    summarize_file,
+)
+
+
+def _model():
+    # registries only — no per-file summaries needed for these pins
+    return ProjectModel.from_file_summaries({})
+
+
+# --------------------------------------------------------------- registries
+class TestRegistryExtractionPins:
+    def test_fault_sites_match_live_module(self):
+        import hfrep_tpu.resilience.faults as faults
+
+        model = _model()
+        assert set(model.fault_sites["boundary"]) == set(faults.BOUNDARY_SITES)
+        assert set(model.fault_sites["io"]) == set(faults.IO_SITES)
+        assert set(model.fault_sites["post_save"]) == set(
+            faults.POST_SAVE_SITES)
+        assert set(model.fault_sites["actor"]) == set(faults.ACTOR_SITES)
+        # registry lines point INTO the registry assignments
+        for group in model.fault_sites.values():
+            for line in group.values():
+                assert line > 0
+
+    def test_fault_kinds_match_live_module(self):
+        import hfrep_tpu.resilience.faults as faults
+
+        model = _model()
+        assert set(model.fault_kinds) == set(faults.KINDS)
+        assert model.fault_kinds["sigterm"] == "boundary"
+        assert model.fault_kinds["io_fail"] == "io"
+        assert model.fault_kinds["torn"] == "post_save"
+        assert model.fault_kinds["kill"] == "actor"
+
+    def test_thresholds_match_live_module(self):
+        import hfrep_tpu.obs.regress as regress
+
+        model = _model()
+        assert set(model.thresholds) == set(regress.DEFAULT_THRESHOLDS)
+        # the two historical inversions MUST stay explicit
+        assert "serve/shed_rate" in model.thresholds
+        assert "scenario/pad_waste_frac" in model.thresholds
+
+    def test_gauge_prefixes_match_live_module(self):
+        import hfrep_tpu.obs.history as history
+
+        model = _model()
+        assert model.gauge_prefixes == history.GAUGE_PREFIXES
+
+    def test_atomic_writers_exist_where_declared(self):
+        model = _model()
+        assert {name for _, name in ATOMIC_WRITER_DEFS} == \
+            model.atomic_writers
+        for relpath, name in ATOMIC_WRITER_DEFS:
+            mod_path = REPO_ROOT / relpath
+            assert mod_path.exists(), relpath
+            tree = ast.parse(mod_path.read_text())
+            assert any(isinstance(n, ast.FunctionDef) and n.name == name
+                       for n in ast.walk(tree)), (relpath, name)
+
+    def test_doc_surface_covers_known_emitters(self):
+        surface = doc_surface_files()
+        # the stale-row gate must see every module that emits documented
+        # schema rows — the files that burned us are the pin
+        for relpath in ("hfrep_tpu/obs/__init__.py",
+                        "hfrep_tpu/serve/server.py",
+                        "hfrep_tpu/orchestrate/pipeline.py",
+                        "hfrep_tpu/experiments/cli.py",
+                        "tools/bench_serve.py", "tools/bench_scenario.py",
+                        "bench.py", "bench_extra.py"):
+            assert relpath in surface, relpath
+
+
+# ------------------------------------------------------------ HF005 registry
+class TestAbsentJaxRegistry:
+    """The absent-API table must describe the INSTALLED runtime: an entry
+    for an attribute that exists would flag live code (false positives);
+    a runtime upgrade that grows the APIs makes this fail, which is the
+    signal to retire entries + the kill list."""
+
+    @staticmethod
+    def _resolves(dotted: str) -> bool:
+        parts = dotted.split(".")
+        obj = importlib.import_module(parts[0])
+        for i, attr in enumerate(parts[1:], start=1):
+            if hasattr(obj, attr):
+                obj = getattr(obj, attr)
+                continue
+            try:
+                obj = importlib.import_module(".".join(parts[:i + 1]))
+            except ImportError:
+                return False
+        return True
+
+    def test_every_registry_entry_is_genuinely_absent(self):
+        jax = pytest.importorskip("jax")
+        from hfrep_tpu.analysis.project import PINNED_JAX
+
+        if jax.__version__ != PINNED_JAX:
+            pytest.skip(f"registry pinned against jax {PINNED_JAX}, "
+                        f"installed {jax.__version__} — re-curate "
+                        "ABSENT_JAX_APIS and the HF005 kill list")
+        for api in ABSENT_JAX_APIS:
+            assert not self._resolves(api), (
+                f"{api} exists on this runtime; stale ABSENT_JAX_APIS "
+                "entry would flag live code")
+
+    def test_compat_gate_matches_registry(self):
+        from hfrep_tpu.utils import jax_compat
+
+        assert jax_compat.HAS_SHARD_MAP == self._resolves("jax.shard_map")
+        # the fallback axis_size is importable either way
+        assert callable(jax_compat.axis_size)
+
+
+# ------------------------------------------------------------- doc schema
+class TestDocSchemaParsing:
+    def test_real_readme_yields_rows_and_mentions(self):
+        schema = _model().doc
+        row_names = {r.name for r in schema.rows}
+        # a spot-check across every schema table family
+        for expected in ("io_retry", "fault_injected", "actor_start",
+                         "queue_put", "serve_shed", "serve_drain",
+                         "scenario_bank_block", "result_healed",
+                         "serve/qps", "scenario/lanes",
+                         "bench/ae_chunk_speedup",
+                         "bench/prod_168x36_steps_per_sec",
+                         "bench/ae_epoch_time_ms"):
+            assert expected in row_names, expected
+        assert "events.jsonl" in schema.mentioned
+
+    def test_expand_doc_name_patterns(self):
+        import re
+
+        (exact,) = expand_doc_name("serve_drain")
+        assert re.match(exact, "serve_drain")
+        (braces,) = expand_doc_name("bench/serve_qps_c{1k,10k,100k}")
+        assert re.match(braces, "bench/serve_qps_c10k")
+        assert not re.match(braces, "bench/serve_qps_c5k")
+        (wild,) = expand_doc_name("bench/bf16_speedup_h{H}")
+        assert re.match(wild, "bench/bf16_speedup_h384")
+        (angle,) = expand_doc_name("train/<key>")
+        assert re.match(angle, "train/g_loss")
+
+    def test_documents_wildcard_mentions(self):
+        schema = DocSchema(mentioned={"compile:<name>"})
+        assert schema.documents("compile:dp_step")
+        assert not schema.documents("dispatch:dp_step")
+
+
+# ------------------------------------------------- per-file summarization
+class TestFileSummaries:
+    def test_wrapper_resolution_on_real_server_module(self):
+        src = (REPO_ROOT / "hfrep_tpu/serve/server.py").read_text()
+        summary = summarize_file(ast.parse(src))
+        events = {n for e in summary.emissions if e.kind == "event"
+                  for n in e.names}
+        # emitted exclusively through the _emit staticmethod wrapper
+        assert "serve_drain" in events
+        assert "serve_worker_exit" in events
+        sites = {(g, s) for g, s, _l in summary.fault_sites_used}
+        assert ("actor", "serve_worker") in sites
+        assert ("io", "serve_result") in sites
+
+    def test_loop_constant_bindings_and_fstring_resolution(self):
+        tree = ast.parse(
+            "def f(obs, a, b):\n"
+            "    for name, value in (('qps', a), ('p95_ms', b)):\n"
+            "        obs.gauge(f'serve/{name}').set(value)\n")
+        fn = tree.body[0]
+        bindings = loop_constant_bindings(fn)
+        assert bindings["name"] == {"qps", "p95_ms"}
+        call = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "gauge"][0]
+        names, prefix = resolve_names(call.args[0], bindings)
+        assert set(names) == {"serve/qps", "serve/p95_ms"}
+        assert prefix is None
+
+    def test_unresolvable_fstring_keeps_prefix(self):
+        tree = ast.parse("def f(obs, h):\n"
+                         "    obs.gauge(f'bench/probe_h{h}').set(1)\n")
+        summary = summarize_file(tree)
+        (em,) = [e for e in summary.emissions if e.kind == "gauge"]
+        assert em.names == () and em.prefix == "bench/probe_h"
+
+    def test_emissions_on_real_cli_scenario_loop(self):
+        src = (REPO_ROOT / "hfrep_tpu/experiments/cli.py").read_text()
+        summary = summarize_file(ast.parse(src))
+        gauges = {n for e in summary.emissions if e.kind == "gauge"
+                  for n in e.names}
+        assert {"scenario/lanes", "scenario/pad_waste_frac",
+                "scenario/windows_per_sec"} <= gauges
+
+    def test_collect_fault_sites_counts_signature_defaults(self):
+        tree = ast.parse(
+            "def write_atomic(path, writer, *, io_site='ckpt_save',\n"
+            "                 fault_site='ckpt'):\n"
+            "    pass\n")
+        sites = {(g, s) for g, s, _l in collect_fault_sites(tree)}
+        assert ("io", "ckpt_save") in sites
+        assert ("post_save", "ckpt") in sites
+
+    def test_digest_changes_with_registry_state(self):
+        a = ProjectModel(thresholds={"serve/qps": 1})
+        b = ProjectModel(thresholds={"serve/qps": 1, "serve/p50_ms": 2})
+        assert a.digest() != b.digest()
+        assert a.digest() == ProjectModel(
+            thresholds={"serve/qps": 1}).digest()
+
+
+# --------------------------------------------------- whole-repo assembly
+class TestWholeRepoModel:
+    @pytest.fixture(scope="class")
+    def model(self):
+        summaries = {}
+        targets = [REPO_ROOT / "hfrep_tpu", REPO_ROOT / "tools",
+                   REPO_ROOT / "bench.py", REPO_ROOT / "bench_extra.py"]
+        files = []
+        for t in targets:
+            files.extend(sorted(t.rglob("*.py")) if t.is_dir() else [t])
+        for f in files:
+            rel = f.relative_to(REPO_ROOT).as_posix()
+            summaries[rel] = summarize_file(ast.parse(f.read_text()))
+        return ProjectModel.from_file_summaries(summaries)
+
+    def test_every_tracked_static_gauge_has_a_threshold(self, model):
+        tracked = [n for n in model.emitted_names(kinds=("gauge", "counter"))
+                   if n.startswith(model.gauge_prefixes)]
+        missing = [n for n in tracked if n not in model.thresholds]
+        assert not missing, missing
+
+    def test_every_hook_site_is_registered(self, model):
+        for path, s in model.files.items():
+            for group, site, line in s.fault_sites_used:
+                assert site in model.fault_sites[group], (path, line, site)
+
+    def test_no_orphan_registry_sites(self, model):
+        used = {(g, s) for f in model.files.values()
+                for g, s, _l in f.fault_sites_used}
+        for group, registry in model.fault_sites.items():
+            for site in registry:
+                assert (group, site) in used, (group, site)
+
+
+class TestRegistryLineFidelity:
+    def test_site_registry_lines_are_per_element(self):
+        # a dead-entry finding must point at the site's own row of the
+        # multi-line registry tuple, not the assignment header
+        model = _model()
+        for group in ("boundary", "io", "post_save", "actor"):
+            lines = list(model.fault_sites[group].values())
+            if len(lines) > 1:
+                assert len(set(lines)) > 1, group

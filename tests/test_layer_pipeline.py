@@ -14,6 +14,13 @@ from jax.sharding import Mesh
 
 needs_2 = pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
 
+from hfrep_tpu.parallel._compat import HAS_SHARD_MAP  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason="jax.shard_map absent on this runtime (pinned jax; "
+           "see hfrep_tpu/analysis/HF005_KILL_LIST.md)")
+
 
 def _mesh():
     return Mesh(np.asarray(jax.devices()[:2]), ("pp",))

@@ -9,10 +9,16 @@ import pytest
 
 from hfrep_tpu.config import ExperimentConfig, MeshConfig, ModelConfig, TrainConfig
 from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.parallel._compat import HAS_SHARD_MAP, axis_size
 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
 from hfrep_tpu.parallel.mesh import make_mesh
 from hfrep_tpu.train.states import init_gan_state
 from hfrep_tpu.train.trainer import GanTrainer
+
+needs_shard_map = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason="jax.shard_map absent on this runtime (pinned jax; "
+           "see hfrep_tpu/analysis/HF005_KILL_LIST.md)")
 
 MCFG = ModelConfig(features=5, window=8, hidden=8)
 
@@ -34,6 +40,7 @@ def test_mesh_uses_all_devices():
     pytest.param("mtss_gan", marks=pytest.mark.slow),
     pytest.param("mtss_wgan", marks=pytest.mark.slow),
     pytest.param("mtss_wgan_gp", marks=pytest.mark.slow)])
+@needs_shard_map
 def test_dp_step_runs_and_replicates(family, dataset):
     mesh = make_mesh()
     tcfg = TrainConfig(batch_size=16, n_critic=2, steps_per_call=2)
@@ -51,6 +58,7 @@ def test_dp_step_runs_and_replicates(family, dataset):
         np.testing.assert_array_equal(shards[0], s)
 
 
+@needs_shard_map
 def test_dp_batch_divisibility_error(dataset):
     mesh = make_mesh()
     pair = build_gan(MCFG)
@@ -58,6 +66,7 @@ def test_dp_batch_divisibility_error(dataset):
         make_dp_multi_step(pair, TrainConfig(batch_size=9), dataset, mesh)
 
 
+@needs_shard_map
 def test_dp_trainer_end_to_end(dataset):
     cfg = ExperimentConfig(
         model=dataclasses.replace(MCFG, family="wgan"),
@@ -70,6 +79,7 @@ def test_dp_trainer_end_to_end(dataset):
 
 
 @pytest.mark.slow
+@needs_shard_map
 def test_dp_gradient_is_global_batch_mean(dataset):
     """Axis-normalized per-shard gradients must equal the global-batch
     gradient.
@@ -80,7 +90,7 @@ def test_dp_gradient_is_global_batch_mean(dataset):
     per-shard gradients (transpose of the implicit replicated→varying
     broadcast), so the shard side divides by the axis size — the same
     normalization `hfrep_tpu.train.steps._psum_if` applies."""
-    from jax import shard_map
+    from hfrep_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh(MeshConfig())
@@ -98,7 +108,7 @@ def test_dp_gradient_is_global_batch_mean(dataset):
 
     def shard_grad(p, x):
         g = jax.grad(loss)(p, x)     # already psum'd across the mesh
-        return jax.tree_util.tree_map(lambda t: t / jax.lax.axis_size("dp"), g)
+        return jax.tree_util.tree_map(lambda t: t / axis_size("dp"), g)
 
     fn = shard_map(shard_grad, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P())
     g_dp = fn(params, batch)
@@ -127,6 +137,7 @@ def test_dp_pallas_backend_on_tpu(dataset):
 
 
 @pytest.mark.slow
+@needs_shard_map
 def test_dp_nan_guard_path(dataset):
     """The failure-detection path under data parallelism: a clean dp run
     with the guard on trains and stays replicated; poisoned data trips
@@ -153,6 +164,7 @@ def test_dp_nan_guard_path(dataset):
     assert tr2.recoveries > 2
 
 
+@needs_shard_map
 def test_psum_if_handles_both_vma_cases(dataset):
     """`steps._psum_if` must produce the global-batch-mean gradient for
     BOTH backward-pass flavors: autodiff'd paths (grads auto-psum'd by the
@@ -160,7 +172,7 @@ def test_psum_if_handles_both_vma_cases(dataset):
     paths (hand-computed per-device cotangents, typed varying → pmean).
     The pallas LSTM kernels are custom_vjp, so the second case is what a
     multi-chip pallas run hits; this exercises it without a TPU."""
-    from jax import shard_map
+    from hfrep_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from hfrep_tpu.train.steps import _psum_if
@@ -211,6 +223,7 @@ def test_psum_if_handles_both_vma_cases(dataset):
     pytest.param("mtss_wgan_gp", 8, marks=pytest.mark.slow),
     pytest.param("mtss_wgan_gp", 4, marks=pytest.mark.slow),
     ("mtss_wgan_gp", 2)])
+@needs_shard_map
 def test_dp_trajectory_matches_single_device(family, n_dev, dataset):
     """dp=8 with controlled global sampling must follow the *whole* loss
     trajectory (and land on the same parameters) as a single-device run at

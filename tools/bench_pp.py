@@ -131,9 +131,9 @@ def _bench(args, obs):
         cm = "" if r["chip_model"] is None else f"  chip-model {r['chip_model']:.2f}x"
         print(f"{r['config']:14s} {r['ms_per_epoch']:9.1f} ms/epoch  "
               f"{r['vs_plain']:.2f}x plain{cm}")
-    os.makedirs("results", exist_ok=True)
-    with open("results/bench_pp.json", "w") as f:
-        json.dump({"window": args.window, "rows": rows}, f, indent=2)
+    from hfrep_tpu.utils.checkpoint import atomic_text
+    atomic_text("results/bench_pp.json",
+                json.dumps({"window": args.window, "rows": rows}, indent=2))
     for r in rows:
         obs.gauge(f"bench/{r['config']}/ms_per_epoch").set(
             r["ms_per_epoch"], vs_plain=r["vs_plain"])

@@ -11,7 +11,11 @@
 # on new violations even when this script isn't invoked directly.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python -m hfrep_tpu.analysis check \
+# env-stripped like the self-tests below: the two-phase analyzer (and
+# its HF002 spec checks) must judge the tree, not whatever ambient
+# fault plan / telemetry env this shell happens to carry.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
+    python -m hfrep_tpu.analysis check \
     hfrep_tpu tools tests bench.py bench_extra.py "$@"
 # telemetry schema gate: writer (hfrep_tpu.obs) and parser (obs.report)
 # must agree on the committed fixture run directory.  Status goes to

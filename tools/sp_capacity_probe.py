@@ -158,9 +158,8 @@ def cmd_search() -> int:
            "bytes_per_w": slope, "fixed_bytes": icept,
            "hbm_bytes": HBM_BYTES, "points": pts,
            "sp_projected_max_w": proj}
-    os.makedirs("results", exist_ok=True)
-    with open("results/sp_capacity.json", "w") as f:
-        json.dump(out, f, indent=2)
+    from hfrep_tpu.utils.checkpoint import atomic_text
+    atomic_text("results/sp_capacity.json", json.dumps(out, indent=2))
     print(json.dumps({k: out[k] for k in
                       ("plain_max_w", "first_overflow_w", "sp_projected_max_w")}))
     return 0
