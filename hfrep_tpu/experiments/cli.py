@@ -525,6 +525,8 @@ def cmd_train_gan(args) -> int:
         try:
             return _cmd_train_gan_impl(args)
         except Preempted as e:
+            from hfrep_tpu.obs.crash import bundle_if_enabled
+            bundle_if_enabled(e)   # flight recorder: drain forensics
             # graceful drain: the final checkpoint is on disk and the obs
             # session's run_end still lands; 75 = EX_TEMPFAIL (re-run with
             # --resume to continue the schedule)
@@ -662,6 +664,8 @@ def cmd_sweep(args) -> int:
         try:
             return _cmd_sweep_impl(args)
         except Preempted as e:
+            from hfrep_tpu.obs.crash import bundle_if_enabled
+            bundle_if_enabled(e)   # flight recorder: drain forensics
             # only the --resume path has a snapshot to come back to; a
             # bare sweep would silently retrain from scratch on re-run
             hint = ("re-run the same command to resume from the last chunk"
@@ -822,6 +826,8 @@ def cmd_pipeline(args) -> int:
         try:
             return _cmd_pipeline_impl(args)
         except Preempted as e:
+            from hfrep_tpu.obs.crash import bundle_if_enabled
+            bundle_if_enabled(e)   # flight recorder: drain forensics
             print(f"preempted: {e}; re-run with --resume to continue "
                   "from the drained state", file=sys.stderr)
             return 75
@@ -898,6 +904,8 @@ def cmd_serve(args) -> int:
         try:
             return _cmd_serve_impl(args)
         except Preempted as e:
+            from hfrep_tpu.obs.crash import bundle_if_enabled
+            bundle_if_enabled(e)   # flight recorder: drain forensics
             # graceful drain: admission stopped, in-flight flushed, every
             # request reached a typed terminal outcome; 75 = EX_TEMPFAIL
             print(f"preempted: {e}", file=sys.stderr)
@@ -990,6 +998,8 @@ def cmd_scenario(args) -> int:
         try:
             return _cmd_scenario_impl(args)
         except Preempted as e:
+            from hfrep_tpu.obs.crash import bundle_if_enabled
+            bundle_if_enabled(e)   # flight recorder: drain forensics
             print(f"preempted: {e}; re-run with --resume to continue "
                   "(published blocks/windows are kept and verified)",
                   file=sys.stderr)

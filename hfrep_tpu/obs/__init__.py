@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import time
 from pathlib import Path
 from typing import IO, Dict, List, Optional
@@ -57,9 +58,12 @@ SCHEMA_VERSION = 1
 #: ``--self-test`` validate against this set)
 EVENT_TYPES = ("span", "metric", "memory", "event")
 
-#: cap on retained histogram samples — the JSONL stream keeps everything,
-#: the in-memory registry only needs enough for summary percentiles
-_HIST_CAP = 65536
+#: log-bucket resolution of the streaming histogram: buckets per decade.
+#: 100 → ~2.3% relative bucket width, ~2.4k live buckets across 1e-12..
+#: 1e12 worst case (stored sparsely) — the registry's memory is O(spread)
+#: instead of O(samples), so a 100k-request serve run or a week-long soak
+#: no longer holds every sample
+_HIST_BUCKETS_PER_DECADE = 100
 
 
 def _json_safe(v):
@@ -116,19 +120,72 @@ class Gauge:
 
 
 class Histogram:
-    """Sample accumulator; summary percentiles come from the registry
-    snapshot, full fidelity from the JSONL stream."""
+    """Bounded log-bucket streaming accumulator.
+
+    The JSONL stream keeps full per-sample fidelity (every ``observe``
+    still lands as one metric line); the in-memory registry keeps only
+    sparse log-bucket counts + exact n/sum/min/max, so its footprint is
+    O(value spread), never O(samples) — the unbounded per-metric sample
+    list was the one structure a 100k-request serve run or a long soak
+    grew without limit.  Nearest-rank percentiles come back as the
+    holding bucket's geometric midpoint, clamped to the observed
+    [min, max]: within one bucket width (~2.3% relative,
+    :data:`_HIST_BUCKETS_PER_DECADE`) of the exact sample statistic
+    (pinned by ``tests/test_obs.py``).
+    """
 
     def __init__(self, obs: "Obs", name: str):
         self._obs, self.name = obs, name
-        self.samples: List[float] = []
+        self.counts: Dict[int, int] = {}    # log-bucket index -> count
+        self.n = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._n_zero = 0                    # exactly-0.0 samples
+        self._n_neg = 0                     # negative samples (rare)
 
     def observe(self, v: float, **attrs) -> None:
-        if len(self.samples) < _HIST_CAP:
-            self.samples.append(float(v))
+        v = float(v)
+        self.n += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if v > 0.0 and math.isfinite(v):
+            idx = math.floor(math.log10(v) * _HIST_BUCKETS_PER_DECADE)
+            self.counts[idx] = self.counts.get(idx, 0) + 1
+        elif v == 0.0:
+            self._n_zero += 1
+        else:
+            self._n_neg += 1                # negatives + non-finite
         self._obs._emit({"type": "metric", "kind": "histogram",
-                         "name": self.name, "value": float(v),
+                         "name": self.name, "value": v,
                          **_json_safe(attrs)})
+
+    def percentile(self, pct: float) -> Optional[float]:
+        """Nearest-rank percentile (rank ``ceil(pct/100 · n)`` — the one
+        definition the serve loadgen and the report share), resolved to
+        the holding bucket's representative value."""
+        if self.n == 0:
+            return None
+        # ceil(pct/100 · n) without int(pct) truncation: percentile(99.9)
+        # must resolve the p99.9 rank, not silently return p99
+        rank = max(1, math.ceil(self.n * float(pct) / 100.0))
+        acc = self._n_neg
+        if rank <= acc:
+            return self.min
+        acc += self._n_zero
+        if rank <= acc:
+            return 0.0
+        for idx in sorted(self.counts):
+            acc += self.counts[idx]
+            if rank <= acc:
+                lo = 10.0 ** (idx / _HIST_BUCKETS_PER_DECADE)
+                hi = 10.0 ** ((idx + 1) / _HIST_BUCKETS_PER_DECADE)
+                rep = math.sqrt(lo * hi)
+                return min(max(rep, self.min), self.max)
+        return self.max
 
 
 class _NullInstrument:
@@ -286,20 +343,14 @@ class Obs:
         self._emit({"type": "event", "name": name, **_json_safe(attrs)})
 
     def summary(self) -> dict:
-        """Registry state as plain data (also the ``run_end`` payload)."""
-        hist = {}
-        for name, h in self._histograms.items():
-            s = sorted(h.samples)
-            n = len(s)
-            hist[name] = {
-                "n": n,
-                "p50": s[n // 2] if n else None,
-                # nearest-rank p95 = rank ceil(0.95 n), in integer math:
-                # int(n * 0.95) overshoots by one whenever 0.95 n is whole
-                # (n = 20 would report the max as p95)
-                "p95": s[max(0, (n * 95 + 99) // 100 - 1)] if n else None,
-                "max": s[-1] if n else None,
-            }
+        """Registry state as plain data (also the ``run_end`` payload).
+        Histogram percentiles are log-bucket resolved (within one bucket
+        width of the exact nearest-rank statistic); ``max`` is exact."""
+        hist = {name: {"n": h.n,
+                       "p50": _json_safe(h.percentile(50)),
+                       "p95": _json_safe(h.percentile(95)),
+                       "max": _json_safe(h.max)}
+                for name, h in self._histograms.items()}
         return {"counters": {k: c.value for k, c in self._counters.items()},
                 "gauges": {k: g.value for k, g in self._gauges.items()},
                 "histograms": hist}
@@ -404,13 +455,31 @@ def session(run_dir, **manifest_extra):
     ``run_dir`` yields the :data:`NULL` sink (telemetry stays off, every
     hook a no-op); otherwise the run_end summary, flush and close are
     guaranteed even when the body raises, and the report hint is printed
-    on the way out."""
+    on the way out.
+
+    Flight recorder: any exception that escapes the body lands a
+    crash-forensics bundle (last-N events, manifest, env, traceback) as
+    an atomic ``crash_<run_id>/`` directory under the run dir
+    (:mod:`hfrep_tpu.obs.crash`), so "what was the system doing when it
+    died" survives the death.  A clean ``SystemExit(0)`` does not
+    bundle.  Drains the body HANDLES (the CLIs catch Preempted and
+    return exit 75) bundle explicitly at the handler via
+    :func:`hfrep_tpu.obs.crash.bundle_if_enabled` — a drive that
+    recovers from a Preempted and completes cleanly (the walk-forward
+    drill's injected-preempt→resume path) must NOT leave a crash bundle
+    for a successful run.
+    """
     if not run_dir:
         yield NULL
         return
     obs = enable(run_dir, **manifest_extra)
     try:
         yield obs
+    except BaseException as e:
+        if not (isinstance(e, SystemExit) and e.code in (0, None)):
+            from hfrep_tpu.obs import crash
+            crash.write_crash_bundle(obs, e)
+        raise
     finally:
         disable()
         # stderr, not stdout: the bench probes' single-JSON-line stdout

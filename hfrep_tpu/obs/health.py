@@ -1,0 +1,227 @@
+"""In-graph training health: grad/update/param norms + nonfinite counts.
+
+The paper's failure mode is silent: WGAN-GP critic losses go NaN and the
+host loop keeps dispatching — nothing inside the jitted scans measures
+gradient or weight health, so divergence is discovered at evaluation
+time, thousands of epochs too late (the flight-recorder gap, ISSUE 12).
+This module closes it *without changing a single compiled program when
+off and without adding a single device→host sync when on*:
+
+* the step builders (:mod:`hfrep_tpu.train.steps`,
+  :mod:`hfrep_tpu.replication.engine`) consult :func:`active` at BUILD
+  time.  Off (the default): the traced graph is the literal pre-health
+  program — the fp32 jaxpr pins hold by construction.  On: the steps
+  additionally compute global grad-norm, update-norm, param-norm and a
+  nonfinite element count *inside the existing scan carries* and return
+  them as extra metric/trace outputs.  Those outputs are pure functions
+  of values the step already computes, so the training trajectory is
+  bit-identical either way (pinned by ``tests/test_obs_health.py``);
+* the values reach the host only at the boundaries the drives already
+  sync at (the trainer's per-block metrics ``device_get``, the chunked
+  AE engine's continue/stop scalar) and surface as ``health/*`` gauges;
+* :attr:`HealthConfig.abort_on_nonfinite` arms the tripwire: a nonfinite
+  count observed at a boundary raises a typed :class:`NumericFault`
+  after dumping the offending carry + metrics to an atomic forensic
+  directory (``numeric_fault_<epoch>/`` via ``write_atomic``) — the
+  crash-forensics layer (:mod:`hfrep_tpu.obs.crash`) then bundles the
+  event tail around it.
+
+Activation: :func:`configure` programmatically, or the ``HFREP_HEALTH``
+env var — ``1``/``on`` enables measurement, ``abort`` additionally arms
+the tripwire (read once per process, like ``HFREP_FAULTS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+ENV_HEALTH = "HFREP_HEALTH"
+
+#: the gauge vocabulary this layer emits (every name has an explicit
+#: ``regress.DEFAULT_THRESHOLDS`` row — the HF001 contract)
+GAUGES = (
+    "health/g_grad_norm",
+    "health/d_grad_norm",
+    "health/update_norm",
+    "health/param_norm",
+    "health/nonfinite",
+    "health/ae_grad_norm",
+    "health/ae_param_norm",
+    "health/ae_nonfinite",
+)
+
+#: metric-dict keys the GAN steps add when health is on (the trainer
+#: maps ``health_<x>`` -> the ``health/<x>`` gauge at block boundaries)
+STEP_KEYS = ("health_g_grad_norm", "health_d_grad_norm",
+             "health_update_norm", "health_param_norm", "health_nonfinite")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """The flight recorder's in-graph health knobs."""
+
+    #: measure grad/update/param norms + nonfinite counts in-graph
+    enabled: bool = True
+    #: a nonfinite count observed at a boundary raises :class:`NumericFault`
+    #: (after the forensic dump) instead of training on
+    abort_on_nonfinite: bool = False
+    #: forensic dump location; None = ``<obs run_dir>/`` when telemetry is
+    #: on, else the drive's checkpoint/resume dir, else no dump
+    dump_dir: Optional[str] = None
+
+
+class NumericFault(RuntimeError):
+    """Training produced nonfinite gradients/weights and the health
+    tripwire is armed.  Carries the boundary site, the epoch and the
+    forensic dump path (when one was written) — the crash-forensics
+    bundle picks these up via ``__dict__``."""
+
+    def __init__(self, site: str, epoch: Optional[int] = None,
+                 nonfinite: Optional[float] = None,
+                 dump: Optional[str] = None,
+                 detail: Optional[str] = None):
+        self.site, self.epoch, self.nonfinite, self.dump = (
+            site, epoch, nonfinite, dump)
+        msg = f"nonfinite values detected at {site} boundary"
+        if epoch is not None:
+            msg += f" (epoch {epoch})"
+        if nonfinite:
+            msg += f": {int(nonfinite)} nonfinite element(s)"
+        if detail:
+            msg += f" [{detail}]"
+        if dump:
+            msg += f"; forensic dump at {dump}"
+        super().__init__(msg)
+
+
+_active: Optional[HealthConfig] = None
+_env_consumed = False
+
+
+def configure(cfg: Optional[HealthConfig]) -> Optional[HealthConfig]:
+    """Install (or clear, with None) the process-wide health config."""
+    global _active, _env_consumed
+    _active, _env_consumed = cfg, True
+    return cfg
+
+
+def active() -> Optional[HealthConfig]:
+    """The installed config, else one parsed from ``HFREP_HEALTH`` (read
+    once per process); None when health telemetry is off — the builders'
+    one branch point."""
+    global _active, _env_consumed
+    if _active is None and not _env_consumed:
+        spec = (os.environ.get(ENV_HEALTH) or "").strip().lower()
+        if spec and spec not in ("0", "off", "false"):
+            _active = HealthConfig(
+                enabled=True, abort_on_nonfinite=(spec == "abort"))
+        _env_consumed = True
+    if _active is not None and not _active.enabled:
+        return None
+    return _active
+
+
+# ------------------------------------------------------- in-graph helpers
+def tree_sq_norm(tree):
+    """Σ‖leaf‖² over a pytree, accumulated in float32 (identity cast on
+    fp32 inputs — the precision-policy discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
+def tree_norm(tree):
+    """Global L2 norm of a pytree (float32)."""
+    import jax.numpy as jnp
+    return jnp.sqrt(tree_sq_norm(tree))
+
+
+def tree_nonfinite(tree):
+    """Count of non-finite elements across a pytree, as float32 (floats
+    ride the existing metric plumbing; the count is exact well past any
+    realistic parameter count)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            total = total + jnp.sum(
+                (~jnp.isfinite(leaf)).astype(jnp.float32))
+    return total
+
+
+def tree_update_sq_norm(old_tree, new_tree):
+    """Σ‖new − old‖² over two same-structure pytrees (float32) — the
+    per-boundary update magnitude."""
+    import jax
+    import jax.numpy as jnp
+
+    old_l = jax.tree_util.tree_leaves(old_tree)
+    new_l = jax.tree_util.tree_leaves(new_tree)
+    total = jnp.zeros((), jnp.float32)
+    for o, n in zip(old_l, new_l):
+        total = total + jnp.sum(jnp.square(
+            n.astype(jnp.float32) - o.astype(jnp.float32)))
+    return total
+
+
+# -------------------------------------------------------------- forensics
+def dump_forensics(dump_dir, carry, detail: Optional[dict] = None,
+                   name: str = "numeric_fault") -> Optional[str]:
+    """Persist the offending carry pytree (+ a JSON detail document)
+    atomically under ``dump_dir/<name>``; returns the dump path, or None
+    when nothing could be written.  Best-effort by design: forensics
+    must never mask the fault they describe."""
+    if dump_dir is None:
+        return None
+    try:
+        import json
+        from pathlib import Path
+
+        import jax
+        import numpy as np
+
+        from hfrep_tpu.utils import checkpoint as ckpt
+
+        leaves = [np.asarray(x) for x in
+                  jax.device_get(jax.tree_util.tree_leaves(carry))]
+        doc = json.dumps(detail or {}, default=str, indent=2)
+
+        def writer(tmp: Path) -> None:
+            np.savez(tmp / "carry.npz",
+                     **{f"leaf_{i}": v for i, v in enumerate(leaves)})
+            (tmp / "detail.json").write_text(doc)
+
+        path = Path(dump_dir) / name
+        ckpt.write_atomic(path, writer,
+                          metadata={"kind": "numeric_fault_dump",
+                                    "n_leaves": len(leaves)})
+        return str(path)
+    except Exception:
+        return None
+
+
+def resolve_dump_dir(cfg: HealthConfig,
+                     fallback: Optional[str] = None) -> Optional[str]:
+    """Where a forensic dump should land: the configured dir, else the
+    active obs run dir, else the caller's fallback (checkpoint/resume
+    dir), else nowhere."""
+    if cfg.dump_dir:
+        return cfg.dump_dir
+    try:
+        from hfrep_tpu.obs import get_obs
+        obs = get_obs()
+        if obs.enabled:
+            return str(obs.run_dir)
+    except Exception:
+        pass
+    return fallback

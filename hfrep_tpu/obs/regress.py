@@ -150,6 +150,29 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                  "abs_tol": 0.05, "mad_mult": 5.0},
     "scenario/bank_windows_per_sec": {"direction": "up", "rel_tol": 0.15,
                                       "mad_mult": 5.0},
+    # flight-recorder health gauges (hfrep_tpu/obs/health.py; ISSUE 12).
+    # Diagnostics, not perf: directions matter mainly for the cross-host
+    # FOLD (a pod reports its WORST member's health), so norms are
+    # "down" (a growing grad/update norm is instability) with generous
+    # relative floors — the NaN tripwire, not the gate, is the alarm —
+    # and the nonfinite counts use absolute floors (any value > 0 has
+    # already fired a ``numeric_fault`` event; gating re-litigates it).
+    "health/g_grad_norm":   {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/d_grad_norm":   {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/update_norm":   {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/param_norm":    {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/nonfinite":     {"direction": "down", "rel_tol": 0.0,
+                             "abs_tol": 0.5, "mad_mult": 0.0},
+    "health/ae_grad_norm":  {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/ae_param_norm": {"direction": "down", "rel_tol": 1.0,
+                             "mad_mult": 5.0},
+    "health/ae_nonfinite":  {"direction": "down", "rel_tol": 0.0,
+                             "abs_tol": 0.5, "mad_mult": 0.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import re
 import sys
 import time
 from pathlib import Path
@@ -109,6 +110,173 @@ def load_events(run_dir, strict: bool = False) -> List[dict]:
     return load_jsonl(Path(run_dir) / EVENTS_NAME, parse_event,
                       strict=strict,
                       torn_hint="run was likely killed mid-write")
+
+
+# ------------------------------------------------------ trace collection
+#: event names that terminate a trace — the zero-orphan contract
+#: (``bench_serve --self-test``) asserts every submitted trace reaches
+#: one of these
+TERMINAL_TRACE_EVENTS = ("serve_complete", "serve_shed",
+                         "serve_deadline_miss", "serve_degraded",
+                         "serve_fault", "result_publish")
+
+
+#: a live or rotated event stream — and nothing else: the crash bundle's
+#: ``events_tail.jsonl`` is a COPY of stream tails, and matching it
+#: would return every pre-crash hop twice on exactly the crashed run
+#: dirs the flight recorder targets
+_STREAM_NAME_RE = re.compile(r"^events(-\d+)?\.jsonl$")
+
+
+def is_stream_file(path: Path) -> bool:
+    return bool(_STREAM_NAME_RE.match(path.name))
+
+
+def iter_event_files(run_dirs) -> List[Path]:
+    """Every event stream under the given run dirs, recursively —
+    rotated streams (``events-<n>.jsonl``) included, because a restarted
+    member's pre-kill history is exactly what a cross-restart trace
+    reconstruction needs; crash bundles' ``events_tail.jsonl`` copies
+    excluded (they would double every pre-crash record)."""
+    seen, out = set(), []
+    for d in run_dirs:
+        d = Path(d)
+        files = ([d] if d.is_file()
+                 else sorted(f for f in d.rglob("events*.jsonl")
+                             if is_stream_file(f)))
+        for f in files:
+            if f not in seen:
+                seen.add(f)
+                out.append(f)
+    return out
+
+
+def _stream_rank(path: Path):
+    """Ordering of streams within one run dir: rotated (earlier-run)
+    streams sort before the live ``events.jsonl``, in rotation order."""
+    name = path.name
+    if name == EVENTS_NAME:
+        return (1, 0)
+    try:
+        return (0, int(name[len("events-"):-len(".jsonl")]))
+    except ValueError:
+        return (0, 0)
+
+
+def trace_index(run_dirs, trace_ids=None) -> Dict[str, List[dict]]:
+    """Parse every stream under ``run_dirs`` ONCE and bucket the records
+    by trace ID — the bulk form behind zero-orphan checks (calling
+    :func:`trace_events` per ID would re-read and re-parse the whole
+    run dir per trace).  ``trace_ids=None`` indexes every ID seen.
+
+    Records match by the ``trace`` attr or by membership in a
+    batch-level ``traces`` list, and come back annotated with ``_dir``/
+    ``_file``/``_rotated``/``_abs`` (absolute unix time via the stream
+    dir's manifest; None for rotated streams whose manifest the restart
+    overwrote) and sorted into reconstruction order."""
+    wanted = None if trace_ids is None else set(trace_ids)
+    out: Dict[str, List[dict]] = ({} if wanted is None
+                                  else {t: [] for t in wanted})
+    for f in iter_event_files(run_dirs):
+        try:
+            recs = load_jsonl(f, parse_event)
+        except (OSError, SchemaError):
+            continue
+        base = None
+        try:
+            base = json.loads(
+                (f.parent / "run.json").read_text()).get("created_unix")
+        except (OSError, json.JSONDecodeError):
+            pass
+        rotated = f.name != EVENTS_NAME
+        for rec in recs:
+            ids = []
+            if isinstance(rec.get("trace"), str):
+                ids.append(rec["trace"])
+            traces = rec.get("traces")
+            if isinstance(traces, list):
+                ids.extend(t for t in traces if isinstance(t, str))
+            hits = {i for i in ids if wanted is None or i in wanted}
+            if not hits:
+                continue
+            r = dict(rec)
+            r["_dir"] = str(f.parent)
+            r["_file"] = str(f)
+            r["_rotated"] = rotated
+            r["_dir_base"] = base
+            r["_abs"] = ((base + float(rec["t"]))
+                         if base is not None and not rotated else None)
+            for i in hits:
+                out.setdefault(i, []).append(r)
+    for recs in out.values():
+        recs.sort(key=_trace_sort_key)
+    return out
+
+
+def trace_events(run_dirs, trace_id: str) -> List[dict]:
+    """One trace's records in reconstruction order (see
+    :func:`trace_index`)."""
+    return trace_index(run_dirs, [trace_id]).get(trace_id, [])
+
+
+def _trace_sort_key(r: dict):
+    """Reconstruction order: absolute time where the stream has a
+    manifest base; rotated streams (whose manifest the restart
+    overwrote) anchor just BEFORE their dir's live stream — their events
+    happened before the restart by definition; streams with no manifest
+    at all sort last, by dir."""
+    if r["_abs"] is not None:
+        return (0, r["_abs"], r["_dir"],
+                _stream_rank(Path(r["_file"])), float(r["t"]))
+    if r["_dir_base"] is not None:          # rotated, base known
+        return (0, r["_dir_base"] - 1e-3, r["_dir"],
+                _stream_rank(Path(r["_file"])), float(r["t"]))
+    return (1, 0.0, r["_dir"], _stream_rank(Path(r["_file"])),
+            float(r["t"]))
+
+
+def has_terminal(records: List[dict]) -> bool:
+    return any(r["type"] == "event" and r.get("name") in
+               TERMINAL_TRACE_EVENTS for r in records)
+
+
+def render_trace(trace_id: str, records: List[dict], root=None) -> str:
+    """The cross-process critical path, one line per hop with per-hop
+    durations (absolute-clock deltas where both ends have a manifest
+    base; same-stream ``t`` deltas otherwise; ``?`` across a restart
+    whose rotated stream lost its manifest)."""
+    if not records:
+        return f"trace {trace_id}: no matching events"
+    root = Path(root) if root is not None else None
+    streams = {r["_file"] for r in records}
+    lines = [f"trace {trace_id} — {len(records)} event(s) across "
+             f"{len(streams)} stream(s)"]
+    prev = None
+    for r in records:
+        d = Path(r["_dir"])
+        label = str(d.relative_to(root)) if root and root in d.parents \
+            else d.name
+        if r["_rotated"]:
+            label += f":{Path(r['_file']).name}"
+        delta = ""
+        if prev is not None:
+            if r["_abs"] is not None and prev.get("_abs") is not None:
+                delta = f"  (+{(r['_abs'] - prev['_abs']) * 1e3:.1f} ms)"
+            elif r["_file"] == prev["_file"]:
+                delta = f"  (+{(float(r['t']) - float(prev['t'])) * 1e3:.1f} ms)"
+            else:
+                delta = "  (+? across restart)"
+        name = r.get("name", r["type"])
+        attrs = {k: v for k, v in r.items()
+                 if k not in ("v", "t", "type", "name", "trace", "traces")
+                 and not k.startswith("_") and v is not None}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(f"  [{label:>24s}] t={float(r['t']):8.3f}s "
+                     f"{r['type']:6s} {name:20s} {detail}{delta}")
+        prev = r
+    lines.append("terminal: " + ("yes" if has_terminal(records)
+                                 else "NO (orphan trace)"))
+    return "\n".join(lines)
 
 
 def _weighted_percentile(pairs: List[Tuple[float, float]], q: float) -> float:
@@ -449,6 +617,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="treat each RUN_DIR as a multi-host launch parent "
                         "(proc0/, proc1/, ...) and summarize the folded "
                         "logical run (history.merge_run_dirs)")
+    r.add_argument("--trace", metavar="ID", default=None,
+                   help="reconstruct one request/item's cross-process "
+                        "critical path: every event carrying this trace "
+                        "ID across ALL events*.jsonl streams under the "
+                        "given run dir(s), rotated streams included, "
+                        "with per-hop durations")
+    r.add_argument("--crash", action="store_true",
+                   help="read the run dir's crash-forensics bundle "
+                        "(crash_<run_id>/): exception, traceback tail, "
+                        "last events")
     r.add_argument("--self-test", action="store_true",
                    help="validate the committed fixture run dir (CI gate)")
 
@@ -487,6 +665,33 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--merge", action="store_true",
                    help="RUN_DIR is a multi-host parent; ingest the "
                         "folded logical run")
+
+    t = sub.add_parser(
+        "tail", help="live one-screen view of a running run dir "
+                     "(steps/sec, health/* gauges, queue depth, shed "
+                     "rate, breaker state) following the torn-tail-"
+                     "tolerant JSONL streams")
+    t.add_argument("run_dirs", nargs="+")
+    t.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period in seconds (default 1.0)")
+    t.add_argument("--once", action="store_true",
+                   help="render a single frame and exit (CI/scripting)")
+
+    e = sub.add_parser(
+        "export", help="Prometheus-exposition-format text snapshot of a "
+                       "run dir's gauges/counters/histograms (for "
+                       "external scrapers)")
+    e.add_argument("run_dirs", nargs="+")
+    e.add_argument("-o", "--out", default=None,
+                   help="write to this file (atomic tmp+rename) instead "
+                        "of stdout")
+
+    sub.add_parser(
+        "crash-drill",
+        help="CI gate for the crash-forensics loop: run a real obs "
+             "session through injected io_fail + nonfinite faults, "
+             "assert the crash bundle lands complete and renders "
+             "(tools/check.sh)")
     return p
 
 
@@ -505,6 +710,41 @@ def _parse_threshold_overrides(pairs):
 def _cmd_report(args) -> int:
     if args.self_test:
         return self_test()
+    if args.crash:
+        from hfrep_tpu.obs import crash
+        if len(args.run_dirs) != 1:
+            print("report --crash wants exactly one run dir (or bundle "
+                  "dir)", file=sys.stderr)
+            return 2
+        bundle = crash.find_bundle(args.run_dirs[0])
+        if bundle is None:
+            print(f"no crash bundle under {args.run_dirs[0]}",
+                  file=sys.stderr)
+            return 1
+        if args.format == "json":
+            try:
+                print(json.dumps(json.loads(
+                    (bundle / "crash.json").read_text()), indent=2))
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+        else:
+            print(crash.render_bundle(bundle))
+        return 0
+    if args.trace:
+        if not args.run_dirs:
+            print("report --trace wants at least one run dir",
+                  file=sys.stderr)
+            return 2
+        records = trace_events(args.run_dirs, args.trace)
+        if args.format == "json":
+            print(json.dumps({"trace": args.trace,
+                              "terminal": has_terminal(records),
+                              "events": records}, indent=2, default=str))
+        else:
+            print(render_trace(args.trace, records,
+                               root=Path(args.run_dirs[0]).resolve()))
+        return 0 if records else 1
     if not 1 <= len(args.run_dirs) <= 2:
         print("report wants 1 run dir (summary) or 2 (diff)", file=sys.stderr)
         return 2
@@ -588,10 +828,28 @@ def _cmd_ingest(args) -> int:
     return 0
 
 
+def _cmd_tail(args) -> int:
+    from hfrep_tpu.obs import tail
+    return tail.tail_main(args.run_dirs, interval=args.interval,
+                          once=args.once)
+
+
+def _cmd_export(args) -> int:
+    from hfrep_tpu.obs import tail
+    return tail.export_main(args.run_dirs, out=args.out)
+
+
+def _cmd_crash_drill(args) -> int:
+    from hfrep_tpu.obs import crash
+    return crash.drill()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"report": _cmd_report, "gate": _cmd_gate,
-            "ingest": _cmd_ingest}[args.command](args)
+            "ingest": _cmd_ingest, "tail": _cmd_tail,
+            "export": _cmd_export,
+            "crash-drill": _cmd_crash_drill}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -168,24 +168,29 @@ def _make_consumer(payload: dict):
 # ------------------------------------------------------------- the loops
 def _generator_loop(name: str, payload: dict) -> None:
     from hfrep_tpu import resilience
-    from hfrep_tpu.orchestrate.queue import SpoolQueue
+    from hfrep_tpu.orchestrate.queue import SpoolQueue, item_trace_id
     from hfrep_tpu.resilience.snapshot import ProgressSnapshot
 
     q = SpoolQueue(payload["queue_dir"], capacity=int(payload["capacity"]))
     source, blocks = payload["source"], int(payload["blocks"])
+    stream_seed = int(payload.get("stream_seed", 0))
     snap = ProgressSnapshot(
         payload["snapshot_dir"],
         fingerprint={"source": source, "blocks": blocks,
                      "mode": payload["mode"],
-                     "stream_seed": payload.get("stream_seed", 0)},
+                     "stream_seed": stream_seed},
         name=f"gen_{source}")
     start = 0
     state = snap.load()
     if state is not None:
         start = int(state.get("next", 0))
     gen = _make_generator(payload)
-    extra = {"source_idx": int(payload["source_idx"])}
     for seq in range(start, blocks):
+        # the trace ID is a pure function of the item coordinate, like
+        # the item itself — a restarted member's replayed item carries
+        # the SAME id, so the cross-process reconstruction spans the kill
+        extra = {"source_idx": int(payload["source_idx"]),
+                 "trace": item_trace_id(stream_seed, source, seq)}
         q.put(source, seq, gen(seq), extra_meta=extra)
         snap.save({"next": seq + 1})
         # the sub-block boundary: injected faults fire here, and a
@@ -236,6 +241,7 @@ def _consumer_loop(name: str, payload: dict) -> None:
             time.sleep(q.poll)
             continue
         res_dir = results_dir / result_name(item.source, item.seq)
+        trace = item.meta.get("trace")
         # skip only a result that VERIFIES: a duplicate delivery whose
         # published artifact rotted in the meantime is recomputed (same
         # degrade-don't-trust pattern as every snapshot loader here)
@@ -247,13 +253,22 @@ def _consumer_loop(name: str, payload: dict) -> None:
                 shutil.rmtree(res_dir, ignore_errors=True)
                 published = False
         if not published:
+            from hfrep_tpu.obs import get_obs
             arrays = item.arrays()
             source_idx = int(item.meta.get("source_idx", 0))
-            ckpt.write_atomic(
-                res_dir,
-                lambda tmp: consume(source_idx, item.seq, arrays, tmp),
-                metadata={"source": item.source, "seq": item.seq},
-                io_site="result_save", fault_site="result")
+            # the trace attr stitches this consumer's hop into the item's
+            # cross-process critical path (claim → sweep → publish)
+            with get_obs().span("item_sweep", trace=trace,
+                                source=item.source, seq=item.seq):
+                ckpt.write_atomic(
+                    res_dir,
+                    lambda tmp: consume(source_idx, item.seq, arrays, tmp),
+                    metadata={"source": item.source, "seq": item.seq,
+                              "trace": trace},
+                    io_site="result_save", fault_site="result")
+            get_obs().event("result_publish", trace=trace,
+                            source=item.source, seq=item.seq)
+            get_obs().flush()      # item-granular durability (see queue)
         q.ack(item)
         # the item boundary: result published + claim acked = the common
         # checkpoint boundary every member drains at

@@ -87,6 +87,17 @@ def item_name(source: str, seq: int) -> str:
     return f"item_{source}_{seq:05d}"
 
 
+def item_trace_id(stream_seed: int, source: str, seq: int) -> str:
+    """The pipeline item's trace/correlation ID — a PURE function of the
+    item coordinate, like the item itself: a producer restarted after
+    SIGKILL re-emits the same ID for a replayed item, so ``obs report
+    --trace`` reconstructs one critical path spanning the restart
+    (queue-wait → claim → sweep → publish) instead of two orphan halves.
+    Every process's events for the item carry it as the ``trace`` attr.
+    """
+    return f"t{int(stream_seed)}-{source}-{int(seq):05d}"
+
+
 def _parse_item_name(name: str):
     """``item_<source>_<seq>`` → (source, seq); None for foreign names."""
     if not name.startswith("item_"):
@@ -101,7 +112,14 @@ def _parse_item_name(name: str):
 def _obs_event(name: str, **attrs) -> None:
     try:
         from hfrep_tpu.obs import get_obs
-        get_obs().event(name, **attrs)
+        obs = get_obs()
+        obs.event(name, **attrs)
+        # item-granular durability: a SIGKILLed member loses its write
+        # buffer, and the flight recorder's cross-restart trace
+        # reconstruction depends on the pre-kill queue hops being ON
+        # DISK — queue events are per-item (seconds of work each), so a
+        # flush per event is noise next to the sweep it brackets
+        obs.flush()
     except Exception:
         pass
 
@@ -160,8 +178,10 @@ class SpoolQueue:
         has not advanced past ``seq``, so resume regenerates it.
         """
         name = item_name(source, seq)
+        trace = (extra_meta or {}).get("trace")
         if self.spooled(source, seq):
-            _obs_event("queue_put", source=source, seq=seq, duplicate=True)
+            _obs_event("queue_put", source=source, seq=seq, duplicate=True,
+                       trace=trace)
             return False
         t0 = time.perf_counter()
         while self.depth() >= self.capacity:
@@ -181,7 +201,7 @@ class SpoolQueue:
         ckpt.write_atomic(self.ready / name, writer, metadata=meta,
                           io_site="queue_put", fault_site="queue_item")
         _obs_event("queue_put", source=source, seq=seq,
-                   wait_s=round(waited, 4), depth=self.depth())
+                   wait_s=round(waited, 4), depth=self.depth(), trace=trace)
         return True
 
     # --------------------------------------------------------------- claim
@@ -213,7 +233,8 @@ class SpoolQueue:
                 shutil.rmtree(dst, ignore_errors=True)
                 continue
             _obs_event("queue_get", source=source, seq=seq,
-                       consumer=consumer, depth=self.depth())
+                       consumer=consumer, depth=self.depth(),
+                       trace=(meta or {}).get("trace"))
             return QueueItem(source=source, seq=seq, path=dst,
                              meta=meta or {})
         return None

@@ -35,6 +35,7 @@ from jax import lax
 from hfrep_tpu import resilience
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core import costs
+from hfrep_tpu.obs import health as health_mod
 from hfrep_tpu.core import scaler as mm
 from hfrep_tpu.models.autoencoder import Autoencoder, latent_mask
 from hfrep_tpu.ops.optimizers import keras_nadam
@@ -121,6 +122,12 @@ def _ae_epoch_step(cfg: AEConfig, x_train_scaled: jnp.ndarray,
     """
     model = _ae_model(cfg)
     tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)
+    # Flight-recorder health, decided at build time: None (default)
+    # traces the literal pre-health program; a config extends the epoch
+    # outputs with (grad_norm, nonfinite) traces accumulated inside the
+    # existing batch/epoch scans — the training carry is untouched, so
+    # results stay bit-identical (pinned by tests/test_obs_health.py)
+    hcfg = health_mod.active()
     n = x_train_scaled.shape[0]
     # Keras validation_split semantics: split_at = floor(n * (1 - split))
     # training rows, the rest validation (167 → 125 train / 42 val).
@@ -160,10 +167,12 @@ def _ae_epoch_step(cfg: AEConfig, x_train_scaled: jnp.ndarray,
             xb = jnp.take(x_fit, sl, axis=0)
             loss, grads = jax.value_and_grad(mse)(p, xb, w)
             updates, o = tx.update(grads, o, p)
-            return (optax.apply_updates(p, updates), o), loss
+            out = ((loss, health_mod.tree_sq_norm(grads)) if hcfg else loss)
+            return (optax.apply_updates(p, updates), o), out
 
-        (new_params, new_opt), batch_losses = lax.scan(
+        (new_params, new_opt), batch_out = lax.scan(
             batch_step, (params, opt_state), jnp.arange(n_batches))
+        batch_losses, batch_gsq = (batch_out if hcfg else (batch_out, None))
 
         # freeze updates once stopped (Keras keeps stop-epoch weights)
         params = jax.tree_util.tree_map(
@@ -178,8 +187,21 @@ def _ae_epoch_step(cfg: AEConfig, x_train_scaled: jnp.ndarray,
         newly_stopped = jnp.logical_and(jnp.logical_not(stopped), wait >= cfg.patience)
         train_loss = jnp.where(stopped, jnp.nan, jnp.mean(batch_losses))
         val_out = jnp.where(stopped, jnp.nan, val)
+        outs = (train_loss, val_out)
+        if hcfg:
+            # per-epoch health traces: global grad norm across the batch
+            # scan (NaN after the lane stopped, like the losses) + a
+            # nonfinite count over the kept params and the epoch's val
+            # loss — read back only at the chunk boundary the host
+            # already syncs at
+            gn = jnp.where(stopped, jnp.nan,
+                           jnp.sqrt(jnp.sum(batch_gsq)))
+            nf = (health_mod.tree_nonfinite(params)
+                  + (~jnp.isfinite(val)).astype(jnp.float32))
+            outs = outs + (gn, nf)
         stopped = jnp.logical_or(stopped, newly_stopped)
-        return (params, opt_state, best_val, wait, stopped), (train_loss, val_out, stopped)
+        return ((params, opt_state, best_val, wait, stopped),
+                outs[:2] + (stopped,) + outs[2:])
 
     return epoch_step
 
@@ -204,8 +226,10 @@ def train_autoencoder(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfig
     """
     carry, keys = _ae_init(cfg, x_train_scaled, key)
     step = _ae_epoch_step(cfg, x_train_scaled, mask)
-    (params, _, _, _, _), (tl, vl, stop_trace) = lax.scan(step, carry, keys)
-    return _ae_result(params, tl, vl, stop_trace, cfg.epochs)
+    (params, _, _, _, _), traces = lax.scan(step, carry, keys)
+    # traces[3:] are the optional health traces (flight recorder); the
+    # result contract is the first three either way
+    return _ae_result(params, traces[0], traces[1], traces[2], cfg.epochs)
 
 
 def _donate_argnums() -> Tuple[int, ...]:
@@ -227,7 +251,12 @@ _PROGRAM_CACHE: dict = {}
 
 
 def _cached_program(cfg: AEConfig, kind: str, build):
-    key = (dataclasses.astuple(cfg), kind)
+    # the health flag changes the traced program's OUTPUT arity (extra
+    # grad-norm/nonfinite traces), so it must key the cache: a test that
+    # toggles health between drives must not replay the other mode's
+    # compiled program
+    key = (dataclasses.astuple(cfg), kind,
+           bool(health_mod.active()))
     fn = _PROGRAM_CACHE.get(key)
     if fn is None:
         fn = _PROGRAM_CACHE[key] = build()
@@ -331,27 +360,46 @@ def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
         snap = ChunkSnapshot(resume_dir, fingerprint={
             "cfg": list(dataclasses.astuple(cfg)), "kind": kind,
             "lanes": lanes,
+            # health changes the persisted trace arity — a health-on
+            # resume must not adopt a health-off snapshot (or vice versa)
+            "health": bool(health_mod.active()),
             "operands": digest_arrays(keys, xs, masks, rows_info)})
     carry, epoch_keys = _init_program(cfg, kind, n_lanes_init)(keys, xs)
     fn = _chunk_fn(cfg, kind)
     with resilience.graceful_drain():
-        carry, (tl, vl, st), dispatched, chunks = _drive_chunks(
+        carry, traces, dispatched, chunks = _drive_chunks(
             lambda c, ks: fn(c, ks, xs, masks, rows_info), carry, epoch_keys,
             cfg.epochs, cfg.chunk_epochs, snapshot=snap)
-    res = _ae_result(carry[0], tl, vl, st, cfg.epochs)
+    res = _ae_result(carry[0], traces[0], traces[1], traces[2], cfg.epochs)
+    # final boundary: lanes_stopped (and, with health on, the last
+    # dispatched epoch's health scalars) in ONE device_get — the drive's
+    # pre-existing end-of-run sync, no new sync points
+    stopped_dev = jnp.sum(res.stop_epoch < cfg.epochs)
+    if health_mod.active() is not None and len(traces) >= 5:
+        last = max(0, dispatched - 1)
+        n_stopped, gnv, nfv, pnv = jax.device_get(
+            (stopped_dev, jnp.nanmax(traces[3][..., last]),
+             jnp.nansum(traces[4][..., last]),
+             health_mod.tree_norm(carry[0])))
+        _emit_ae_health(float(gnv), float(nfv), float(pnv), dispatched,
+                        carry, snap)
+    else:
+        n_stopped = jax.device_get(stopped_dev)
     stats = ChunkStats(chunks_dispatched=chunks, epochs_dispatched=dispatched,
                        epochs_total=cfg.epochs,
                        chunk_epochs=cfg.chunk_epochs or cfg.epochs,
-                       lanes=lanes,
-                       lanes_stopped=_lanes_stopped(res.stop_epoch, cfg.epochs))
+                       lanes=lanes, lanes_stopped=int(n_stopped))
     if snap is not None:
         snap.clear()
     return res, stats
 
 
-def _concat_traces(traces: list) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def _concat_traces(traces: list) -> Tuple[jnp.ndarray, ...]:
+    """Concatenate per-chunk trace tuples along the epoch axis.  The
+    first three components are always (train_loss, val_loss, stopped);
+    health-enabled drives carry two more (grad_norm, nonfinite)."""
     return tuple(jnp.concatenate([t[i] for t in traces], axis=-1)
-                 for i in range(3))
+                 for i in range(len(traces[0])))
 
 
 def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
@@ -399,9 +447,11 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
         traces.append(tr)
         pos += length
         chunks += 1
-        # one scalar device→host sync per chunk decides continue/stop
+        # one device→host sync per chunk decides continue/stop; with
+        # health on, the boundary's health scalars ride the SAME sync
+        # (and may raise NumericFault under abort_on_nonfinite)
         if pos < epochs:
-            stopped_all = bool(jax.device_get(jnp.all(carry[4])))
+            stopped_all = _boundary_sync(carry, tr, pos, snapshot)
         if snapshot is not None:
             snapshot.save(carry, _concat_traces(traces), pos, chunks,
                           stopped_all)
@@ -415,20 +465,69 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
                 site=e.site, reason=e.reason, epoch=pos,
                 snapshot=(str(snapshot.path)
                           if snapshot is not None else None)) from None
-    tl, vl, st = _concat_traces(traces)
+    out = _concat_traces(traces)
     if pos < epochs:
-        lead = tl.shape[:-1]
+        lead = out[0].shape[:-1]
         pad = (epochs - pos,)
-        tl = jnp.concatenate(
-            [tl, jnp.full(lead + pad, jnp.nan, tl.dtype)], axis=-1)
-        vl = jnp.concatenate(
-            [vl, jnp.full(lead + pad, jnp.nan, vl.dtype)], axis=-1)
-        st = jnp.concatenate([st, jnp.ones(lead + pad, st.dtype)], axis=-1)
-    return carry, (tl, vl, st), pos, chunks
+        padded = []
+        for i, t in enumerate(out):
+            # index 2 is the stop trace (padded True — the exact values
+            # the monolithic scan's post-stop masking produces); every
+            # other trace pads NaN
+            fill = (jnp.ones(lead + pad, t.dtype) if i == 2
+                    else jnp.full(lead + pad, jnp.nan, t.dtype))
+            padded.append(jnp.concatenate([t, fill], axis=-1))
+        out = tuple(padded)
+    return carry, out, pos, chunks
 
 
-def _lanes_stopped(stop_epoch: jnp.ndarray, epochs: int) -> int:
-    return int(jax.device_get(jnp.sum(stop_epoch < epochs)))
+def _boundary_sync(carry, tr, pos: int, snapshot) -> bool:
+    """The chunk boundary's continue/stop read-back.  Health-off: the
+    exact pre-health single-scalar sync.  Health-on: the boundary's
+    grad-norm / nonfinite / param-norm scalars join the SAME
+    ``device_get`` (zero additional sync points), surface as
+    ``health/ae_*`` gauges, and arm the nonfinite tripwire."""
+    stopped_dev = jnp.all(carry[4])
+    if health_mod.active() is None or len(tr) < 5:
+        return bool(jax.device_get(stopped_dev))
+    gn = jnp.nanmax(tr[3][..., -1])
+    nf = jnp.nansum(tr[4][..., -1])
+    pn = health_mod.tree_norm(carry[0])
+    stopped_all, gnv, nfv, pnv = jax.device_get((stopped_dev, gn, nf, pn))
+    _emit_ae_health(float(gnv), float(nfv), float(pnv), pos, carry, snapshot)
+    return bool(stopped_all)
+
+
+def _emit_ae_health(gn: float, nf: float, pn: float, epoch: int,
+                    carry, snapshot) -> None:
+    """Publish one AE boundary's health scalars; under
+    ``abort_on_nonfinite`` a nonfinite count converts into a typed
+    :class:`~hfrep_tpu.obs.health.NumericFault` after an atomic forensic
+    dump of the offending carry (the chunk snapshot of the failing chunk
+    is deliberately NOT yet written, so a resume replays it)."""
+    from hfrep_tpu.obs import get_obs
+    obs = get_obs()
+    if obs.enabled:
+        obs.gauge("health/ae_grad_norm").set(gn, epoch=epoch)
+        obs.gauge("health/ae_nonfinite").set(nf, epoch=epoch)
+        obs.gauge("health/ae_param_norm").set(pn, epoch=epoch)
+    if not nf > 0:
+        return
+    hcfg = health_mod.active()
+    abort = bool(hcfg and hcfg.abort_on_nonfinite)
+    obs.event("numeric_fault", site="chunk", epoch=epoch, nonfinite=nf,
+              abort=abort)
+    if not abort:
+        return
+    dump = health_mod.dump_forensics(
+        health_mod.resolve_dump_dir(
+            hcfg, str(snapshot.dir) if snapshot is not None else None),
+        carry, detail={"site": "chunk", "epoch": epoch, "nonfinite": nf,
+                       "grad_norm": gn, "param_norm": pn},
+        name=f"numeric_fault_{epoch}")
+    obs.flush()
+    raise health_mod.NumericFault("chunk", epoch=epoch, nonfinite=nf,
+                                  dump=dump)
 
 
 def train_autoencoder_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,

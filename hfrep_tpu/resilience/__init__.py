@@ -56,7 +56,10 @@ ENV_RETRIES = "HFREP_IO_RETRIES"
 class Preempted(RuntimeError):
     """Graceful preemption: a drive stopped at a safe boundary after
     persisting its state.  Callers translate this into a resumable exit
-    (the CLIs exit 75 / EX_TEMPFAIL) rather than a crash."""
+    (the CLIs exit 75 / EX_TEMPFAIL) rather than a crash; their exit-75
+    handlers also land the crash-forensics bundle explicitly
+    (:func:`hfrep_tpu.obs.crash.bundle_if_enabled`) — a drive that
+    catches a Preempted and successfully RESUMES must not bundle."""
 
     def __init__(self, site: str, reason: Optional[str] = None,
                  epoch: Optional[int] = None, snapshot: Optional[str] = None):

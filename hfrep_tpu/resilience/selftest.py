@@ -252,6 +252,7 @@ def _summary_digests(summary: dict) -> dict:
 
 
 def _check_ensemble(td: str) -> dict:
+    import hfrep_tpu.obs as obs_pkg
     import hfrep_tpu.resilience as res
     from hfrep_tpu.orchestrate import run_pipeline
 
@@ -259,16 +260,24 @@ def _check_ensemble(td: str) -> dict:
 
     # --- scenario 4: REAL SIGKILL of a generator actor mid-stream; the
     # supervisor restarts it from its sub-block snapshot and the run
-    # completes bit-identical to the undisturbed reference
+    # completes bit-identical to the undisturbed reference.  Runs under
+    # a real obs session so the flight recorder's cross-process,
+    # cross-RESTART trace reconstruction is asserted on the same kill
+    # (ISSUE 12: trace IDs are pure functions of the item coordinate,
+    # so the restarted member re-emits the same IDs)
+    kill_plan = _ensemble_plan(os.path.join(td, "ens_kill"))
+    obs_dir = os.path.join(td, "obs_ens_kill")
     res.install_plan(res.FaultPlan.parse("kill@actor=1"))
     try:
-        out = run_pipeline(_ensemble_plan(os.path.join(td, "ens_kill")))
+        with obs_pkg.session(obs_dir, command="selftest-ensemble"):
+            out = run_pipeline(kill_plan)
     finally:
         res.clear_plan()
     assert out["stats"]["restarts"] >= 1, \
         "ensemble kill: the SIGKILL did not land on a live member"
     assert _summary_digests(out["summary"]) == expected, \
         "ensemble kill: artifacts differ from the undisturbed reference"
+    _check_trace_continuity(kill_plan, obs_dir)
 
     # --- scenario 5: pod-wide drain at the 2nd observed item → barrier
     # (every member checkpoints at its item boundary) → resume completes
@@ -288,7 +297,41 @@ def _check_ensemble(td: str) -> dict:
         "ensemble drain: resumed artifacts differ from the reference"
     return {"ensemble_kill": "ok",
             "ensemble_kill_restarts": int(out["stats"]["restarts"]),
+            "ensemble_kill_traces": "ok",
             "ensemble_drain": "ok"}
+
+
+def _check_trace_continuity(plan, obs_dir: str) -> None:
+    """The flight-recorder acceptance on the SIGKILL scenario: every
+    pipeline item's trace reconstructs end to end (queue_put →
+    queue_get → sweep → result_publish) across the producer's and
+    consumer's separate processes, and the reconstruction SPANS the
+    restart — the killed incarnation's rotated stream contributes the
+    pre-kill hop under the same (deterministic) trace ID."""
+    from hfrep_tpu.obs.report import trace_index
+    from hfrep_tpu.orchestrate.queue import item_trace_id
+
+    tids = [item_trace_id(plan.stream_seed, src.name, seq)
+            for src in plan.sources for seq in range(plan.blocks)]
+    index = trace_index([obs_dir], tids)   # one parse for all items
+    all_records = []
+    for src in plan.sources:
+        for seq in range(plan.blocks):
+            tid = item_trace_id(plan.stream_seed, src.name, seq)
+            recs = index.get(tid, [])
+            names = {r.get("name") for r in recs}
+            assert "queue_put" in names, \
+                f"trace {tid}: producer hop (queue_put) missing"
+            assert "result_publish" in names, \
+                f"trace {tid}: terminal hop (result_publish) missing"
+            assert len({r["_dir"] for r in recs}) >= 2, \
+                f"trace {tid}: events do not span producer + consumer " \
+                f"processes ({names})"
+            all_records.extend(recs)
+    assert any(r["_rotated"] and r.get("name") == "queue_put"
+               for r in all_records), \
+        "no pre-kill (rotated-stream) queue_put found: the " \
+        "reconstruction does not span the restart"
 
 
 def _serving_fixture_server(workers: int = 1):
@@ -320,6 +363,19 @@ def _await_all(futures) -> None:
 
 
 def _check_serving(td: str) -> dict:
+    """Scenario 6 runs under a REAL obs session so the flight recorder's
+    request tracing is asserted against the same chaos the envelope
+    takes: the settle probe threads an explicit trace ID and its
+    admit → dispatch → complete path must reconstruct with per-hop
+    durations via ``report --trace`` machinery."""
+    import hfrep_tpu.obs as obs_pkg
+
+    obs_dir = os.path.join(td, "obs_serve")
+    with obs_pkg.session(obs_dir, command="selftest-serving"):
+        return _serving_scenario(td, obs_dir)
+
+
+def _serving_scenario(td: str, obs_dir: str) -> dict:
     import hfrep_tpu.resilience as res
     from hfrep_tpu.resilience import faults
     from hfrep_tpu.serve import Overloaded
@@ -382,10 +438,27 @@ def _check_serving(td: str) -> dict:
         # out the cooldown and let one clean probe close it, so the
         # breaker phase below observes its own trip, not the chaos one's
         time.sleep(server.cfg.breaker_cooldown_s + 0.1)
-        settle = server.replicate(panels[0], timeout_ms=5000.0)
+        settle = server.replicate(panels[0], timeout_ms=5000.0,
+                                  trace_id="st-settle")
         _await_all([settle])
         assert server.breaker.state == "closed", \
             f"breaker did not settle closed: {server.breaker.state}"
+
+        # flight recorder: the settle probe's critical path must
+        # reconstruct — admit → (batch-wait) dispatch → complete — with
+        # per-hop durations attributed
+        from hfrep_tpu.obs import get_obs
+        from hfrep_tpu.obs.report import trace_events
+        get_obs().flush()
+        recs = trace_events([obs_dir], "st-settle")
+        names = [r.get("name") for r in recs]
+        for hop in ("serve_admit", "serve_dispatch", "serve_complete"):
+            assert hop in names, \
+                f"serve trace missing hop {hop}: {names}"
+        (done,) = [r for r in recs if r.get("name") == "serve_complete"]
+        assert done.get("queue_ms") is not None \
+            and done.get("exec_ms") is not None, \
+            f"serve trace lacks per-hop durations: {done}"
 
         # --- breaker: every publish fails → consecutive faults trip it
         # OPEN; submits then get last-good DEGRADED answers flagged

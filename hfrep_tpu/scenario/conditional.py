@@ -85,6 +85,7 @@ def train_conditional(mcfg: ModelConfig, tcfg: TrainConfig,
     pair = build_conditional_gan(mcfg, n_regimes)
     state = init_conditional_state(jax.random.PRNGKey(seed), mcfg, tcfg,
                                    pair, n_regimes)
+    metrics = None
     if epochs > 0:
         from hfrep_tpu import resilience
 
@@ -104,7 +105,8 @@ def train_conditional(mcfg: ModelConfig, tcfg: TrainConfig,
                     multis[spc] = make_multi_step(
                         pair, dataclasses.replace(tcfg, steps_per_call=spc),
                         ds, step=step)
-                state, _ = multis[spc](state, jax.random.fold_in(key, done))
+                state, metrics = multis[spc](state,
+                                             jax.random.fold_in(key, done))
                 done += spc
                 if done < epochs:
                     # a SIGTERM lands here as a clean Preempted (exit 75
@@ -112,11 +114,57 @@ def train_conditional(mcfg: ModelConfig, tcfg: TrainConfig,
                     # mid-dispatch; after the final chunk the completed
                     # bundle proceeds to (resumable) bank generation
                     resilience.boundary("gan_block")
+    params_host = jax.device_get(state.g_params)
+    _emit_conditional_health(metrics, epochs, state)
     return ConditionalBundle(
-        pair=pair, params=jax.device_get(state.g_params),
+        pair=pair, params=params_host,
         window=int(windows.shape[1]), features=int(windows.shape[2]),
         n_regimes=n_regimes, family=mcfg.family,
         train_epochs=int(epochs), seed=int(seed))
+
+
+def _emit_conditional_health(metrics, epochs: int, state) -> None:
+    """Flight-recorder tail of the conditional drive: the last
+    dispatch's in-graph health stats (present in the metrics dict only
+    when :func:`hfrep_tpu.obs.health.active` armed the step builder)
+    ride the ``device_get`` the bundle pays anyway — the conditional
+    drive never syncs metrics mid-run, so this is its one boundary.
+    Surfaces the same ``health/*`` gauges as the GAN trainer and arms
+    the same nonfinite tripwire (site ``gan_block``)."""
+    import jax
+
+    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.obs import health as health_mod
+
+    if not metrics or "health_nonfinite" not in metrics:
+        return
+    host = jax.device_get(metrics)
+    obs = get_obs()
+    last = {k: float(np.asarray(v).reshape(-1)[-1])
+            for k, v in host.items() if k.startswith("health_")}
+    if obs.enabled:
+        for k, v in last.items():
+            short = k[len("health_"):]
+            obs.gauge(f"health/{short}").set(v, epoch=epochs - 1,
+                                             drive="conditional")
+    nf = float(np.nansum(np.asarray(host["health_nonfinite"])))
+    if nf <= 0:
+        return
+    hcfg = health_mod.active()
+    abort = bool(hcfg and hcfg.abort_on_nonfinite)
+    obs.event("numeric_fault", site="gan_block", epoch=epochs - 1,
+              nonfinite=nf, abort=abort)
+    if not abort:
+        return
+    dump = health_mod.dump_forensics(
+        health_mod.resolve_dump_dir(hcfg),
+        {"g_params": state.g_params, "d_params": state.d_params},
+        detail={"site": "gan_block", "epoch": epochs - 1, "nonfinite": nf,
+                "last_metrics": last},
+        name=f"numeric_fault_{epochs - 1}")
+    obs.flush()
+    raise health_mod.NumericFault("gan_block", epoch=epochs - 1,
+                                  nonfinite=nf, dump=dump)
 
 
 @functools.lru_cache(maxsize=4)

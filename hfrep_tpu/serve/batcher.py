@@ -68,6 +68,14 @@ class ServeRequest:
     deadline: float
     future: Future = dataclasses.field(default_factory=Future)
     retries: int = 0
+    #: flight-recorder correlation ID: minted (or caller-supplied) at
+    #: submit, carried on every event this request's lifecycle emits so
+    #: ``obs report --trace`` reconstructs admit → batch-wait → dispatch
+    #: → complete with per-hop durations
+    trace_id: str = ""
+    #: whether this request's lifecycle events enter the stream (the
+    #: 1-in-N ``event_log_every`` sampling decision, made once at admit)
+    log: bool = True
 
     def finish(self, value=None, error: Optional[Exception] = None) -> bool:
         """Resolve the request exactly once; False if already terminal.
@@ -211,10 +219,13 @@ class MicroBatcher:
     def _emit_miss(self, req: ServeRequest, late_ms: float) -> None:
         if self.on_deadline_miss is not None:
             self.on_deadline_miss(req, late_ms)
+        if not req.log:
+            return
         try:
             from hfrep_tpu.obs import get_obs
             get_obs().event("serve_deadline_miss", request=req.id,
-                            kind=req.kind, late_ms=round(late_ms, 3))
+                            kind=req.kind, late_ms=round(late_ms, 3),
+                            trace=req.trace_id)
         except Exception:
             pass
 

@@ -100,7 +100,8 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
                timeout_ms: Optional[float] = None,
                sample_every: int = 0,
                wave: int = 512,
-               on_wave: Optional[Callable[[int], None]] = None) -> dict:
+               on_wave: Optional[Callable[[int], None]] = None,
+               trace_prefix: Optional[str] = None) -> dict:
     """Offer ``total`` queries and account for every terminal outcome.
 
     ``sample_every > 0`` turns every Nth request into a generator
@@ -108,8 +109,16 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
     between waves — the CLI's drain-poll hook (it may raise to stop the
     load, e.g. :class:`~hfrep_tpu.resilience.Preempted`; already-offered
     futures are still awaited and classified by the caller's drain).
+
+    ``trace_prefix`` threads flight-recorder trace IDs through the load:
+    request ``j`` submits as ``<prefix><j:06d>`` and the report gains a
+    ``trace_ids`` list — what the bench's zero-orphan-trace self-check
+    (every submitted ID reaches a terminal event reachable by
+    ``report --trace``) keys on.  None (the default) lets the server
+    mint per-request IDs and adds no per-request bookkeeping.
     """
     futures: List[Future] = []
+    trace_ids: List[str] = []
     t0 = time.perf_counter()
     submitted = 0
     try:
@@ -117,12 +126,18 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
             n = min(wave, total - submitted)
             for i in range(n):
                 j = submitted + i
+                tid = None
+                if trace_prefix is not None:
+                    tid = f"{trace_prefix}{j:06d}"
+                    trace_ids.append(tid)
                 if (sample_every and server.gen_model is not None
                         and j % sample_every == sample_every - 1):
-                    futures.append(server.sample(1, timeout_ms=timeout_ms))
+                    futures.append(server.sample(1, timeout_ms=timeout_ms,
+                                                 trace_id=tid))
                 else:
                     futures.append(server.replicate(
-                        panels[j % len(panels)], timeout_ms=timeout_ms))
+                        panels[j % len(panels)], timeout_ms=timeout_ms,
+                        trace_id=tid))
             submitted += n
             if on_wave is not None:
                 on_wave(submitted)
@@ -130,6 +145,8 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
         wait(futures)
         wall = time.perf_counter() - t0
     doc = classify(futures)
+    if trace_prefix is not None:
+        doc["trace_ids"] = trace_ids
     lat = sorted(doc.pop("latencies_ms"))
     done = doc["results"] + doc["stale"]
     doc.update({

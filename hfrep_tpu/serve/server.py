@@ -245,18 +245,27 @@ class ReplicationServer:
 
     # ------------------------------------------------------------ admission
     def submit(self, kind: str, payload,
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Admit one query; ALWAYS returns a future that terminates.
 
         Typed rejections (shed, draining, closed) resolve the future
         immediately — raising at the submit call site would make the
         sync and async client paths behave differently under overload,
         which is exactly when behavior must be boring.
+
+        ``trace_id`` is the flight-recorder correlation ID a caller
+        (load generator, upstream gateway) threads through; None mints
+        one from the request id.  Every lifecycle event this request
+        produces — admit, dispatch, complete, shed, miss, fault — then
+        carries it, so ``obs report --trace <id>`` reconstructs the
+        request's critical path with per-hop durations.
         """
         self.outcomes.inc("submitted")
         now = self._clock()
         idnum = next(self._ids)
         rid = f"r{idnum}"
+        trace = trace_id or rid
         log = (self.cfg.event_log_every <= 1
                or idnum % self.cfg.event_log_every == 0)
         budget = (self.cfg.request_timeout_ms
@@ -265,12 +274,16 @@ class ReplicationServer:
             bucket = self._bucket(kind, payload)
         except (ValueError, aot.BucketError) as e:
             self.outcomes.inc("invalid")
+            if log:
+                self._emit("serve_fault", request=rid, trace=trace,
+                           cause=f"invalid: {e}")
             return self._rejected(InvalidRequest(str(e)))
         req = ServeRequest(id=rid, kind=kind, payload=payload, bucket=bucket,
-                           arrival=now, deadline=now + budget / 1e3)
+                           arrival=now, deadline=now + budget / 1e3,
+                           trace_id=trace, log=log)
         if log:
             self._emit("serve_admit", request=rid, kind=kind,
-                       bucket=str(bucket), timeout_ms=budget)
+                       bucket=str(bucket), timeout_ms=budget, trace=trace)
 
         # breaker-open fast path: degraded answer over queueing to death
         if self.breaker.state == OPEN:
@@ -281,13 +294,14 @@ class ReplicationServer:
             self.outcomes.inc("shed")
             if log:
                 self._emit("serve_shed", request=rid, reason="queue_full",
-                           depth=e.depth, bound=e.bound)
+                           depth=e.depth, bound=e.bound, trace=trace)
             req.finish(error=e)
             return req.future
         except Draining as e:
             self.outcomes.inc("drain_rejected")
             if log:
-                self._emit("serve_shed", request=rid, reason="draining")
+                self._emit("serve_shed", request=rid, reason="draining",
+                           trace=trace)
             req.finish(error=e)
             return req.future
         except ServerClosed as e:
@@ -298,13 +312,16 @@ class ReplicationServer:
         self._gauge_depth()
         return req.future
 
-    def replicate(self, panel, timeout_ms: Optional[float] = None) -> Future:
+    def replicate(self, panel, timeout_ms: Optional[float] = None,
+                  trace_id: Optional[str] = None) -> Future:
         return self.submit("replicate", np.asarray(panel, np.float32),
-                           timeout_ms=timeout_ms)
+                           timeout_ms=timeout_ms, trace_id=trace_id)
 
     def sample(self, n_windows: int,
-               timeout_ms: Optional[float] = None) -> Future:
-        return self.submit("sample", int(n_windows), timeout_ms=timeout_ms)
+               timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
+        return self.submit("sample", int(n_windows), timeout_ms=timeout_ms,
+                           trace_id=trace_id)
 
     def _bucket(self, kind: str, payload) -> Tuple:
         if kind == "replicate":
@@ -342,11 +359,13 @@ class ReplicationServer:
                 request_id=req.id, kind=req.kind, value=cached,
                 latency_ms=latency, stale=True))
             if log:
-                self._emit("serve_degraded", request=req.id, reason=why)
+                self._emit("serve_degraded", request=req.id, reason=why,
+                           trace=req.trace_id)
         else:
             self.outcomes.inc("shed")
             if log:
-                self._emit("serve_shed", request=req.id, reason=why)
+                self._emit("serve_shed", request=req.id, reason=why,
+                           trace=req.trace_id)
             req.finish(error=Overloaded(depth=self.batcher.depth,
                                         bound=self.cfg.max_queue))
         return req.future
@@ -412,6 +431,9 @@ class ReplicationServer:
             self.batcher.requeue(retry)
         for r in dead:
             self.outcomes.inc("worker_faults")
+            if r.log:
+                self._emit("serve_fault", request=r.id, trace=r.trace_id,
+                           cause="worker died twice")
             r.finish(error=WorkerFault(r.id, "worker died twice"))
 
     # ------------------------------------------------------------- dispatch
@@ -423,6 +445,16 @@ class ReplicationServer:
             for r in batch:
                 self._degrade_or_shed(r, "breaker open at dispatch")
             return
+        t_disp = self._clock()
+        if any(r.log for r in batch):
+            # one batch-level hop event (not per-request): the traces
+            # list lets `report --trace` attribute the batch-wait →
+            # dispatch hop to every member without 8x the event volume
+            self._emit("serve_dispatch", kind=kind,
+                       bucket=str(batch[0].bucket), batch=len(batch),
+                       traces=[r.trace_id for r in batch if r.log],
+                       max_wait_ms=round(
+                           (t_disp - min(r.arrival for r in batch)) * 1e3, 3))
         try:
             if kind == "replicate":
                 values = self._run_replicate(batch)
@@ -432,10 +464,21 @@ class ReplicationServer:
             self.breaker.record_failure(cause=type(e).__name__)
             for r in batch:
                 self.outcomes.inc("worker_faults")
+                if r.log:
+                    self._emit("serve_fault", request=r.id, trace=r.trace_id,
+                               cause=f"{type(e).__name__}: {e}")
                 r.finish(error=WorkerFault(r.id, f"{type(e).__name__}: {e}"))
             return
+        # Two passes, breaker first, futures LAST: a client that observes
+        # its future done may immediately read `breaker.state` (the
+        # selftest does), so every breaker/ledger transition this batch
+        # causes must be visible BEFORE any member future resolves —
+        # resolving first opened a race that per-request event emission
+        # (the flight recorder's serve_complete) widened into a reliably
+        # flaky half-open read.
         ok = True
         now = self._clock()
+        settled: List[Tuple[ServeRequest, object, Optional[float]]] = []
         for r, value in zip(batch, values):
             try:
                 # the result-publish boundary: ``io_fail@serve_result``
@@ -446,18 +489,33 @@ class ReplicationServer:
                 ok = False
                 self.breaker.record_failure(cause="serve_result EIO")
                 self.outcomes.inc("worker_faults")
-                r.finish(error=WorkerFault(r.id, f"result publish: {e}"))
+                if r.log:
+                    self._emit("serve_fault", request=r.id, trace=r.trace_id,
+                               cause=f"result publish: {e}")
+                settled.append((r, WorkerFault(r.id, f"result publish: {e}"),
+                                None))
                 continue
-            latency = (now - r.arrival) * 1e3
+            settled.append((r, value, (now - r.arrival) * 1e3))
+        if ok:
+            self.breaker.record_success()
+            with self._lock:
+                self._last_good[kind] = values[-1]
+        for r, value, latency in settled:
+            if latency is None:
+                r.finish(error=value)
+                continue
             if r.finish(value=ServeResult(request_id=r.id, kind=kind,
                                           value=value, latency_ms=latency,
                                           batch_size=len(batch))):
                 self.outcomes.inc("results")
                 self._note_latency(latency)
-        if ok:
-            self.breaker.record_success()
-            with self._lock:
-                self._last_good[kind] = values[-1]
+                if r.log:
+                    self._emit("serve_complete", request=r.id,
+                               trace=r.trace_id, kind=kind,
+                               queue_ms=round((t_disp - r.arrival) * 1e3, 3),
+                               exec_ms=round((now - t_disp) * 1e3, 3),
+                               latency_ms=round(latency, 3),
+                               batch=len(batch))
 
     def warm(self) -> int:
         """AOT-compile the full program grid — every (kind, batch

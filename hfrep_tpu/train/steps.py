@@ -52,10 +52,42 @@ from jax import lax
 from hfrep_tpu.utils.jax_compat import axis_size
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
+from hfrep_tpu.obs import health as health_mod
 from hfrep_tpu.train.states import GanState, make_optimizers
 from hfrep_tpu.utils.vma import match_vma
 
 Metrics = dict
+
+
+def _health_metrics(state0: GanState, state1: GanState, g_grads,
+                    d_gn_sq, losses) -> Metrics:
+    """The in-graph health block every step family shares (built only
+    when :func:`hfrep_tpu.obs.health.active` — the step's traced graph is
+    otherwise the literal pre-health program).  All outputs are pure
+    functions of values the step already computed, so enabling health
+    cannot perturb the training trajectory (pinned); they ride the
+    existing metrics dict to the host at the block boundaries the
+    trainer already syncs at — zero additional device→host syncs.
+
+    ``d_gn_sq`` is the critic phase's (last-iteration) grad sq-norm,
+    ``g_grads`` the generator update's gradient pytree, ``losses`` the
+    scalar losses whose nonfiniteness should count toward the tripwire
+    even when the parameters are still finite (a NaN loss poisons the
+    NEXT update)."""
+    params1 = {"g": state1.g_params, "d": state1.d_params}
+    nonfinite = (health_mod.tree_nonfinite(params1)
+                 + sum(jnp.sum((~jnp.isfinite(
+                     jnp.asarray(v, jnp.float32))).astype(jnp.float32))
+                       for v in losses))
+    return {
+        "health_g_grad_norm": jnp.sqrt(health_mod.tree_sq_norm(g_grads)),
+        "health_d_grad_norm": jnp.sqrt(d_gn_sq),
+        "health_update_norm": jnp.sqrt(
+            health_mod.tree_update_sq_norm(
+                {"g": state0.g_params, "d": state0.d_params}, params1)),
+        "health_param_norm": health_mod.tree_norm(params1),
+        "health_nonfinite": nonfinite,
+    }
 
 
 def _psum_if(axis_name: Optional[str], grads, loss):
@@ -183,6 +215,11 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
     granularity, no duplicated sampling work.
     """
     g_tx, d_tx = make_optimizers(pair, tcfg)
+    # Flight-recorder health (hfrep_tpu/obs/health.py): decided at BUILD
+    # time — None (the default) traces the literal pre-health program, so
+    # the fp32 jaxpr pins hold by construction; a config adds grad/
+    # update/param-norm + nonfinite outputs to the metrics dict only.
+    hcfg = health_mod.active()
     # Mixed-precision posture (hfrep_tpu/core/precision.py): modules cast
     # fp32 master weights + inputs to the compute dtype internally; here
     # `acc` lifts critic scores/logits back to float32 BEFORE any loss
@@ -241,17 +278,21 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         return match_vma(jnp.zeros(()), probe)
 
     def d_update(d_params, d_opt, loss_fn):
+        """Returns ``(params, opt, loss, aux, grads)`` — the gradient
+        pytree rides along for the (build-time-gated) health block; when
+        health is off nothing consumes it and XLA's DCE sees the exact
+        pre-health graph (the grads already exist for the update)."""
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(d_params)
         grads = _psum_if(axis_name, grads, loss)
         updates, d_opt = d_tx.update(grads, d_opt, d_params)
-        return optax.apply_updates(d_params, updates), d_opt, loss, aux
+        return optax.apply_updates(d_params, updates), d_opt, loss, aux, grads
 
     def g_update(state: GanState, loss_fn):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.g_params)
         grads = _psum_if(axis_name, grads, loss)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
         return state.replace(g_params=optax.apply_updates(state.g_params, updates),
-                             g_opt=g_opt, step=state.step + 1), loss
+                             g_opt=g_opt, step=state.step + 1), loss, grads
 
     # ------------------------------------------------------------------ bce
     def bce_step(state: GanState, key: jax.Array):
@@ -267,16 +308,25 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             logits = acc(d_apply(p, lax.stop_gradient(fake)))
             return _bce_logits(logits, 0.0), jnp.mean((logits <= 0).astype(jnp.float32))
 
-        d_params, d_opt, l_real, acc_r = d_update(state.d_params, state.d_opt, loss_real)
-        d_params, d_opt, l_fake, acc_f = d_update(d_params, d_opt, loss_fake)
+        state0 = state
+        d_params, d_opt, l_real, acc_r, gr1 = d_update(
+            state.d_params, state.d_opt, loss_real)
+        d_params, d_opt, l_fake, acc_f, gr2 = d_update(
+            d_params, d_opt, loss_fake)
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             return _bce_logits(acc(d_apply(state.d_params, g_apply(p, _noise(k_z2)))), 1.0), None
 
-        state, g_loss = g_update(state, loss_g)
-        return state, {"d_loss": 0.5 * (l_real + l_fake),
-                       "d_acc": 0.5 * (acc_r + acc_f), "g_loss": g_loss}
+        state, g_loss, g_grads = g_update(state, loss_g)
+        metrics = {"d_loss": 0.5 * (l_real + l_fake),
+                   "d_acc": 0.5 * (acc_r + acc_f), "g_loss": g_loss}
+        if hcfg:
+            metrics.update(_health_metrics(
+                state0, state, g_grads,
+                health_mod.tree_sq_norm(gr1) + health_mod.tree_sq_norm(gr2),
+                (l_real, l_fake, g_loss)))
+        return state, metrics
 
     # ------------------------------------------------------------ wgan_clip
     clip = tcfg.clip_value
@@ -321,18 +371,21 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         """d-phase dispatch shared by the two Wasserstein steps: the
         straight-line fused form when n_critic allows, the fori_loop
         otherwise.  ``critic_iter(i, (d_params, d_opt, d_loss))`` is the
-        unchanged per-iteration body."""
+        unchanged per-iteration body; with health on the carry grows a
+        4th element — the iteration's critic grad sq-norm (vma-matched
+        like the loss, since it derives from the same varying data)."""
+        init = (state.d_params, state.d_opt, _loop_init(key))
+        if hcfg:
+            init = init + (_loop_init(key),)
         if fuse_single:
-            return critic_iter(0, (state.d_params, state.d_opt,
-                                   _loop_init(key)))
-        return lax.fori_loop(0, tcfg.n_critic, critic_iter,
-                             (state.d_params, state.d_opt, _loop_init(key)))
+            return critic_iter(0, init)
+        return lax.fori_loop(0, tcfg.n_critic, critic_iter, init)
 
     def wgan_step(state: GanState, key: jax.Array):
         k_idx, noises, fakes, _ = _critic_loop_inputs(key, state.g_params, False)
 
         def critic_iter(i, carry):
-            d_params, d_opt, _ = carry
+            d_params, d_opt = carry[0], carry[1]
             real = _real(k_idx[i])
             fake = fakes[i]
 
@@ -342,20 +395,30 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             def loss_fake(p):
                 return jnp.mean(acc(d_apply(p, fake))), None
 
-            d_params, d_opt, l_real, _ = d_update(d_params, d_opt, loss_real)
-            d_params, d_opt, l_fake, _ = d_update(d_params, d_opt, loss_fake)
+            d_params, d_opt, l_real, _, gr1 = d_update(d_params, d_opt, loss_real)
+            d_params, d_opt, l_fake, _, gr2 = d_update(d_params, d_opt, loss_fake)
             d_params = jax.tree_util.tree_map(lambda w: jnp.clip(w, -clip, clip), d_params)
-            return d_params, d_opt, 0.5 * (l_real + l_fake)
+            out = (d_params, d_opt, 0.5 * (l_real + l_fake))
+            if hcfg:        # last iteration's critic grad sq-norm wins
+                out = out + (health_mod.tree_sq_norm(gr1)
+                             + health_mod.tree_sq_norm(gr2),)
+            return out
 
-        d_params, d_opt, d_loss = _critic_phase(state, key, critic_iter)
+        phase = _critic_phase(state, key, critic_iter)
+        d_params, d_opt, d_loss = phase[0], phase[1], phase[2]
+        state0 = state
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/WGAN.py:203)
             return jnp.mean(-acc(d_apply(state.d_params, g_apply(p, noises[-1])))), None
 
-        state, g_loss = g_update(state, loss_g)
-        return state, {"d_loss": d_loss, "g_loss": g_loss}
+        state, g_loss, g_grads = g_update(state, loss_g)
+        metrics = {"d_loss": d_loss, "g_loss": g_loss}
+        if hcfg:
+            metrics.update(_health_metrics(state0, state, g_grads, phase[3],
+                                           (d_loss, g_loss)))
+        return state, metrics
 
     # -------------------------------------------------------------- wgan_gp
     gp_w = tcfg.gp_weight
@@ -381,22 +444,31 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
             key, state.g_params, True)
 
         def critic_iter(i, carry):
-            d_params, d_opt, _ = carry
+            d_params, d_opt = carry[0], carry[1]
             real = _real(k_idx[i])
 
             loss_fn = lambda p: gp_critic_loss(p, real, fakes[i], alphas[i])
-            d_params, d_opt, loss, _ = d_update(d_params, d_opt, loss_fn)
-            return d_params, d_opt, loss
+            d_params, d_opt, loss, _, grads = d_update(d_params, d_opt, loss_fn)
+            out = (d_params, d_opt, loss)
+            if hcfg:
+                out = out + (health_mod.tree_sq_norm(grads),)
+            return out
 
-        d_params, d_opt, d_loss = _critic_phase(state, key, critic_iter)
+        phase = _critic_phase(state, key, critic_iter)
+        d_params, d_opt, d_loss = phase[0], phase[1], phase[2]
+        state0 = state
         state = state.replace(d_params=d_params, d_opt=d_opt)
 
         def loss_g(p):
             # reference reuses the final critic-loop noise (GAN/MTSS_WGAN_GP.py:281)
             return jnp.mean(-acc(d_apply(state.d_params, g_apply(p, noises[-1])))), None
 
-        state, g_loss = g_update(state, loss_g)
-        return state, {"d_loss": d_loss, "g_loss": g_loss}
+        state, g_loss, g_grads = g_update(state, loss_g)
+        metrics = {"d_loss": d_loss, "g_loss": g_loss}
+        if hcfg:
+            metrics.update(_health_metrics(state0, state, g_grads, phase[3],
+                                           (d_loss, g_loss)))
+        return state, metrics
 
     return {"bce": bce_step, "wgan_clip": wgan_step, "wgan_gp": wgan_gp_step}[pair.loss]
 
@@ -421,6 +493,7 @@ def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
     at jaxpr level by ``tests/test_scenario.py``).
     """
     g_tx, d_tx = make_optimizers(pair, tcfg)
+    hcfg = health_mod.active()     # build-time, like the unconditional step
     acc = pair.policy.accum
     be = resolve_lstm_backend(tcfg.lstm_backend)
     conditions = jnp.asarray(conditions, jnp.float32)
@@ -446,36 +519,43 @@ def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
     def d_update(d_params, d_opt, loss_fn):
         loss, grads = jax.value_and_grad(loss_fn)(d_params)
         updates, d_opt = d_tx.update(grads, d_opt, d_params)
-        return optax.apply_updates(d_params, updates), d_opt, loss
+        return optax.apply_updates(d_params, updates), d_opt, loss, grads
 
     def g_update(state: GanState, loss_fn):
         loss, grads = jax.value_and_grad(loss_fn)(state.g_params)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
         return state.replace(
             g_params=optax.apply_updates(state.g_params, updates),
-            g_opt=g_opt, step=state.step + 1), loss
+            g_opt=g_opt, step=state.step + 1), loss, grads
 
     def bce_step(state: GanState, key: jax.Array):
         k_idx, k_z1, k_z2 = jax.random.split(key, 3)
         real, cond = _real(k_idx)
         fake = lax.stop_gradient(g_apply(state.g_params, _noise(k_z1), cond))
-        d_params, d_opt, l_real = d_update(
+        state0 = state
+        d_params, d_opt, l_real, gr1 = d_update(
             state.d_params, state.d_opt,
             lambda p: _bce_logits(acc(d_apply(p, real, cond)), 1.0))
-        d_params, d_opt, l_fake = d_update(
+        d_params, d_opt, l_fake, gr2 = d_update(
             d_params, d_opt,
             lambda p: _bce_logits(acc(d_apply(p, fake, cond)), 0.0))
         state = state.replace(d_params=d_params, d_opt=d_opt)
-        state, g_loss = g_update(state, lambda p: _bce_logits(
+        state, g_loss, g_grads = g_update(state, lambda p: _bce_logits(
             acc(d_apply(state.d_params, g_apply(p, _noise(k_z2), cond),
                         cond)), 1.0))
-        return state, {"d_loss": 0.5 * (l_real + l_fake), "g_loss": g_loss}
+        metrics = {"d_loss": 0.5 * (l_real + l_fake), "g_loss": g_loss}
+        if hcfg:
+            metrics.update(_health_metrics(
+                state0, state, g_grads,
+                health_mod.tree_sq_norm(gr1) + health_mod.tree_sq_norm(gr2),
+                (l_real, l_fake, g_loss)))
+        return state, metrics
 
     clip, gp_w = tcfg.clip_value, tcfg.gp_weight
 
     def _wasserstein_step(state: GanState, key: jax.Array, with_gp: bool):
         def critic_iter(i, carry):
-            d_params, d_opt, _ = carry
+            d_params, d_opt = carry[0], carry[1]
             ki = jax.random.fold_in(key, i)
             k_idx, k_z, k_a = jax.random.split(ki, 3)
             real, cond = _real(k_idx)
@@ -494,22 +574,32 @@ def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
                     return (jnp.mean(-scores[:batch])
                             + jnp.mean(scores[batch:]) + gp_w * gp)
 
-                d_params, d_opt, loss = d_update(d_params, d_opt, loss_fn)
+                d_params, d_opt, loss, grads = d_update(d_params, d_opt,
+                                                        loss_fn)
+                gn_sq = health_mod.tree_sq_norm(grads) if hcfg else None
             else:
-                d_params, d_opt, l_real = d_update(
+                d_params, d_opt, l_real, gr1 = d_update(
                     d_params, d_opt,
                     lambda p: jnp.mean(-acc(d_apply(p, real, cond))))
-                d_params, d_opt, l_fake = d_update(
+                d_params, d_opt, l_fake, gr2 = d_update(
                     d_params, d_opt,
                     lambda p: jnp.mean(acc(d_apply(p, fake, cond))))
                 d_params = jax.tree_util.tree_map(
                     lambda w: jnp.clip(w, -clip, clip), d_params)
                 loss = 0.5 * (l_real + l_fake)
-            return d_params, d_opt, loss
+                gn_sq = (health_mod.tree_sq_norm(gr1)
+                         + health_mod.tree_sq_norm(gr2)) if hcfg else None
+            out = (d_params, d_opt, loss)
+            if hcfg:
+                out = out + (gn_sq,)
+            return out
 
-        d_params, d_opt, d_loss = lax.fori_loop(
-            0, tcfg.n_critic, critic_iter,
-            (state.d_params, state.d_opt, jnp.zeros(())))
+        init = (state.d_params, state.d_opt, jnp.zeros(()))
+        if hcfg:
+            init = init + (jnp.zeros(()),)
+        phase = lax.fori_loop(0, tcfg.n_critic, critic_iter, init)
+        d_params, d_opt, d_loss = phase[0], phase[1], phase[2]
+        state0 = state
         state = state.replace(d_params=d_params, d_opt=d_opt)
         # the generator trains on the final critic iteration's sampling
         # streams, mirroring the unconditional step's noise reuse
@@ -517,10 +607,14 @@ def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
         k_idx, k_z, _ = jax.random.split(kl, 3)
         _, cond_g = _real(k_idx)
         noise_g = _noise(k_z)
-        state, g_loss = g_update(state, lambda p: jnp.mean(
+        state, g_loss, g_grads = g_update(state, lambda p: jnp.mean(
             -acc(d_apply(state.d_params, g_apply(p, noise_g, cond_g),
                          cond_g))))
-        return state, {"d_loss": d_loss, "g_loss": g_loss}
+        metrics = {"d_loss": d_loss, "g_loss": g_loss}
+        if hcfg:
+            metrics.update(_health_metrics(state0, state, g_grads, phase[3],
+                                           (d_loss, g_loss)))
+        return state, metrics
 
     if pair.loss == "bce":
         return bce_step

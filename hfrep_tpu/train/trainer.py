@@ -354,6 +354,53 @@ class GanTrainer:
             self.history.append({"epoch": e, **{k: float(v) for k, v in rec.items()}})
             if e % self.cfg.train.log_every == 0:
                 self.logger.log(e, rec)
+        if "health_nonfinite" in host:
+            self._health_boundary(host, n, base_epoch)
+
+    def _health_boundary(self, host: dict, n: int, base_epoch: int) -> None:
+        """Flight-recorder boundary: surface the block's in-graph health
+        stats as ``health/*`` gauges and arm the nonfinite tripwire.
+
+        ``host`` is the block's already-fetched metrics — the health
+        values rode the metrics sync the trainer performs anyway, so
+        this adds zero device→host syncs.  With
+        ``HealthConfig.abort_on_nonfinite`` a nonfinite count converts
+        into a typed :class:`~hfrep_tpu.obs.health.NumericFault` after
+        an atomic forensic dump of the live carry (params + optimizer
+        state + key + epoch) — the state the crash bundle's event tail
+        points back at.
+        """
+        from hfrep_tpu.obs import health as health_mod
+        obs = get_obs()
+        epoch = base_epoch + n - 1
+        last = {k: float(np.asarray(v).reshape(-1)[-1])
+                for k, v in host.items() if k.startswith("health_")}
+        if obs.enabled:
+            for k, v in last.items():
+                short = k[len("health_"):]
+                obs.gauge(f"health/{short}").set(v, epoch=epoch)
+        nf = float(np.nansum(np.asarray(host["health_nonfinite"])))
+        if nf <= 0:
+            return
+        hcfg = health_mod.active()
+        abort = bool(hcfg and hcfg.abort_on_nonfinite)
+        obs.event("numeric_fault", site="block", epoch=epoch,
+                  nonfinite=nf, abort=abort)
+        if not abort:
+            return
+        dump = health_mod.dump_forensics(
+            health_mod.resolve_dump_dir(hcfg, self.cfg.train.checkpoint_dir),
+            self._ckpt_tree(),
+            detail={"site": "block", "epoch": epoch, "nonfinite": nf,
+                    "family": self.cfg.model.family, "last_metrics": last},
+            name=f"numeric_fault_{epoch}")
+        try:
+            self.logger.flush()
+            obs.flush()
+        except Exception:
+            pass
+        raise health_mod.NumericFault("block", epoch=epoch, nonfinite=nf,
+                                      dump=dump)
 
     @property
     def steps_per_sec(self) -> float:

@@ -213,16 +213,52 @@ def test_session_or_off_degrades_on_unusable_run_dir(tmp_path, capsys):
 
 
 def test_summary_p95_nearest_rank(tmp_path):
-    """int(n*0.95) overshoots when 0.95n is whole — n=20 must report the
-    19th value (nearest-rank p95), not the max."""
+    """n=20 must resolve p95 to the 19th value's bucket (nearest-rank),
+    not the max — and the log-bucket streaming histogram (ISSUE 12: the
+    registry holds bucket counts, never every sample) must land within
+    ONE bucket width of the exact sample statistic.  `max` is exact."""
+    from hfrep_tpu.obs import _HIST_BUCKETS_PER_DECADE
     obs = obs_pkg.enable(tmp_path / "run", manifest=False,
                          compile_listener=False)
     for v in range(1, 21):                     # 1..20
         obs.histogram("t").observe(float(v))
     s = obs.summary()["histograms"]["t"]
     obs_pkg.disable()
-    assert s["p95"] == 19.0
+    width = 10.0 ** (1.0 / _HIST_BUCKETS_PER_DECADE)   # one bucket, ratio
+    assert 19.0 / width <= s["p95"] <= 19.0 * width, s
+    assert s["p95"] < 20.0 / width, "p95 must not resolve to the max"
     assert s["max"] == 20.0
+
+
+def test_histogram_memory_is_bounded_and_percentiles_close(tmp_path):
+    """A 100k-sample stream must hold O(buckets) registry state, with
+    p50/p95 within one log-bucket width of the exact nearest-rank values
+    (the serve-soak memory fix, ISSUE 12)."""
+    import numpy as np
+    from hfrep_tpu.obs import _HIST_BUCKETS_PER_DECADE
+    obs = obs_pkg.enable(tmp_path / "run", manifest=False,
+                         compile_listener=False)
+    h = obs.histogram("lat")
+    rng = np.random.default_rng(7)
+    samples = np.abs(rng.lognormal(mean=1.0, sigma=1.2, size=100_000))
+    for v in samples:
+        h.observe(float(v))
+    obs_pkg.disable()
+    assert len(h.counts) < 2500, f"{len(h.counts)} buckets is not bounded"
+    assert not hasattr(h, "samples"), "per-sample retention is back"
+    width = 10.0 ** (1.0 / _HIST_BUCKETS_PER_DECADE)
+    s = np.sort(samples)
+    for pct in (50, 95):
+        exact = float(s[max(0, (len(s) * pct + 99) // 100 - 1)])
+        got = h.percentile(pct)
+        assert exact / width <= got <= exact * width, (pct, exact, got)
+    # negatives and zeros route through their dedicated buckets (the
+    # sink is closed: _emit is a no-op, the accumulator still counts)
+    h2 = obs_pkg.Histogram(obs, "edge")
+    for v in (-3.0, 0.0, 0.0, 5.0):
+        h2.observe(v)
+    assert h2.percentile(1) == -3.0 and h2.percentile(50) == 0.0
+    assert h2.max == 5.0
 
 
 def test_compile_listener_registration_is_constant(tmp_path):

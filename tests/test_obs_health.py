@@ -1,0 +1,300 @@
+"""Flight-recorder in-graph health (ISSUE 12, hfrep_tpu/obs/health.py).
+
+The two hard contracts, pinned here:
+
+* **zero-overhead-when-off** — with health off (the default) the step
+  builders trace the LITERAL pre-health programs: the jaxpr is stable
+  across configure-on/off cycles and carries no health outputs;
+* **bit-identical-when-on** — enabling health only ADDS metric/trace
+  outputs computed from values the steps already produce: the fp32
+  training trajectory (params, losses, stop epochs) is bitwise unchanged
+  for every GAN family and for the chunked AE drives, and kill→resume
+  stays bit-identical with the extended snapshot trace arity.
+
+Plus the tripwire: ``HealthConfig.abort_on_nonfinite`` turns a NaN
+block/chunk into a typed NumericFault with an atomic forensic dump.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import (
+    AEConfig,
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.obs import health
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_train_step
+from hfrep_tpu.utils.fixture_data import scaled_panel
+
+
+@pytest.fixture(autouse=True)
+def _health_off():
+    """Every test starts (and ends) with health explicitly off."""
+    health.configure(None)
+    yield
+    health.configure(None)
+
+
+def _dataset(seed=0, n=32, w=6, f=4):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(n, w, f).astype(np.float32))
+
+
+def _small_cfgs(family, n_critic=1):
+    mcfg = ModelConfig(family=family, hidden=8, features=4, window=6)
+    tcfg = TrainConfig(batch_size=8, n_critic=n_critic, steps_per_call=2)
+    return mcfg, tcfg
+
+
+def _run_steps(family, on, n_critic=1, epochs=3):
+    health.configure(health.HealthConfig() if on else None)
+    mcfg, tcfg = _small_cfgs(family, n_critic)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    step = jax.jit(make_train_step(pair, tcfg, _dataset()))
+    metrics = None
+    for i in range(epochs):
+        state, metrics = step(state, jax.random.fold_in(
+            jax.random.PRNGKey(1), i))
+    return state, jax.device_get(metrics)
+
+
+# ------------------------------------------------------------ off = literal
+def test_health_defaults_off():
+    assert health.active() is None
+
+
+def test_env_arms_config(monkeypatch):
+    monkeypatch.setattr(health, "_active", None)
+    monkeypatch.setattr(health, "_env_consumed", False)
+    monkeypatch.setenv(health.ENV_HEALTH, "abort")
+    cfg = health.active()
+    assert cfg is not None and cfg.abort_on_nonfinite
+    health.configure(None)
+
+
+@pytest.mark.parametrize("family,n_critic", [("gan", 1), ("wgan", 5),
+                                             ("mtss_wgan_gp", 2),
+                                             ("mtss_wgan_gp", 1)])
+def test_off_jaxpr_stable_across_toggle(family, n_critic):
+    """The health-off graph must be the identical program before and
+    after a configure-on/off cycle — no global leaks into the trace."""
+    mcfg, tcfg = _small_cfgs(family, n_critic)
+    pair = build_gan(mcfg)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    key = jax.random.PRNGKey(1)
+    ds = _dataset()
+
+    def jaxpr():
+        return str(jax.make_jaxpr(make_train_step(pair, tcfg, ds))(state,
+                                                                   key))
+
+    before = jaxpr()
+    health.configure(health.HealthConfig())
+    on = str(jax.make_jaxpr(make_train_step(pair, tcfg, ds))(state, key))
+    health.configure(None)
+    assert jaxpr() == before
+    assert on != before      # the health outputs really are in the graph
+
+
+@pytest.mark.parametrize("family,n_critic", [("gan", 1), ("wgan", 5),
+                                             ("mtss_wgan_gp", 1),
+                                             ("mtss_wgan_gp", 2)])
+def test_trajectory_bit_identical_on_vs_off(family, n_critic):
+    s_off, m_off = _run_steps(family, on=False, n_critic=n_critic)
+    s_on, m_on = _run_steps(family, on=True, n_critic=n_critic)
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(s_off),
+                              jax.tree_util.tree_leaves(s_on)):
+        assert bool(jnp.array_equal(leaf_a, leaf_b)), \
+            f"{family}: health perturbed the trajectory"
+    for k in health.STEP_KEYS:
+        assert k not in m_off
+        assert k in m_on and np.isfinite(float(m_on[k]))
+    assert float(m_on["health_nonfinite"]) == 0.0
+    assert float(m_on["health_g_grad_norm"]) > 0.0
+    assert float(m_on["health_d_grad_norm"]) > 0.0
+
+
+def test_conditional_step_health_keys():
+    from hfrep_tpu.models.registry import build_conditional_gan
+    from hfrep_tpu.train.states import init_conditional_state
+    from hfrep_tpu.train.steps import make_conditional_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=8, features=4, window=6)
+    tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=1)
+    ds = _dataset()
+    cond = jnp.asarray(np.eye(3, dtype=np.float32)[
+        np.arange(ds.shape[0]) % 3])
+    pair = build_conditional_gan(mcfg, 3)
+    state = init_conditional_state(jax.random.PRNGKey(0), mcfg, tcfg,
+                                   pair, 3)
+    health.configure(health.HealthConfig())
+    step = jax.jit(make_conditional_step(pair, tcfg, ds, cond))
+    state1, m = step(state, jax.random.PRNGKey(2))
+    for k in health.STEP_KEYS:
+        assert k in m
+    assert float(m["health_nonfinite"]) == 0.0
+    # off again: the literal pre-health metrics dict
+    health.configure(None)
+    pair2 = build_conditional_gan(mcfg, 3)
+    step2 = jax.jit(make_conditional_step(pair2, tcfg, ds, cond))
+    state0 = init_conditional_state(jax.random.PRNGKey(0), mcfg, tcfg,
+                                    pair2, 3)
+    _, m2 = step2(state0, jax.random.PRNGKey(2))
+    assert set(m2) == {"d_loss", "g_loss"}
+
+
+# ------------------------------------------------------------- AE engine
+def _ae_cfg(**kw):
+    base = dict(n_factors=5, latent_dim=3, epochs=12, batch_size=16,
+                patience=2, chunk_epochs=4)
+    base.update(kw)
+    return AEConfig(**base)
+
+
+def test_ae_chunked_bit_identical_and_gauges(tmp_path):
+    from hfrep_tpu.replication.engine import (
+        sweep_autoencoders_chunked,
+        train_autoencoder,
+    )
+
+    xs = scaled_panel(60, 5, seed=3)
+    cfg = _ae_cfg()
+    key = jax.random.PRNGKey(0)
+    mono = train_autoencoder(key, xs, _ae_cfg(latent_dim=3))
+    health.configure(health.HealthConfig())
+    with obs_pkg.session(tmp_path / "run", command="t") as obs:
+        on, _ = sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3])
+    events = [l for l in (tmp_path / "run" / "events.jsonl"
+                          ).read_text().splitlines() if l]
+    import json
+    gauges = {json.loads(l)["name"] for l in events
+              if '"kind": "gauge"' in l}
+    assert {"health/ae_grad_norm", "health/ae_nonfinite",
+            "health/ae_param_norm"} <= gauges
+    # the monolithic (health-on) drive matches the health-off monolithic
+    health.configure(None)
+    mono_off = train_autoencoder(key, xs, _ae_cfg(latent_dim=3))
+    for a, b in zip(jax.tree_util.tree_leaves(mono.params),
+                    jax.tree_util.tree_leaves(mono_off.params)):
+        assert bool(jnp.array_equal(a, b))
+
+
+def test_ae_kill_resume_bit_identical_with_health(tmp_path):
+    import hfrep_tpu.resilience as res
+    from hfrep_tpu.replication.engine import sweep_autoencoders_chunked
+
+    xs = scaled_panel(60, 5, seed=3)
+    cfg = _ae_cfg()
+    key = jax.random.PRNGKey(0)
+    health.configure(health.HealthConfig())
+    base, _ = sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3])
+    rd = str(tmp_path / "resume")
+    res.install_plan(res.FaultPlan.parse("preempt@chunk=1"))
+    try:
+        with pytest.raises(res.Preempted):
+            sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3],
+                                       resume_dir=rd)
+    finally:
+        res.clear_plan()
+    resumed, _ = sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3],
+                                            resume_dir=rd)
+    for a, b in zip(jax.tree_util.tree_leaves(base.params),
+                    jax.tree_util.tree_leaves(resumed.params)):
+        assert bool(jnp.array_equal(a, b))
+
+
+def test_ae_snapshot_refuses_cross_health_resume(tmp_path):
+    """A health-off snapshot must not be adopted by a health-on resume
+    (trace arity differs) — the fingerprint separates them and the
+    drive degrades to a fresh start with identical results."""
+    import hfrep_tpu.resilience as res
+    from hfrep_tpu.replication.engine import sweep_autoencoders_chunked
+
+    xs = scaled_panel(60, 5, seed=3)
+    cfg = _ae_cfg()
+    key = jax.random.PRNGKey(0)
+    rd = str(tmp_path / "resume")
+    res.install_plan(res.FaultPlan.parse("preempt@chunk=1"))
+    try:
+        with pytest.raises(res.Preempted):
+            sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3],
+                                       resume_dir=rd)
+    finally:
+        res.clear_plan()
+    health.configure(health.HealthConfig())
+    resumed, stats = sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3],
+                                                resume_dir=rd)
+    assert stats.chunks_dispatched == 3     # fresh start, not a resume
+    base, _ = sweep_autoencoders_chunked(key, xs, cfg, [1, 2, 3])
+    for a, b in zip(jax.tree_util.tree_leaves(base.params),
+                    jax.tree_util.tree_leaves(resumed.params)):
+        assert bool(jnp.array_equal(a, b))
+
+
+def test_ae_tripwire_raises_numeric_fault(tmp_path):
+    from hfrep_tpu.replication.engine import train_autoencoder_chunked
+
+    health.configure(health.HealthConfig(abort_on_nonfinite=True,
+                                         dump_dir=str(tmp_path)))
+    xs = jnp.asarray(np.full((40, 4), np.nan, np.float32))
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
+                   patience=2, chunk_epochs=2)
+    with pytest.raises(health.NumericFault) as ei:
+        train_autoencoder_chunked(jax.random.PRNGKey(0), xs, cfg)
+    fault = ei.value
+    assert fault.nonfinite and fault.nonfinite > 0
+    assert fault.dump and os.path.isdir(fault.dump)
+    assert os.path.exists(os.path.join(fault.dump, "carry.npz"))
+    assert os.path.exists(os.path.join(fault.dump, "detail.json"))
+
+
+# -------------------------------------------------------------- trainer
+def test_trainer_emits_gauges_and_tripwire(tmp_path):
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    cfg = ExperimentConfig(
+        data=DataConfig(), mesh=MeshConfig(),
+        model=ModelConfig(family="mtss_wgan_gp", hidden=8, features=4,
+                          window=6),
+        train=TrainConfig(batch_size=8, n_critic=1, epochs=4,
+                          steps_per_call=2, log_every=1))
+    # clean data + health on: gauges land, no fault
+    health.configure(health.HealthConfig())
+    with obs_pkg.session(tmp_path / "ok", command="t"):
+        tr = GanTrainer(cfg, _dataset())
+        tr.train(epochs=2)
+    text = (tmp_path / "ok" / "events.jsonl").read_text()
+    for g in ("health/g_grad_norm", "health/d_grad_norm",
+              "health/update_norm", "health/param_norm",
+              "health/nonfinite"):
+        assert g in text
+    assert "numeric_fault" not in text
+    assert any(k.startswith("health_") for k in tr.history[0])
+
+    # NaN data + armed tripwire: typed NumericFault, numeric_fault event,
+    # forensic dump, and (because it escaped the session) a crash bundle
+    health.configure(health.HealthConfig(abort_on_nonfinite=True))
+    nan_ds = jnp.asarray(np.full((32, 6, 4), np.nan, np.float32))
+    with pytest.raises(health.NumericFault) as ei:
+        with obs_pkg.session(tmp_path / "bad", command="t"):
+            GanTrainer(cfg, nan_ds).train(epochs=2)
+    assert ei.value.dump and os.path.isdir(ei.value.dump)
+    bad = (tmp_path / "bad" / "events.jsonl").read_text()
+    assert "numeric_fault" in bad
+    from hfrep_tpu.obs import crash
+    bundle = crash.find_bundle(tmp_path / "bad")
+    assert bundle is not None and not crash.verify_bundle(bundle)

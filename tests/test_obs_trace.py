@@ -1,0 +1,307 @@
+"""Flight-recorder tracing, crash forensics and the live plane
+(ISSUE 12): trace propagation through the spool queue and the serving
+layer, ``report --trace`` reconstruction, crash bundles + ``report
+--crash``, and the ``tail``/``export`` read paths."""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import wait
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.obs import crash
+from hfrep_tpu.obs import report as report_mod
+from hfrep_tpu.obs import tail as tail_mod
+
+
+# ------------------------------------------------------------- queue side
+def test_item_trace_id_is_deterministic():
+    from hfrep_tpu.orchestrate.queue import item_trace_id
+    a = item_trace_id(11, "s0", 3)
+    assert a == item_trace_id(11, "s0", 3)
+    assert a != item_trace_id(11, "s0", 4)
+    assert a != item_trace_id(12, "s0", 3)
+
+
+def test_queue_events_carry_trace(tmp_path):
+    from hfrep_tpu.orchestrate.queue import SpoolQueue, item_trace_id
+
+    tid = item_trace_id(0, "s0", 0)
+    with obs_pkg.session(tmp_path / "run", command="t") as obs:
+        q = SpoolQueue(tmp_path / "spool", capacity=4)
+        q.put("s0", 0, {"x": np.zeros(3, np.float32)},
+              extra_meta={"source_idx": 0, "trace": tid})
+        item = q.claim("c0")
+        assert item is not None and item.meta.get("trace") == tid
+        q.ack(item)
+        obs.flush()
+    recs = report_mod.trace_events([tmp_path / "run"], tid)
+    names = [r.get("name") for r in recs]
+    assert names == ["queue_put", "queue_get"]
+    assert all(r["_abs"] is not None for r in recs)
+
+
+# ------------------------------------------------------------- serve side
+@pytest.fixture(scope="module")
+def traced_serve(tmp_path_factory):
+    """One small traced load against the fixture server, shared by the
+    reconstruction/CLI/export tests (training + warm dominate)."""
+    from hfrep_tpu.serve.fixture import fixture_server, warm_server
+    from hfrep_tpu.serve.loadgen import drive_load, make_panels
+    from hfrep_tpu.serve.server import ServeConfig
+
+    run = tmp_path_factory.mktemp("serve_obs") / "run"
+    scfg = ServeConfig(max_batch=4, batch_window_ms=3.0,
+                       request_timeout_ms=1000.0, max_queue=64, workers=1,
+                       row_buckets=(32, 64), compile_storm=64)
+    with obs_pkg.session(run, command="t"):
+        server = fixture_server(scfg, feats=8)
+        panels = make_panels(11, 8, (16, 24), variants=4)
+        warm_server(server, panels)
+        rep = drive_load(server, 24, panels, timeout_ms=1000.0,
+                         trace_prefix="tt-")
+        server.stop()
+    return run, rep
+
+
+def test_serve_trace_reconstructs_hops(traced_serve):
+    run, rep = traced_serve
+    assert rep["trace_ids"] and rep["terminal"] == rep["submitted"]
+    done_tids = [t for t in rep["trace_ids"]
+                 if report_mod.has_terminal(
+                     report_mod.trace_events([run], t))]
+    assert len(done_tids) == len(rep["trace_ids"]), "orphan traces"
+    recs = report_mod.trace_events([run], rep["trace_ids"][0])
+    names = [r.get("name") for r in recs]
+    assert "serve_admit" in names
+    assert "serve_dispatch" in names        # via the batch traces list
+    (comp,) = [r for r in recs if r.get("name") == "serve_complete"]
+    assert comp["queue_ms"] is not None and comp["exec_ms"] is not None
+    rendered = report_mod.render_trace(rep["trace_ids"][0], recs, root=run)
+    assert "terminal: yes" in rendered and "serve_complete" in rendered
+
+
+def test_report_trace_cli(traced_serve, capsys):
+    run, rep = traced_serve
+    rc = report_mod.main(["report", "--trace", rep["trace_ids"][0],
+                          str(run)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "serve_admit" in out
+    rc = report_mod.main(["report", "--trace", "no-such-trace", str(run)])
+    assert rc == 1
+    assert "no matching events" in capsys.readouterr().out
+    rc = report_mod.main(["report", "--trace", rep["trace_ids"][1],
+                          str(run), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["terminal"] is True and doc["events"]
+
+
+def test_export_prometheus(traced_serve, tmp_path, capsys):
+    run, _ = traced_serve
+    rc = report_mod.main(["export", str(run)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "# TYPE hfrep_serve_queue_depth gauge" in out
+    assert "hfrep_serve_latency_ms_count" in out
+    dst = tmp_path / "snap.prom"
+    rc = report_mod.main(["export", str(run), "-o", str(dst)])
+    assert rc == 0 and dst.read_text().startswith("# TYPE")
+    # empty dir → exit 1
+    assert report_mod.main(["export", str(tmp_path / "nope")]) == 1
+
+
+def test_tail_once_renders_frame(traced_serve, tmp_path, capsys):
+    run, _ = traced_serve
+    rc = report_mod.main(["tail", str(run), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "flight recorder" in out
+    assert "queue depth:" in out        # the serve/queue_depth gauge
+
+
+def test_tail_follower_waits_for_torn_tail(tmp_path):
+    p = tmp_path / "events.jsonl"
+    p.write_text('{"a": 1}\n{"b": 2')
+    f = tail_mod._StreamFollower(p)
+    assert f.poll() == [{"a": 1}]
+    assert f.poll() == []                   # torn tail not consumed
+    with open(p, "a") as fh:
+        fh.write("2}\n")
+    assert f.poll() == [{"b": 22}]
+
+
+def test_tail_aggregate_tracks_state():
+    agg = tail_mod.TailAggregate()
+    agg.consume({"type": "span", "t": 1.0, "name": "block", "steps": 50,
+                 "dur": 0.5})
+    agg.consume({"type": "metric", "t": 1.2, "kind": "gauge",
+                 "name": "health/nonfinite", "value": 0.0})
+    agg.consume({"type": "event", "t": 1.3, "name": "serve_breaker_open",
+                 "reason": "x"})
+    assert agg.steps_per_sec() == pytest.approx(100.0)
+    assert agg.breaker == "open"
+    frame = tail_mod.render_frame({"run": agg})
+    assert "steps/sec" in frame and "breaker=open" in frame
+
+
+# --------------------------------------------------------- crash bundles
+def test_session_bundles_uncaught_exception(tmp_path):
+    run = tmp_path / "run"
+    with pytest.raises(RuntimeError):
+        with obs_pkg.session(run, command="t") as obs:
+            obs.event("something")
+            raise RuntimeError("boom")
+    bundle = crash.find_bundle(run)
+    assert bundle is not None
+    assert crash.verify_bundle(bundle) == []
+    doc = json.loads((bundle / "crash.json").read_text())
+    assert doc["type"] == "RuntimeError" and doc["message"] == "boom"
+    assert "RuntimeError: boom" in (bundle / "traceback.txt").read_text()
+    assert "something" in (bundle / "events_tail.jsonl").read_text()
+    rendered = crash.render_bundle(bundle)
+    assert "RuntimeError: boom" in rendered
+
+
+def test_handled_preempted_bundles_only_at_exit_hook(tmp_path):
+    """The CLIs catch Preempted inside the session body and bundle
+    EXPLICITLY at their exit-75 handler (`crash.bundle_if_enabled`); a
+    drive that catches a Preempted and successfully RESUMES must leave
+    no bundle for its clean run (the walk-forward drill pattern)."""
+    from hfrep_tpu import resilience
+
+    run = tmp_path / "run"
+    with obs_pkg.session(run, command="t"):
+        try:
+            raise resilience.Preempted(site="block", epoch=7,
+                                       snapshot="/x/ckpt_7")
+        except resilience.Preempted as e:
+            crash.bundle_if_enabled(e)      # the CLI's exit-75 path
+    bundle = crash.find_bundle(run)
+    assert bundle is not None
+    doc = json.loads((bundle / "crash.json").read_text())
+    assert doc["type"] == "Preempted" and doc["epoch"] == 7
+
+    # caught-and-recovered: NO bundle for a successful run
+    clean = tmp_path / "clean"
+    with obs_pkg.session(clean, command="t"):
+        try:
+            raise resilience.Preempted(site="chunk", epoch=1)
+        except resilience.Preempted:
+            pass                            # ...resume and complete
+    assert crash.find_bundle(clean) is None
+    # bundle_if_enabled outside any session is a no-op
+    assert crash.bundle_if_enabled(RuntimeError("x")) is None
+
+
+def test_clean_exit_has_no_bundle(tmp_path):
+    run = tmp_path / "run"
+    with obs_pkg.session(run, command="t"):
+        pass
+    assert crash.find_bundle(run) is None
+    # SystemExit(0) is a clean exit too
+    with pytest.raises(SystemExit):
+        with obs_pkg.session(tmp_path / "run2", command="t"):
+            raise SystemExit(0)
+    assert crash.find_bundle(tmp_path / "run2") is None
+
+
+def test_report_crash_cli(tmp_path, capsys):
+    run = tmp_path / "run"
+    with pytest.raises(ValueError):
+        with obs_pkg.session(run, command="t"):
+            raise ValueError("died here")
+    rc = report_mod.main(["report", "--crash", str(run)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "ValueError: died here" in out
+    rc = report_mod.main(["report", "--crash", str(run), "--format",
+                          "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["type"] == "ValueError"
+    assert report_mod.main(["report", "--crash",
+                            str(tmp_path / "empty")]) == 1
+
+
+def test_env_redaction(monkeypatch, tmp_path):
+    monkeypatch.setenv("MY_API_KEY", "hunter2")
+    monkeypatch.setenv("SAFE_FLAG", "yes")
+    run = tmp_path / "run"
+    with pytest.raises(RuntimeError):
+        with obs_pkg.session(run, command="t"):
+            raise RuntimeError("x")
+    env = json.loads(
+        (crash.find_bundle(run) / "env.json").read_text())
+    assert env["MY_API_KEY"] == "<redacted>"
+    assert env["SAFE_FLAG"] == "yes"
+    assert "hunter2" not in json.dumps(env)
+
+
+def test_crash_bundle_tail_is_not_a_stream(tmp_path):
+    """The bundle's events_tail.jsonl is a COPY of stream tails; trace
+    collection, tail and export must not read it back as a stream (it
+    would double every pre-crash record on exactly the crashed runs)."""
+    run = tmp_path / "run"
+    with pytest.raises(RuntimeError):
+        with obs_pkg.session(run, command="t") as obs:
+            obs.event("queue_put", source="s0", seq=0, trace="tr-x")
+            obs.flush()
+            raise RuntimeError("die")
+    bundle = crash.find_bundle(run)
+    assert "tr-x" in (bundle / "events_tail.jsonl").read_text()
+    recs = report_mod.trace_events([run], "tr-x")
+    assert len(recs) == 1, [r["_file"] for r in recs]
+    files = report_mod.iter_event_files([run])
+    assert all(f.name != "events_tail.jsonl" for f in files)
+    assert all(f.name != "events_tail.jsonl"
+               for f in tail_mod._discover([run]))
+
+
+def test_trace_index_bulk_matches_per_id(tmp_path):
+    run = tmp_path / "run"
+    with obs_pkg.session(run, command="t") as obs:
+        for i in range(4):
+            obs.event("queue_put", source="s", seq=i, trace=f"b-{i}")
+        obs.event("serve_dispatch", traces=["b-0", "b-2"], batch=2)
+        obs.flush()
+    ids = [f"b-{i}" for i in range(4)]
+    index = report_mod.trace_index([run], ids)
+    assert set(index) == set(ids)
+    for t in ids:
+        assert index[t] == report_mod.trace_events([run], t)
+    assert len(index["b-0"]) == 2           # put + dispatch membership
+    # None = index everything
+    assert set(report_mod.trace_index([run])) == set(ids)
+
+
+def test_histogram_fractional_percentile():
+    stub = type("S", (), {"_emit": staticmethod(lambda rec: None)})()
+    h = obs_pkg.Histogram(stub, "t")
+    # a tail the truncating int(pct) bug would miss: ranks 991..1000 hold
+    # the outliers, so p99 and p99.9 resolve to different buckets
+    for _ in range(990):
+        h.observe(1.0)
+    for _ in range(10):
+        h.observe(10000.0)
+    assert h.percentile(99) == pytest.approx(1.0, rel=0.05)
+    assert h.percentile(99.9) == pytest.approx(10000.0, rel=0.05)
+
+
+def test_rotated_streams_contribute_to_traces(tmp_path):
+    """A restarted member re-enables obs into the same dir (the stream
+    rotates); trace collection must read the rotated pre-restart stream
+    and order it before the live one."""
+    run = tmp_path / "run"
+    with obs_pkg.session(run, command="t") as obs:
+        obs.event("queue_put", source="s0", seq=0, trace="tr-1")
+        obs.flush()
+    with obs_pkg.session(run, command="t") as obs:   # the "restart"
+        obs.event("result_publish", source="s0", seq=0, trace="tr-1")
+        obs.flush()
+    recs = report_mod.trace_events([run], "tr-1")
+    assert [r.get("name") for r in recs] == ["queue_put", "result_publish"]
+    assert recs[0]["_rotated"] and not recs[1]["_rotated"]
+    rendered = report_mod.render_trace("tr-1", recs, root=run)
+    assert "across restart" in rendered or "ms)" in rendered

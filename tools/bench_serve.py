@@ -135,6 +135,34 @@ def _chaos_smoke(server, panels, problems: list) -> dict:
             "breaker_trips": server.breaker.trips}
 
 
+def _trace_drill(server, panels, obs, problems: list) -> dict:
+    """Zero-orphan-trace check (the tracing analogue of the
+    zero-silent-drop ledger): thread explicit trace IDs through the load
+    generator, then assert every submitted request's terminal outcome is
+    reachable by ``report --trace`` over the run dir's event stream."""
+    from hfrep_tpu.obs.report import has_terminal, trace_index
+    from hfrep_tpu.serve.loadgen import drive_load
+
+    rep = drive_load(server, 64, panels, timeout_ms=1000.0,
+                     trace_prefix="lg-")
+    obs.flush()
+    # ONE parse of the run dir indexes every trace (trace_events per ID
+    # would re-read the whole stream 64 times)
+    index = trace_index([obs.run_dir], rep["trace_ids"])
+    orphans = [t for t in rep["trace_ids"]
+               if not has_terminal(index.get(t, []))]
+    if orphans:
+        problems.append(f"traces: {len(orphans)}/{len(rep['trace_ids'])} "
+                        f"orphan trace(s) (first: {orphans[0]})")
+    # the reconstructed path must attribute the admit hop at minimum
+    # (completed requests additionally carry dispatch + complete)
+    first = index.get(rep["trace_ids"][0], [])
+    if not any(r.get("name") == "serve_admit" for r in first):
+        problems.append("traces: reconstruction lacks the admit hop")
+    return {"submitted": rep["submitted"], "traced": len(rep["trace_ids"]),
+            "orphans": len(orphans)}
+
+
 def run_probe(obs, self_test: bool) -> int:
     from hfrep_tpu.serve.fixture import fixture_server, warm_server
     from hfrep_tpu.serve.loadgen import drive_load, make_panels
@@ -207,6 +235,11 @@ def run_probe(obs, self_test: bool) -> int:
 
         if self_test:
             doc["chaos"] = _chaos_smoke(server, panels, problems)
+            if obs.enabled:
+                doc["traces"] = _trace_drill(server, panels, obs, problems)
+            else:
+                problems.append("traces: no run dir to verify traces "
+                                "against (self-test wants one)")
 
         ledger = server.outcomes.as_dict()
         if ledger["terminal"] != ledger["submitted"]:
@@ -236,16 +269,29 @@ def main(argv=None) -> int:
                          "smoke in seconds on CPU (the CI fast path)")
     args = ap.parse_args(argv)
 
+    import contextlib
+    import tempfile
+
     obs_dir = os.environ.get("HFREP_OBS_DIR")
-    with obs_pkg.session_or_off(obs_dir, "bench_serve",
-                                command="bench_serve") as obs:
-        if obs_dir and not obs.enabled:
-            obs_dir = None                 # degraded: nothing to gate below
-        rc = run_probe(obs, args.self_test)
-    from hfrep_tpu.obs import history as hist_mod
-    hist = hist_mod.resolve_history(obs_dir)
-    if obs_dir and hist:
-        rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
+    # the self-test's zero-orphan-trace drill needs a readable event
+    # stream even in the env-stripped CI invocation: a throwaway run dir
+    # that never gates or ingests (the sentinel keys off HFREP_OBS_DIR
+    # alone, so a temp dir cannot pollute the committed store)
+    tmp_ctx = (tempfile.TemporaryDirectory(prefix="bench_serve_obs_")
+               if args.self_test and not obs_dir
+               else contextlib.nullcontext(None))
+    with tmp_ctx as tmp_dir:
+        run_dir = obs_dir or (os.path.join(tmp_dir, "run")
+                              if tmp_dir else None)
+        with obs_pkg.session_or_off(run_dir, "bench_serve",
+                                    command="bench_serve") as obs:
+            if obs_dir and not obs.enabled:
+                obs_dir = None             # degraded: nothing to gate below
+            rc = run_probe(obs, args.self_test)
+        from hfrep_tpu.obs import history as hist_mod
+        hist = hist_mod.resolve_history(obs_dir)
+        if obs_dir and hist:
+            rc = hist_mod.gate_and_ingest(obs_dir, hist, rc)
     return rc
 
 

@@ -32,7 +32,7 @@ python -m hfrep_tpu.obs gate --self-test 1>&2
 # mechanism, not a measurement of the backend) and stripped of the
 # telemetry env: ambient HFREP_OBS_DIR/HFREP_HISTORY must not make a CI
 # self-test ingest a non-measurement record into the committed store.
-env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python tools/bench_ae.py --self-test 1>&2
 # resilience gate: kill→resume bit-identical (REAL SIGTERM through the
 # graceful-drain handler, 21-lane + multi-dataset AE sweeps at fixture
@@ -49,13 +49,13 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
 # CPU-pinned and env-stripped like the bench self-test: ambient
 # HFREP_OBS_DIR/HFREP_HISTORY must not pollute the committed history
 # store, and an ambient HFREP_FAULTS plan must not fire inside the gate.
-env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python -m hfrep_tpu.resilience selftest 1>&2
 # mixed-precision gate: the production Policy path end to end at fixture
 # shapes — fp32-policy identity (bit-identical graphs), bf16-vs-f32
 # trajectory tolerance with fp32 master weights, fused==alternating G/D
 # at n_critic=1.  CPU-pinned + env-stripped like the other self-tests.
-env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python tools/bench_bf16_probe.py --self-test 1>&2
 # serving gate: the overload envelope at tiny shapes — AOT-warmed
 # programs, micro-batch load levels with zero silent drops and bounded
@@ -64,13 +64,22 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY JAX_PLATFORMS=cpu \
 # streak → breaker opens, serves flagged-stale degraded answers, closes
 # after cooldown).  Env-stripped so ambient fault plans / history stores
 # stay out of the gate.
-env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python tools/bench_serve.py --self-test 1>&2
+# crash-forensics drill (flight recorder): a real obs session drives a
+# real (tiny) AE training on NaN-poisoned data with the health tripwire
+# armed and io_fail@obs_append faults injected into the event stream;
+# the NumericFault must land a COMPLETE checksum-verifying crash bundle
+# (events tail + manifest + traceback + env) plus the forensic carry
+# dump, and `report --crash` must render it.  Env-stripped + CPU-pinned
+# like the other gates; runs in seconds.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH \
+    JAX_PLATFORMS=cpu python -m hfrep_tpu.obs crash-drill 1>&2
 # scenario-factory gate: bank determinism replay (same seed+regime ⇒
 # identical aggregate digest, re-derived three independent ways), the
 # 100-lane walk-forward preempt→resume bit-identity drill (injected
 # preempt at a training chunk boundary AND a scoring window boundary;
 # resumed surface byte-identical to an undisturbed run), universe
 # synthesis determinism.  Env-stripped + CPU-pinned like the others.
-env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python tools/bench_scenario.py --self-test 1>&2
