@@ -116,6 +116,14 @@ def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
     their rates as ``bench/<label>`` gauges instead."""
     obs = obs_pkg.get_obs()
     span = "block" if label == "headline" else "bench"
+    if obs.enabled:
+        # perf microscope: fingerprint the measured program BEFORE the
+        # first (donating) dispatch — trace+lower only, outside both
+        # timing windows, so a recompile/fusion change between bench
+        # rounds is a diffable run.json fact instead of a mystery rate
+        from hfrep_tpu.obs import attrib
+        attrib.profile_jitted(multi, f"bench:{label}", state,
+                              jax.random.fold_in(key, 0))
     t0 = time.perf_counter()
     for i in range(n_warmups):
         state, metrics = multi(state, jax.random.fold_in(key, i))
@@ -123,13 +131,33 @@ def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
     obs.record_span(span, time.perf_counter() - t0,
                     steps=n_warmups * steps_per_call, warmup=True,
                     synced=True, config=label)
+    if obs.enabled:
+        # an instrument_step-wrapped multi (the dp/sp launch factories)
+        # noted warmup calls 2..n into the attribution window — discard
+        # them so the timed window below starts clean
+        from hfrep_tpu.obs import attrib
+        attrib.reset_window()
     t0 = time.perf_counter()
+    disp = 0.0
     for i in range(n_warmups, n_warmups + n_calls):
+        d0 = time.perf_counter()
         state, metrics = multi(state, jax.random.fold_in(key, i))
+        disp += time.perf_counter() - d0
     float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
     dt = time.perf_counter() - t0
     obs.record_span(span, dt, steps=n_calls * steps_per_call,
                     warmup=False, synced=True, config=label)
+    if obs.enabled:
+        # dispatch-vs-compute split of the timed window (the device_get
+        # fence above is the window's one sync).  Instrumented multis
+        # already noted every steady call through their wrapper — only
+        # the plain-jit multis need the outer aggregate, or the same
+        # wall time would count twice
+        from hfrep_tpu.obs import attrib
+        if not attrib.window_calls():
+            attrib.note_dispatch(f"bench:{label}", disp)
+        attrib.flush_window(dt, steps=n_calls * steps_per_call,
+                            config=label)
     for v in metrics.values():
         assert jnp.isfinite(v).all()
     return n_calls * steps_per_call / dt

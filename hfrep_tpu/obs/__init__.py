@@ -600,8 +600,15 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
 
     When on: emits a ``parallel_build`` event, records the first call as
     a device-synced ``compile:<name>`` span (first call pays trace +
-    XLA compile), and counts subsequent dispatches (un-synced — counting
-    must not serialize the trainer's block pipelining).
+    XLA compile) — also fingerprinting the lowered program against the
+    first call's operands (``program_profile`` event + ``run.json``
+    ``programs`` entry, hfrep_tpu/obs/attrib.py; graceful no-op where
+    the callable or runtime cannot lower) — and counts subsequent
+    dispatches (un-synced — counting must not serialize the trainer's
+    block pipelining) while accumulating their un-blocked host-side
+    durations into the attribution window ``StepTimer.stop`` flushes at
+    the block boundaries the trainer already syncs at (the
+    dispatch-vs-compute split; zero per-call events, zero new syncs).
     """
     obs = get_obs()
     if not obs.enabled:
@@ -611,8 +618,12 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
     state = {"first": True}
 
     def wrapped(*args, **kwargs):
+        from hfrep_tpu.obs import attrib
         if state["first"]:
             state["first"] = False
+            # fingerprint BEFORE executing: the jitted step may donate
+            # its input buffers, and lowering only reads avals anyway
+            attrib.profile_jitted(fn, f"compile:{name}", *args, **kwargs)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
             try:
@@ -624,7 +635,10 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
                             synced=True)
             return out
         obs.counter(f"dispatch:{name}").inc()
-        return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        attrib.note_dispatch(name, time.perf_counter() - t0)
+        return out
 
     wrapped.__wrapped__ = fn
     wrapped.__name__ = f"obs_instrumented_{name}"

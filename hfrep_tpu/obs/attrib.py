@@ -1,0 +1,446 @@
+"""Perf microscope, write side: compiled-program fingerprints,
+dispatch-vs-compute attribution, and xprof-trace digestion.
+
+The sentinel (PR 3) says *whether* a run regressed and the flight
+recorder (PR 12) says *what happened*; nothing in the repo could say
+*why* — the BENCH_r01–r05 headline sat 5–7% under its own recorded
+``vs_baseline`` for five rounds and nobody could tell a recompile from
+a fusion change from dispatch overhead, because no run records what
+programs it actually compiled or where its wall clock went.  This
+module is the per-program cost/attribution layer (the third pillar of
+profiling-in-production; cf. the xprof/roofline methodology in
+PAPERS.md's scaling references):
+
+* **program fingerprints** — at every compile boundary the repo owns
+  (``instrument_step``-wrapped train steps, the AE engine's chunk
+  program cache, ``serve/aot.py``'s AOT compiles, ``bench.py``'s timed
+  programs), :func:`profile_jitted` / :func:`profile_stage` capture the
+  lowered program text's sha256 digest plus ``cost_analysis()`` /
+  ``memory_analysis()`` where the runtime carries them (graceful None
+  otherwise — every jax access is gated through
+  :mod:`hfrep_tpu.utils.jax_compat`), land them as ``program_profile``
+  events and index them in ``run.json``'s ``programs`` section — a
+  silent recompile or fusion change between two runs becomes a
+  machine-diffable fact (:mod:`hfrep_tpu.obs.explain` consumes it);
+* **dispatch-vs-compute attribution** — :func:`note_dispatch` /
+  :func:`flush_window` split an instrumented drive's wall clock into
+  host-dispatch time (the un-blocked jitted-call returns XLA's async
+  dispatch hands back immediately) vs the residual the host spent
+  blocked on device compute, measured ONLY at the block boundaries the
+  drives already sync at (``StepTimer.stop``, the AE engine's
+  continue/stop scalar) — zero new syncs inside scans, no-op when obs
+  is off, trajectories bit-identical (the PR-12 discipline; pinned by
+  ``tests/test_obs_attrib.py``).  Surfaced as
+  ``attrib/{dispatch_ms,compute_ms,dispatch_frac}`` gauges;
+* **trace digestion** — :func:`profile_run` parses the
+  ``trace_capture`` artifacts PR 3 lands under ``<run_dir>/traces``
+  (perfetto trace-event JSON; best-effort, typed
+  :class:`TraceUnavailable` when absent) into per-op / per-region time
+  tables with interval-union busy time (nested parent ops — a ``while``
+  spans its body — must not double-count), consolidating the parsing
+  that ``tools/mfu_trace_probe.py`` grew privately.
+
+Everything that *reads* (trace digestion) is stdlib-only; everything
+that *captures* imports jax lazily and only when a sink is enabled.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import hashlib
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from hfrep_tpu.obs import get_obs
+
+#: the event-stream name program fingerprints land under (documented in
+#: obs/README.md; hfrep_tpu/obs/explain.py and the manifest ``programs``
+#: section are the two readers)
+PROGRAM_EVENT = "program_profile"
+
+#: cost_analysis keys normalized into the profile (the jax cost model's
+#: names, spaces and all); everything else stays behind in the raw dict
+_COST_KEYS = (("flops", "flops"),
+              ("bytes accessed", "bytes_accessed"),
+              ("transcendentals", "transcendentals"))
+
+
+def fingerprint_text(text: Optional[str]) -> Optional[str]:
+    """sha256 hex digest of a lowered/compiled program's text — the
+    machine-diffable identity of "the same program"."""
+    if not text:
+        return None
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _profile_dict(name: str, stage, compiled=None) -> dict:
+    """The JSON-safe profile of one compile boundary.  ``stage`` is the
+    Lowered (or anything with ``as_text``/``cost_analysis``);
+    ``compiled`` optionally adds the Compiled's ``memory_analysis``."""
+    from hfrep_tpu.utils import jax_compat
+
+    text = jax_compat.stage_hlo_text(stage)
+    cost = jax_compat.stage_cost_analysis(stage)
+    if cost is None and compiled is not None:
+        cost = jax_compat.stage_cost_analysis(compiled)
+    prof = {
+        "name": str(name),
+        "hlo_sha256": fingerprint_text(text),
+        "hlo_bytes": len(text) if text else None,
+        "cost": ({dst: cost.get(src) for src, dst in _COST_KEYS}
+                 if cost else None),
+        "memory": jax_compat.stage_memory_analysis(
+            compiled if compiled is not None else stage),
+    }
+    return prof
+
+
+def profile_stage(name: str, stage, compiled=None) -> Optional[dict]:
+    """Fingerprint an already-lowered/compiled stage into the active
+    run: one ``program_profile`` event + a ``run.json`` ``programs``
+    entry.  No-op (None) when telemetry is off; never raises into the
+    caller — a failed fingerprint must not cost the program it
+    describes."""
+    obs = get_obs()
+    if not obs.enabled:
+        return None
+    try:
+        prof = _profile_dict(name, stage, compiled)
+        payload = dict(prof)
+        # the boundary name rides as ``program`` — the event's own
+        # ``name`` is the type tag ("program_profile") and must not be
+        # overwritten by the profile's
+        payload["program"] = payload.pop("name")
+        obs.event("program_profile", **payload)
+        from hfrep_tpu.obs import manifest
+        manifest.add_program(obs.run_dir, prof)
+        return prof
+    except Exception:
+        return None
+
+
+def profile_jitted(fn, name: str, *args, **kwargs) -> Optional[dict]:
+    """Fingerprint a jitted callable at a compile boundary by lowering
+    it against the example operands (trace + lower only — no second XLA
+    compile, no execution, donated buffers untouched).  No-op when
+    telemetry is off or the callable/runtime cannot lower (a wrapped
+    non-jit function, a non-jax operand): the boundary stays
+    fingerprint-less, never broken."""
+    obs = get_obs()
+    if not obs.enabled:
+        return None
+    from hfrep_tpu.utils import jax_compat
+    lowered = jax_compat.lower_jitted(fn, *args, **kwargs)
+    if lowered is None:
+        return None
+    return profile_stage(name, lowered)
+
+
+# ------------------------------------------- dispatch-vs-compute windows
+class _Window:
+    """The open attribution window: host-dispatch seconds accumulated
+    per step name since the last boundary flush.  One process drives one
+    step at a time, so a single module-level window (guarded for the
+    serve layer's threads) is the whole story; per-name detail rides the
+    gauge attrs."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.dispatch_s: Dict[str, float] = {}
+        self.calls: Dict[str, int] = {}
+
+    def note(self, name: str, dur_s: float) -> None:
+        with self.lock:
+            self.dispatch_s[name] = self.dispatch_s.get(name, 0.0) + dur_s
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def take(self) -> Tuple[Dict[str, float], Dict[str, int]]:
+        with self.lock:
+            d, c = self.dispatch_s, self.calls
+            self.dispatch_s, self.calls = {}, {}
+            return d, c
+
+
+_WINDOW = _Window()
+
+
+def note_dispatch(name: str, dur_s: float) -> None:
+    """Record one un-blocked jitted call's host-side duration (callers
+    gate on ``obs.enabled`` at build/drive time, so the off path never
+    reaches here).  Pure accumulation — no event, no sync."""
+    _WINDOW.note(name, dur_s)
+
+
+def reset_window() -> None:
+    """Discard the open window (warmup blocks: their dispatch carries
+    XLA compile time and would poison the first steady attribution)."""
+    _WINDOW.take()
+
+
+def window_calls() -> int:
+    """How many dispatches the open window holds, without draining it —
+    the probe callers use to decide whether the steps they just drove
+    were already instrumented (noting an outer aggregate on top would
+    double-count the same wall time)."""
+    with _WINDOW.lock:
+        return sum(_WINDOW.calls.values())
+
+
+def flush_window(wall_s: float, steps: Optional[int] = None,
+                 warmup: bool = False, **attrs) -> Optional[dict]:
+    """Close the attribution window at a boundary the drive already
+    syncs at: ``wall_s`` is the synced wall clock of the window, the
+    accumulated dispatch seconds split it into host-dispatch vs
+    device-compute.  Emits ``attrib/{dispatch_ms,compute_ms,
+    dispatch_frac}`` gauges (lower dispatch_frac is better — a rising
+    fraction means the host, not the chip, is the bottleneck).  Warmup
+    windows are discarded (their dispatch time is XLA compile).  No-op
+    with nothing accumulated or telemetry off."""
+    dispatch, calls = _WINDOW.take()
+    obs = get_obs()
+    n_calls = sum(calls.values())
+    if not obs.enabled or warmup or not n_calls or not wall_s > 0:
+        return None
+    dispatch_s = sum(dispatch.values())
+    # clamp: on a synchronous backend (CPU) the dispatch IS the compute
+    # and rounding can push the sum a hair past the wall
+    dispatch_s = min(dispatch_s, wall_s)
+    compute_s = wall_s - dispatch_s
+    frac = dispatch_s / wall_s
+    steps_attr = {} if steps is None else {"steps": int(steps)}
+    names = ",".join(sorted(calls))
+    out = {"dispatch_ms": dispatch_s * 1e3, "compute_ms": compute_s * 1e3,
+           "dispatch_frac": frac, "calls": n_calls, "wall_ms": wall_s * 1e3,
+           "step": names}
+    obs.gauge("attrib/dispatch_ms").set(
+        round(dispatch_s * 1e3, 3), step=names, calls=n_calls,
+        **steps_attr, **attrs)
+    obs.gauge("attrib/compute_ms").set(
+        round(compute_s * 1e3, 3), step=names, calls=n_calls,
+        **steps_attr, **attrs)
+    obs.gauge("attrib/dispatch_frac").set(
+        round(frac, 6), step=names, calls=n_calls, **steps_attr, **attrs)
+    return out
+
+
+class dispatch_timer:
+    """``with dispatch_timer("ae_chunk"): fn(...)`` — time one un-blocked
+    dispatch into the open window (the AE engine's chunk loop hook; the
+    GAN steps go through ``instrument_step``'s wrapper instead)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        note_dispatch(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+# ------------------------------------------------------- trace digestion
+class TraceUnavailable(RuntimeError):
+    """A run dir carries no digestible profiler trace — the typed skip
+    (``obs profile`` renders it as a skip document, never a crash):
+    either the run never captured one (``trace_capture`` is opt-in) or
+    the runtime's profiler emitted a format this parser does not read
+    (xplane-only exports carry no trace-event JSON)."""
+
+
+def find_trace_files(run_dir) -> List[Path]:
+    """Every perfetto trace-event JSON under the run dir's capture
+    roots: the ``traces`` links in ``run.json`` plus the default
+    ``<run_dir>/traces`` tree (``**/*.trace.json.gz`` — the layout
+    ``jax.profiler`` writes under ``plugins/profile/<session>/``)."""
+    run_dir = Path(run_dir)
+    roots = [run_dir / "traces"]
+    try:
+        doc = json.loads((run_dir / "run.json").read_text())
+        for link in doc.get("traces") or []:
+            if isinstance(link, dict) and link.get("path"):
+                roots.append(Path(str(link["path"])))
+    except (OSError, json.JSONDecodeError):
+        pass
+    out: List[Path] = []
+    seen = set()
+    for root in roots:
+        for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+            for p in sorted(glob.glob(str(root / pat), recursive=True)):
+                if p not in seen:
+                    seen.add(p)
+                    out.append(Path(p))
+    return out
+
+
+def load_trace_events(path) -> Tuple[List[Tuple[str, float, float]],
+                                     List[str]]:
+    """All complete events on device-pid ``XLA Ops`` threads of one
+    perfetto trace: ``([(op_name, ts_us, dur_us)], sorted thread names)``
+    — the parser ``tools/mfu_trace_probe.py`` carried privately, now the
+    one shared implementation."""
+    path = Path(path)
+    opener = gzip.open if path.name.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        tr = json.load(fh)
+    ev = tr.get("traceEvents", []) if isinstance(tr, dict) else []
+    pid_name, tid_name = {}, {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e.get("pid")] = (e.get("args") or {}).get("name", "")
+        elif e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_name[(e.get("pid"), e.get("tid"))] = \
+                (e.get("args") or {}).get("name", "")
+    dev_pids = {p for p, n in pid_name.items()
+                if "TPU" in n.upper() or "device" in n.lower()}
+    op_tids = {pt for pt, n in tid_name.items()
+               if pt[0] in dev_pids and "XLA Ops" in n}
+    out = []
+    for e in ev:
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
+            try:
+                out.append((str(e.get("name", "")), float(e["ts"]),
+                            float(e.get("dur", 0.0))))
+            except (KeyError, TypeError, ValueError):
+                continue
+    return out, sorted(set(tid_name.values()))
+
+
+def interval_union_s(events) -> float:
+    """Union length of the events' ``[ts, ts+dur)`` intervals in seconds
+    — device busy time without double-counting parents (a ``while`` op
+    SPANS its body's ops; a fusion wrapper spans its constituents — a
+    plain sum counts them twice, the union does not)."""
+    ivs = sorted((ts, ts + d) for _, ts, d in events if d > 0)
+    total, cur_a, cur_b = 0.0, None, None
+    for a, b in ivs:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        total += cur_b - cur_a
+    return total * 1e-6                                   # us -> s
+
+
+def op_table(events, top: int = 20) -> List[dict]:
+    """Per-op time table (summed self-reported durations — comparable
+    *between* ops; the union above is the honest total), largest
+    first."""
+    by_op: Dict[str, List[float]] = {}
+    for name, _, dur in events:
+        by_op.setdefault(name, [0.0, 0])
+        by_op[name][0] += dur * 1e-6
+        by_op[name][1] += 1
+    rows = [{"op": n, "total_s": round(v[0], 9), "n": int(v[1])}
+            for n, v in by_op.items()]
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows[: max(0, int(top))] if top else rows
+
+
+def region_table(events, regions=(("lstm", ("lstm", "LSTM")),
+                                  ("fusion", ("fusion",)),
+                                  ("while", ("while",)),
+                                  ("custom-call", ("custom-call",)),
+                                  ("convolution/dot", ("dot", "conv")),
+                                  )) -> List[dict]:
+    """Named-region busy time: interval union over the ops whose name
+    carries any of the region's substrings (matched events nest — the
+    same trap as the total, so each region is its own union)."""
+    out = []
+    for label, needles in regions:
+        matched = [e for e in events
+                   if any(n in e[0] for n in needles)]
+        if matched:
+            out.append({"region": label,
+                        "busy_s": round(interval_union_s(matched), 9),
+                        "n": len(matched)})
+    out.sort(key=lambda r: -r["busy_s"])
+    return out
+
+
+def profile_run(run_dir, top: int = 20) -> dict:
+    """Digest every trace a run captured into one per-op/per-region time
+    document.  Raises :class:`TraceUnavailable` (typed, for the CLI's
+    skip path) when the run carries no digestible trace."""
+    run_dir = Path(run_dir)
+    files = find_trace_files(run_dir)
+    if not files:
+        raise TraceUnavailable(
+            f"{run_dir}: no trace-event JSON under traces/ or the "
+            "manifest's trace links (trace_capture is opt-in, and "
+            "xplane-only profiler exports carry no trace.json.gz)")
+    captures = []
+    parsed_any = False
+    for f in files:
+        try:
+            events, threads = load_trace_events(f)
+        except (OSError, json.JSONDecodeError, EOFError) as e:
+            captures.append({"file": str(f), "error": str(e)})
+            continue
+        parsed_any = True
+        captures.append({
+            "file": str(f),
+            "n_events": len(events),
+            "busy_s": round(interval_union_s(events), 9),
+            "ops": op_table(events, top=top),
+            "regions": region_table(events),
+            "threads": threads,
+        })
+    if not parsed_any:
+        raise TraceUnavailable(
+            f"{run_dir}: {len(files)} trace file(s) present but none "
+            "parsed as trace-event JSON")
+    return {"run_dir": str(run_dir), "n_traces": len(files),
+            "captures": captures}
+
+
+def render_profile(doc: dict) -> str:
+    """Human rendering of :func:`profile_run`'s document."""
+    lines = [f"trace profile — {doc['run_dir']} "
+             f"({doc['n_traces']} capture(s))"]
+    for cap in doc["captures"]:
+        if "error" in cap:
+            lines.append(f"  {cap['file']}: unreadable ({cap['error']})")
+            continue
+        lines.append(f"  {cap['file']}")
+        lines.append(f"    device busy {cap['busy_s'] * 1e3:.3f} ms "
+                     f"(interval union over {cap['n_events']} op events)")
+        for r in cap["regions"]:
+            lines.append(f"    region {r['region']:16s} "
+                         f"{r['busy_s'] * 1e3:10.3f} ms  (n={r['n']})")
+        for row in cap["ops"][:10]:
+            lines.append(f"    op {row['op'][:48]:48s} "
+                         f"{row['total_s'] * 1e3:10.3f} ms  (n={row['n']})")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- CLI entry
+def profile_main(run_dir, top: int = 20, fmt: str = "human") -> int:
+    """``obs profile RUN_DIR`` — exit 0 with a table/JSON document, or a
+    typed skip document (still exit 0: an un-profiled run is a fact, not
+    a failure) when the run carries no digestible trace."""
+    import sys
+    try:
+        doc = profile_run(run_dir, top=top)
+    except TraceUnavailable as e:
+        if fmt == "json":
+            print(json.dumps({"run_dir": str(run_dir), "skipped": str(e)}))
+        else:
+            print(f"profile skipped: {e}", file=sys.stderr)
+        return 0
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if fmt == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(render_profile(doc))
+    return 0

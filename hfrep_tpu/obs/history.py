@@ -57,10 +57,11 @@ METRIC_FIELDS = (
 #: gauge-name prefixes whose values ride into the record verbatim — the
 #: bench probes' ``bench/<name>`` emissions, the serving layer's
 #: ``serve/<name>`` gauges, the scenario factory's ``scenario/<name>``
-#: gauges and the flight recorder's ``health/<name>`` gauges become
+#: gauges, the flight recorder's ``health/<name>`` gauges and the perf
+#: microscope's ``attrib/<name>`` dispatch/compute splits become
 #: first-class history metrics without the store having to know each
 #: probe's vocabulary
-GAUGE_PREFIXES = ("bench/", "serve/", "scenario/", "health/")
+GAUGE_PREFIXES = ("bench/", "serve/", "scenario/", "health/", "attrib/")
 BENCH_GAUGE_PREFIX = "bench/"          # back-compat alias
 
 #: deadline-class ladder for the serve shape signature: a 10ms-deadline

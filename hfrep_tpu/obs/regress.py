@@ -173,6 +173,23 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                              "mad_mult": 5.0},
     "health/ae_nonfinite":  {"direction": "down", "rel_tol": 0.0,
                              "abs_tol": 0.5, "mad_mult": 0.0},
+    # perf-microscope attribution gauges (hfrep_tpu/obs/attrib.py;
+    # ISSUE 13).  ``dispatch_frac`` is the one that MUST be explicit:
+    # "_frac" carries no cost suffix, so the higher-is-better fallback
+    # would gate (and cross-host fold) it INVERTED — yet a RISING
+    # dispatch fraction means the host, not the chip, is becoming the
+    # bottleneck: lower is better.  It sits near 1.0 on a synchronous
+    # CPU backend and near 0 on a pipelined TPU drive, so the floor is
+    # absolute (a relative tolerance of ~nothing at either extreme
+    # would flag scheduler jitter).  The ms splits are costs with
+    # generous relative floors — they are attribution evidence for
+    # ``obs explain``, not primary gates; steps_per_sec stays the alarm.
+    "attrib/dispatch_ms":   {"direction": "down", "rel_tol": 0.25,
+                             "mad_mult": 5.0},
+    "attrib/compute_ms":    {"direction": "down", "rel_tol": 0.25,
+                             "mad_mult": 5.0},
+    "attrib/dispatch_frac": {"direction": "down", "rel_tol": 0.0,
+                             "abs_tol": 0.10, "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

@@ -295,15 +295,19 @@ def _weighted_percentile(pairs: List[Tuple[float, float]], q: float) -> float:
     return pairs[-1][0]
 
 
-def summarize(run_dir) -> dict:
-    """One run directory -> headline summary dict (all JSON-safe)."""
+def summarize(run_dir, events: Optional[List[dict]] = None) -> dict:
+    """One run directory -> headline summary dict (all JSON-safe).
+    ``events``: the already-parsed stream, when the caller just loaded
+    it (``obs explain`` parses every cohort run once for evidence —
+    re-reading the same JSONL here would double the diagnosis's I/O)."""
     run_dir = Path(run_dir)
     try:
         from hfrep_tpu.obs.manifest import read_manifest
         manifest = read_manifest(run_dir)
     except (OSError, json.JSONDecodeError):
         manifest = {}
-    events = load_events(run_dir)
+    if events is None:
+        events = load_events(run_dir)
 
     counts: Dict[str, int] = {}
     blocks: List[dict] = []
@@ -657,6 +661,46 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exercise ingest/merge/baseline/verdict on the "
                         "committed history fixture (CI gate; pure-JSON "
                         "stdout)")
+    g.add_argument("--explain", action="store_true",
+                   help="on a failing gate, diff the offending run "
+                        "against the comparable history runs still on "
+                        "disk (program fingerprints, compile counts, "
+                        "cost-analysis flops, span/attrib deltas) and "
+                        "append a ranked diagnosis to the verdict")
+
+    x = sub.add_parser(
+        "explain", help="ranked regression diagnosis: diff the LAST run "
+                        "dir against the earlier one(s) as baseline "
+                        "cohort — program fingerprints, compile counts, "
+                        "cost-analysis flops, dispatch-vs-compute and "
+                        "span deltas, worst first")
+    x.add_argument("run_dirs", nargs="*",
+                   help="BASELINE [BASELINE...] TARGET (>= 2; the last "
+                        "dir is the offending run; omit with "
+                        "--self-test/--history)")
+    x.add_argument("--format", choices=("human", "json"), default="human")
+    x.add_argument("--top", type=int, default=10, metavar="N",
+                   help="keep the N highest-scored findings (default 10)")
+    x.add_argument("--history", default=None, metavar="PATH",
+                   help="instead of diffing run dirs, report what the "
+                        "history STORE alone can attribute: per-metric "
+                        "series + an evidence inventory (compile "
+                        "counters / memory / resolvable run dirs per "
+                        "record)")
+    x.add_argument("--self-test", action="store_true",
+                   help="exercise the diagnosis loop on the committed "
+                        "planted-regression fixture (CI gate; pure-JSON "
+                        "stdout)")
+
+    pr = sub.add_parser(
+        "profile", help="digest a run dir's captured profiler traces "
+                        "(trace_capture artifacts under <run_dir>/traces) "
+                        "into per-op / per-region device time tables; "
+                        "typed skip when the run captured none")
+    pr.add_argument("run_dir")
+    pr.add_argument("--format", choices=("human", "json"), default="human")
+    pr.add_argument("--top", type=int, default=20, metavar="N",
+                    help="ops per capture in the table (default 20)")
 
     i = sub.add_parser(
         "ingest", help="append a run dir to a history.jsonl index")
@@ -799,10 +843,30 @@ def _cmd_gate(args) -> int:
     except (OSError, SchemaError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    explain_doc = None
+    if args.explain and not verdict["ok"]:
+        # the gate's red exit becomes a diagnosis: diff against the
+        # comparable history runs still on disk.  Best-effort — a
+        # failed explanation must never change the gate's verdict.
+        from hfrep_tpu.obs import explain as explain_mod
+        try:
+            explain_doc = explain_mod.explain_gate_failure(
+                args.run_dir, record, records, history_path=history_path,
+                window=args.window or regress.DEFAULT_WINDOW)
+        except Exception as e:
+            print(f"explain failed ({e}); verdict unaffected",
+                  file=sys.stderr)
     if args.format == "json":
-        print(regress.verdict_json(verdict))
+        if explain_doc is not None:
+            print(json.dumps(dict(verdict, explain=explain_doc),
+                             indent=2, default=str))
+        else:
+            print(regress.verdict_json(verdict))
     else:
         print(regress.render_verdict(verdict))
+        if explain_doc is not None:
+            from hfrep_tpu.obs import explain as explain_mod
+            print(explain_mod.render_diagnosis(explain_doc))
     if verdict["ok"] and args.ingest:
         try:
             ok = hist_mod.append_record(
@@ -828,6 +892,42 @@ def _cmd_ingest(args) -> int:
     return 0
 
 
+def _cmd_explain(args) -> int:
+    from hfrep_tpu.obs import explain as explain_mod
+    if args.self_test:
+        return explain_mod.self_test()
+    if args.history:
+        from hfrep_tpu.obs import history as hist_mod
+        try:
+            records = hist_mod.load_history(args.history)
+        except (OSError, SchemaError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        doc = explain_mod.history_report(records)
+        if args.format == "json":
+            print(json.dumps(doc, indent=2, default=str))
+        else:
+            print(explain_mod.render_history_report(doc))
+        return 0
+    if len(args.run_dirs) < 2:
+        print("explain wants BASELINE [BASELINE...] TARGET run dirs "
+              "(or --history / --self-test)", file=sys.stderr)
+        return 2
+    doc = explain_mod.explain_runs(args.run_dirs[:-1], args.run_dirs[-1],
+                                   top=args.top)
+    if args.format == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(explain_mod.render_diagnosis(doc))
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    from hfrep_tpu.obs import attrib
+    return attrib.profile_main(args.run_dir, top=args.top,
+                               fmt=args.format)
+
+
 def _cmd_tail(args) -> int:
     from hfrep_tpu.obs import tail
     return tail.tail_main(args.run_dirs, interval=args.interval,
@@ -848,7 +948,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"report": _cmd_report, "gate": _cmd_gate,
             "ingest": _cmd_ingest, "tail": _cmd_tail,
-            "export": _cmd_export,
+            "export": _cmd_export, "explain": _cmd_explain,
+            "profile": _cmd_profile,
             "crash-drill": _cmd_crash_drill}[args.command](args)
 
 

@@ -70,8 +70,8 @@ def jax_export_supported() -> bool:
         return False
 
 
-def aot_compile(fn: Callable, *example_args,
-                via_export: bool = True) -> Tuple[Callable, str]:
+def aot_compile(fn: Callable, *example_args, via_export: bool = True,
+                label: Optional[str] = None) -> Tuple[Callable, str]:
     """Ahead-of-time compile ``fn`` against ``example_args``.
 
     Returns ``(callable, mode)`` with ``mode`` one of ``"export"`` (the
@@ -88,18 +88,36 @@ def aot_compile(fn: Callable, *example_args,
     compile back into the request path that "ahead of time" exists to
     protect (measured: the first serve of a "warmed" program paid
     ~0.5s).
+
+    ``label`` opts the compile into the perf microscope: with telemetry
+    enabled the lowered program's fingerprint + cost/memory analysis
+    land as a ``program_profile`` event and a ``run.json`` ``programs``
+    entry (``hfrep_tpu/obs/attrib.py``), so two serve runs' compiled
+    fleets are machine-diffable.  Compile-time only — nothing touches
+    the request path.
     """
+    from hfrep_tpu.obs import attrib, get_obs
+
     if via_export and jax_export_supported():
         try:
             from jax import export
             exported = export.export(jax.jit(fn))(*example_args)
             rehydrated = export.deserialize(exported.serialize())
             jax.block_until_ready(rehydrated.call(*example_args))
+            if label and get_obs().enabled:
+                # the Exported carries no cost API; re-lower (trace
+                # only) for the fingerprint — serving startup, not the
+                # request path
+                attrib.profile_jitted(jax.jit(fn), f"{label}:export",
+                                      *example_args)
             return rehydrated.call, "export"
         except Exception:
             pass
-    compiled = jax.jit(fn).lower(*example_args).compile()
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
     jax.block_until_ready(compiled(*example_args))
+    if label:
+        attrib.profile_stage(f"{label}:compiled", lowered, compiled)
     return compiled, "compiled"
 
 

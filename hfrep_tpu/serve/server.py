@@ -553,7 +553,8 @@ class ReplicationServer:
                                     jnp.zeros((bsz, rows, feats), jnp.float32),
                                     jnp.zeros((bsz,), jnp.int32),
                                     self._ae_mask(),
-                                    via_export=self.cfg.via_export)[0])
+                                    via_export=self.cfg.via_export,
+                                    label=f"serve:replicate:b{bsz}r{rows}")[0])
 
     def _sample_program(self, bucket: int):
         model = self.gen_model
@@ -563,7 +564,8 @@ class ReplicationServer:
             lambda: aot.aot_compile(
                 aot.gen_batch_fn(model), model.params,
                 jnp.zeros((bucket, w, f), jnp.float32),
-                via_export=self.cfg.via_export)[0])
+                via_export=self.cfg.via_export,
+                label=f"serve:sample:b{bucket}")[0])
 
     def _run_replicate(self, batch: List[ServeRequest]) -> List[dict]:
         model = self.ae_model

@@ -91,7 +91,16 @@ class GanTrainer:
                 self.state = replicate_to_global(self.state, mesh)
                 self.key = replicate_to_global(self.key, mesh)
         else:
-            self._multi = make_multi_step(self.pair, cfg.train, self.windows)
+            # single-device path joins the same build-time hook the
+            # parallel factories use (no-op object passthrough when
+            # telemetry is off): compile:<name> span + lowered-program
+            # fingerprint on the first call, dispatch counting + the
+            # dispatch-vs-compute attribution window on steady calls
+            from hfrep_tpu.obs import instrument_step
+            self._multi = instrument_step(
+                make_multi_step(self.pair, cfg.train, self.windows),
+                "multi_step", batch=cfg.train.batch_size,
+                steps_per_call=cfg.train.steps_per_call)
         style = {"bce": "gan", "wgan_clip": "wgan", "wgan_gp": "wgan_gp"}[self.pair.loss]
         self.logger = logger or MetricLogger(echo=False, echo_style=style)
         self.timer = StepTimer()

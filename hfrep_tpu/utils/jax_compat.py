@@ -60,3 +60,94 @@ except ImportError:
         (identical value, one collective) on older runtimes."""
         from jax import lax
         return lax.psum(1, axis_name)
+
+
+# ------------------------------------------------- AOT-stage introspection
+# The perf microscope (hfrep_tpu/obs/attrib.py) reads compiled-program
+# facts — lowered HLO text, cost_analysis, memory_analysis — off the
+# jax.stages objects at every compile boundary.  Those APIs exist on the
+# pinned 0.4.37 but have drifted across jax versions (cost_analysis
+# moved Lowered→Compiled and back; memory_analysis is Compiled-only;
+# AOT ``.lower`` is absent on plain callables), so every access is
+# gated HERE, returns None instead of raising, and the telemetry layer
+# degrades to fingerprint-less profiles — a missing introspection API
+# must never cost a run or a measurement.
+
+def lower_jitted(fn, *args, **kwargs):
+    """``jit(f).lower(*args)`` (trace + lower, NO XLA compile) where this
+    runtime supports it, unwrapping one obs instrumentation layer
+    (``__wrapped__``); None when ``fn`` has no usable ``.lower`` or the
+    trace itself fails (non-jax operands, donated-shape mismatch...)."""
+    # a jitted callable carries BOTH .lower and __wrapped__ (the plain
+    # python function) — prefer .lower; unwrap only when absent (the
+    # obs instrument_step wrapper hides the jitted fn one level down)
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        lower = getattr(getattr(fn, "__wrapped__", None), "lower", None)
+    if lower is None:
+        return None
+    try:
+        return lower(*args, **kwargs)
+    except Exception:
+        return None
+
+
+def stage_hlo_text(stage):
+    """The stage's program text (``as_text()``; the pre-optimization HLO
+    for a Lowered, the optimized module for a Compiled), or None."""
+    as_text = getattr(stage, "as_text", None)
+    if as_text is None:
+        return None
+    try:
+        text = as_text()
+    except Exception:
+        return None
+    return text if isinstance(text, str) else None
+
+
+def stage_cost_analysis(stage):
+    """Flat ``{metric: float}`` cost analysis of a Lowered/Compiled stage
+    (0.4.37 returns a dict from Lowered and a one-per-computation list
+    from Compiled — normalized here by summing), or None."""
+    cost = getattr(stage, "cost_analysis", None)
+    if cost is None:
+        return None
+    try:
+        raw = cost()
+    except Exception:
+        return None
+    if isinstance(raw, dict):
+        raw = [raw]
+    if not isinstance(raw, (list, tuple)) or not raw:
+        return None
+    out = {}
+    for entry in raw:
+        if not isinstance(entry, dict):
+            continue
+        for k, v in entry.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[str(k)] = out.get(str(k), 0.0) + float(v)
+    return out or None
+
+
+def stage_memory_analysis(stage):
+    """``{field: bytes}`` from a Compiled stage's ``memory_analysis()``
+    (a ``CompiledMemoryStats``-shaped object), or None — Lowered stages
+    and older runtimes simply lack it."""
+    mem = getattr(stage, "memory_analysis", None)
+    if mem is None:
+        return None
+    try:
+        stats = mem()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+    out = {}
+    for field in dir(stats):
+        if field.startswith("_"):
+            continue
+        v = getattr(stats, field, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[field] = float(v)
+    return out or None

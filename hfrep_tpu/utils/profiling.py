@@ -35,7 +35,16 @@ class StepTimer:
     def stop(self, n_steps: int, sync_on=None, warmup: bool = False) -> float:
         """``warmup=True`` marks a sample that carries XLA compile time
         (~30-40s for the GAN steps); such samples are excluded from
-        :attr:`steps_per_sec` whenever steady-state samples exist."""
+        :attr:`steps_per_sec` whenever steady-state samples exist.
+
+        This stop is the one device-synced boundary every timed drive
+        already pays, so it doubles as the perf microscope's attribution
+        boundary: the dispatch seconds the instrumented steps
+        accumulated inside this window (hfrep_tpu/obs/attrib.py) are
+        flushed against the synced wall clock into
+        ``attrib/{dispatch_ms,compute_ms,dispatch_frac}`` gauges —
+        warmup windows are discarded (their dispatch time is XLA
+        compile), and with telemetry off the flush is a no-op."""
         if sync_on is not None:
             jax.block_until_ready(sync_on)
         dt = time.perf_counter() - self._t0
@@ -47,6 +56,12 @@ class StepTimer:
             if n_steps > 0:
                 obs.histogram("step_time").observe(dt / n_steps,
                                                    warmup=bool(warmup))
+            from hfrep_tpu.obs import attrib
+            if warmup or sync_on is None:
+                # compile-polluted or un-synced wall: either would lie
+                attrib.reset_window()
+            else:
+                attrib.flush_window(dt, steps=int(n_steps))
         return dt
 
     @property

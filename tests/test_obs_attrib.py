@@ -1,0 +1,305 @@
+"""Perf microscope, write side (ISSUE 13): compiled-program
+fingerprints, dispatch-vs-compute attribution windows, trace digestion
+— and the bit-identity contract: attribution on vs off changes neither
+the traced programs nor a single trajectory value."""
+
+import gzip
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import AEConfig, ExperimentConfig, ModelConfig, \
+    TrainConfig
+from hfrep_tpu.obs import attrib
+from hfrep_tpu.obs import report as report_mod
+from hfrep_tpu.train.trainer import GanTrainer
+from hfrep_tpu.utils import jax_compat
+
+MCFG = ModelConfig(family="mtss_wgan_gp", features=5, window=8, hidden=8)
+TCFG = TrainConfig(epochs=4, batch_size=4, n_critic=2, steps_per_call=2,
+                   log_every=1)
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    obs_pkg.disable()
+    attrib.reset_window()
+    yield
+    obs_pkg.disable()
+    attrib.reset_window()
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(11)
+    return jnp.asarray(g.uniform(0, 1, (32, 8, 5)).astype(np.float32))
+
+
+def _events(run_dir):
+    return report_mod.load_events(run_dir)
+
+
+# ------------------------------------------------------------ fingerprints
+def test_profile_jitted_lands_event_and_manifest_entry(tmp_path):
+    run = tmp_path / "run"
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    x = jnp.ones((4, 3))
+    with obs_pkg.session(run):
+        prof = attrib.profile_jitted(f, "toy_program", x)
+    assert prof is not None
+    assert prof["name"] == "toy_program"
+    assert len(prof["hlo_sha256"]) == 64
+    assert prof["cost"]["flops"] and prof["cost"]["flops"] > 0
+
+    (ev,) = [e for e in _events(run)
+             if e["type"] == "event" and e["name"] == "program_profile"]
+    assert ev["program"] == "toy_program"
+    assert ev["hlo_sha256"] == prof["hlo_sha256"]
+
+    man = json.loads((run / "run.json").read_text())
+    (entry,) = man["programs"]["toy_program"]
+    assert entry["hlo_sha256"] == prof["hlo_sha256"]
+
+
+def test_profile_dedups_same_digest_and_keeps_recompiles(tmp_path):
+    run = tmp_path / "run"
+    f = jax.jit(lambda x: x * 2.0)
+    g = jax.jit(lambda x: x @ x.T)
+    x = jnp.ones((4, 3))
+    with obs_pkg.session(run):
+        attrib.profile_jitted(f, "boundary", x)
+        attrib.profile_jitted(f, "boundary", x)     # same program: dedup
+        attrib.profile_jitted(g, "boundary", x)     # changed program: kept
+    man = json.loads((run / "run.json").read_text())
+    entries = man["programs"]["boundary"]
+    # the SECOND distinct digest under one name is the silent-recompile
+    # signal obs explain diffs for
+    assert len(entries) == 2
+    assert entries[0]["hlo_sha256"] != entries[1]["hlo_sha256"]
+
+
+def test_profile_noop_when_disabled_or_unlowerable(tmp_path):
+    f = jax.jit(lambda x: x * 2.0)
+    assert attrib.profile_jitted(f, "off", jnp.ones(3)) is None
+    with obs_pkg.session(tmp_path / "run"):
+        # a plain python callable has no .lower: graceful skip, no event
+        assert attrib.profile_jitted(lambda x: x, "plain", 3) is None
+    assert not [e for e in _events(tmp_path / "run")
+                if e.get("name") == "program_profile"]
+
+
+def test_profile_graceful_without_cost_analysis(tmp_path, monkeypatch):
+    # a jax build whose stages lack cost/memory introspection still
+    # fingerprints — the satellite degraded-path contract
+    monkeypatch.setattr(jax_compat, "stage_cost_analysis", lambda s: None)
+    monkeypatch.setattr(jax_compat, "stage_memory_analysis", lambda s: None)
+    with obs_pkg.session(tmp_path / "run"):
+        prof = attrib.profile_jitted(jax.jit(lambda x: x + 1), "nocost",
+                                     jnp.ones(3))
+    assert prof["hlo_sha256"] and prof["cost"] is None \
+        and prof["memory"] is None
+
+
+def test_jax_compat_stage_normalization():
+    lowered = jax.jit(lambda x: jnp.sin(x) @ jnp.ones((3, 2))).lower(
+        jnp.ones((4, 3)))
+    cost = jax_compat.stage_cost_analysis(lowered)
+    assert cost and cost["flops"] > 0
+    compiled = lowered.compile()
+    # Compiled returns a list-of-dicts on 0.4.37: normalized to one flat sum
+    cost_c = jax_compat.stage_cost_analysis(compiled)
+    assert cost_c and cost_c["flops"] > 0
+    mem = jax_compat.stage_memory_analysis(compiled)
+    assert mem is None or all(isinstance(v, float) for v in mem.values())
+    assert jax_compat.stage_hlo_text(lowered)
+    assert jax_compat.stage_cost_analysis(object()) is None
+    assert jax_compat.stage_memory_analysis(object()) is None
+    assert jax_compat.stage_hlo_text(object()) is None
+
+
+# ------------------------------------------------- dispatch/compute window
+def test_flush_window_math_and_gauges(tmp_path):
+    run = tmp_path / "run"
+    with obs_pkg.session(run):
+        attrib.note_dispatch("step_a", 0.2)
+        attrib.note_dispatch("step_a", 0.1)
+        out = attrib.flush_window(1.0, steps=100)
+    assert out["calls"] == 2
+    assert out["dispatch_ms"] == pytest.approx(300.0)
+    assert out["compute_ms"] == pytest.approx(700.0)
+    assert out["dispatch_frac"] == pytest.approx(0.3)
+    gauges = {e["name"]: e for e in _events(run) if e.get("kind") == "gauge"}
+    assert gauges["attrib/dispatch_ms"]["value"] == pytest.approx(300.0)
+    assert gauges["attrib/dispatch_frac"]["value"] == pytest.approx(0.3)
+    assert gauges["attrib/dispatch_frac"]["steps"] == 100
+    assert gauges["attrib/dispatch_frac"]["step"] == "step_a"
+
+
+def test_flush_window_discards_warmup_and_clamps(tmp_path):
+    with obs_pkg.session(tmp_path / "run"):
+        attrib.note_dispatch("w", 5.0)
+        assert attrib.flush_window(1.0, warmup=True) is None   # discarded
+        assert attrib.flush_window(1.0) is None                # empty now
+        # synchronous backend: dispatch can round past the wall — clamped
+        attrib.note_dispatch("s", 1.02)
+        out = attrib.flush_window(1.0)
+    assert out["dispatch_frac"] == pytest.approx(1.0)
+    assert out["compute_ms"] == pytest.approx(0.0)
+
+
+def test_flush_window_noop_when_disabled():
+    attrib.note_dispatch("orphan", 0.5)
+    assert attrib.flush_window(1.0) is None     # no sink: swallowed
+    # and the window was drained, not leaked into the next session
+    assert attrib._WINDOW.take() == ({}, {})
+
+
+# ------------------------------------------------ integration: the drives
+def test_trainer_emits_fingerprint_and_attrib_gauges(tmp_path, dataset):
+    cfg = ExperimentConfig(model=MCFG, train=TCFG)
+    with obs_pkg.session(tmp_path / "run"):
+        GanTrainer(cfg, dataset).train()
+    events = _events(tmp_path / "run")
+    (prof,) = [e for e in events if e.get("name") == "program_profile"]
+    assert prof["program"] == "compile:multi_step"
+    assert len(prof["hlo_sha256"]) == 64
+    gauges = {e["name"] for e in events if e.get("kind") == "gauge"}
+    assert {"attrib/dispatch_ms", "attrib/compute_ms",
+            "attrib/dispatch_frac"} <= gauges
+    fracs = [e["value"] for e in events
+             if e.get("name") == "attrib/dispatch_frac"]
+    assert all(0.0 <= f <= 1.0 for f in fracs)
+    man = json.loads((tmp_path / "run" / "run.json").read_text())
+    assert "compile:multi_step" in man["programs"]
+
+
+def test_ae_chunked_drive_emits_fingerprint_and_attrib(tmp_path):
+    from hfrep_tpu.replication.engine import train_autoencoder_chunked
+    x = jnp.asarray(np.random.default_rng(3).uniform(0, 1, (40, 6)),
+                    jnp.float32)
+    cfg = AEConfig(n_factors=6, latent_dim=3, epochs=30, chunk_epochs=5,
+                   patience=2, batch_size=16)
+    with obs_pkg.session(tmp_path / "run"):
+        _, stats = train_autoencoder_chunked(jax.random.PRNGKey(2), x, cfg)
+    events = _events(tmp_path / "run")
+    profs = [e for e in events if e.get("name") == "program_profile"]
+    assert any(p["program"] == "ae_chunk:single" for p in profs)
+    if stats.chunks_dispatched > 2:
+        # middle-chunk boundaries flushed attribution (first = warmup,
+        # final boundary syncs outside the loop)
+        assert any(e.get("name") == "attrib/dispatch_frac"
+                   for e in events)
+
+
+def test_trajectory_bit_identical_with_attribution_on(tmp_path, dataset):
+    """The acceptance pin: obs-on (fingerprints + attribution) vs
+    obs-off fp32 trajectories are bit-identical, and the traced step
+    program is untouched (attribution lives entirely outside jit)."""
+    from hfrep_tpu.train.steps import make_multi_step, make_train_step
+    from hfrep_tpu.models.registry import build_gan
+
+    cfg = ExperimentConfig(model=MCFG, train=TCFG)
+    off = GanTrainer(cfg, dataset)
+    off.train()
+    with obs_pkg.session(tmp_path / "run"):
+        on = GanTrainer(cfg, dataset)
+        on.train()
+    assert len(off.history) == len(on.history)
+    for a, b in zip(off.history, on.history):
+        assert a == b                      # float equality: bit-identical
+    la = jax.tree_util.tree_leaves(off.state.g_params)
+    lb = jax.tree_util.tree_leaves(on.state.g_params)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # jaxpr pin: the step builder's traced program is identical whether
+    # or not a sink is active at build time
+    pair = build_gan(cfg.model)
+    step_off = make_multi_step(pair, cfg.train, dataset, jit=False)
+    with obs_pkg.session(tmp_path / "run2"):
+        step_on = make_multi_step(pair, cfg.train, dataset, jit=False)
+    k = jax.random.PRNGKey(0)
+    from hfrep_tpu.train.states import init_gan_state
+    st = init_gan_state(jax.random.PRNGKey(1), cfg.model, cfg.train, pair)
+    assert str(jax.make_jaxpr(step_off)(st, k)) == \
+        str(jax.make_jaxpr(step_on)(st, k))
+
+
+# ------------------------------------------------------- trace digestion
+def _write_trace(path: Path, with_device=True):
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "TPU:0" if with_device else "python"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        # while op spans its body: union must not double-count
+        {"ph": "X", "pid": 1, "tid": 2, "name": "while", "ts": 0.0,
+         "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1", "ts": 10.0,
+         "dur": 40.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "custom-call.lstm",
+         "ts": 60.0, "dur": 30.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "dot.3", "ts": 150.0,
+         "dur": 50.0},
+    ]
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+
+
+def test_interval_union_does_not_double_count():
+    events = [("while", 0.0, 100.0), ("a", 10.0, 40.0), ("b", 60.0, 30.0),
+              ("c", 150.0, 50.0)]
+    assert attrib.interval_union_s(events) == pytest.approx(150e-6)
+
+
+def test_profile_run_tables(tmp_path):
+    run = tmp_path / "run"
+    _write_trace(run / "traces" / "plugins" / "profile" / "s1"
+                 / "host.trace.json.gz")
+    doc = attrib.profile_run(run)
+    (cap,) = doc["captures"]
+    assert cap["busy_s"] == pytest.approx(150e-6)
+    ops = {r["op"]: r for r in cap["ops"]}
+    assert ops["while"]["total_s"] == pytest.approx(100e-6)
+    regions = {r["region"]: r for r in cap["regions"]}
+    assert regions["lstm"]["busy_s"] == pytest.approx(30e-6)
+    assert regions["while"]["busy_s"] == pytest.approx(100e-6)
+
+
+def test_profile_run_typed_skip_paths(tmp_path):
+    # no traces at all
+    run = tmp_path / "empty"
+    run.mkdir()
+    with pytest.raises(attrib.TraceUnavailable):
+        attrib.profile_run(run)
+    # a trace file that is not JSON
+    run2 = tmp_path / "garbage"
+    p = run2 / "traces" / "x.trace.json.gz"
+    p.parent.mkdir(parents=True)
+    p.write_bytes(b"not gzip")
+    with pytest.raises(attrib.TraceUnavailable):
+        attrib.profile_run(run2)
+    # a trace with no device pids yields zero events, not a crash
+    run3 = tmp_path / "hostonly"
+    _write_trace(run3 / "traces" / "t.trace.json.gz", with_device=False)
+    doc = attrib.profile_run(run3)
+    assert doc["captures"][0]["n_events"] == 0
+
+
+def test_profile_cli_json_purity(tmp_path, capsys):
+    run = tmp_path / "run"
+    run.mkdir()
+    assert attrib.profile_main(run, fmt="json") == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)                  # ONE pure-JSON document
+    assert "skipped" in doc
+    _write_trace(run / "traces" / "t.trace.json.gz")
+    assert attrib.profile_main(run, fmt="json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["captures"][0]["n_events"] == 4

@@ -1,0 +1,214 @@
+"""Perf microscope, read side (ISSUE 13): ``obs explain`` evidence
+extraction, ranked diagnosis, gate --explain integration, and the
+degraded paths (empty/torn streams, fingerprint-less runs, missing
+cohorts) — typed skips and notes, never crashes, pure-JSON stdout."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hfrep_tpu.obs import explain as explain_mod
+from hfrep_tpu.obs import history as hist_mod
+from hfrep_tpu.obs import report as report_mod
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FX = explain_mod.fixture_dir()
+HIST_FX = report_mod.history_fixture_dir()
+
+
+# -------------------------------------------------------------- fixture
+def test_explain_self_test_green():
+    assert explain_mod.self_test() == 0
+
+
+def test_fixture_streams_are_strict():
+    for d in (FX / "base", FX / "regressed"):
+        assert report_mod.load_events(d, strict=True)
+
+
+def test_planted_regression_diagnosis_content():
+    doc = explain_mod.explain_runs([FX / "base"], FX / "regressed")
+    assert doc["attributed"]
+    top = doc["findings"][0]
+    assert top["rank"] == 1 and top["kind"] == "program"
+    assert "compile:multi_step" in top["summary"]
+    assert "2 new HLO digest" in top["summary"]
+    by_kind = {}
+    for f in doc["findings"]:
+        by_kind.setdefault(f["kind"], []).append(f)
+    (storm,) = [f for f in by_kind["compile"]
+                if "backend_compiles" in f["summary"]]
+    assert storm["detail"]["observed"] == 9
+    assert any("dispatch_frac" in f["summary"] for f in by_kind["attrib"])
+    scores = [f["score"] for f in doc["findings"]]
+    assert scores == sorted(scores, reverse=True)
+    ranks = [f["rank"] for f in doc["findings"]]
+    assert ranks == list(range(1, len(ranks) + 1))
+
+
+def test_base_vs_base_is_silent():
+    doc = explain_mod.explain_runs([FX / "base"], FX / "base")
+    assert not any(f["kind"] in ("program", "compile", "cost", "attrib")
+                   for f in doc["findings"])
+
+
+# ------------------------------------------------------- degraded paths
+def test_empty_run_dir_yields_notes_not_crash(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    doc = explain_mod.explain_runs([empty], FX / "regressed")
+    assert any("unreadable" in n or "no events" in n for n in doc["notes"])
+    doc2 = explain_mod.explain_runs([FX / "base"], empty)
+    assert isinstance(doc2["findings"], list)
+
+
+def test_torn_stream_is_tolerated(tmp_path):
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    text = (FX / "regressed" / "events.jsonl").read_text()
+    (torn / "events.jsonl").write_text(text + '{"v": 1, "t": 9.9, "ty')
+    (torn / "run.json").write_text(
+        (FX / "regressed" / "run.json").read_text())
+    doc = explain_mod.explain_runs([FX / "base"], torn)
+    # the valid prefix still diagnoses: planted causes survive the tear
+    assert doc["attributed"]
+    assert any(f["kind"] == "program" for f in doc["findings"])
+
+
+def test_fingerprintless_runs_note_the_gap():
+    # the committed history fixture predates the microscope: no
+    # program_profile anywhere — diagnosis says so instead of guessing
+    doc = explain_mod.explain_runs([HIST_FX / "run_a"],
+                                   HIST_FX / "regressed")
+    assert any("no program fingerprints" in n for n in doc["notes"])
+    assert any(f["kind"] == "compile" for f in doc["findings"])
+
+
+def test_run_evidence_merges_manifest_and_events():
+    ev = explain_mod.run_evidence(FX / "regressed")
+    assert set(ev["programs"]) == {"compile:multi_step"}
+    assert len(ev["programs"]["compile:multi_step"]) == 2
+    assert ev["counters"]["backend_compiles"] == 9
+    assert ev["compile_spans"]["compile:multi_step"]["n"] == 2
+    # warmup blocks excluded from span aggregation
+    assert ev["spans"]["block"]["n"] == 4
+
+
+# ------------------------------------------------------ gate --explain
+def test_gate_explain_cli_exits_1_with_ranked_diagnosis():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "gate",
+         str(HIST_FX / "regressed"),
+         "--history", str(HIST_FX / "history.jsonl"), "--explain"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "REGRESSION" in proc.stdout
+    assert "obs explain" in proc.stdout
+    # at least one attributed cause named (the acceptance criterion):
+    # the committed fixture's compile-count storm
+    assert "backend_compiles 9 vs cohort median 1" in proc.stdout
+    assert " 1. [" in proc.stdout
+
+
+def test_gate_explain_json_is_one_document():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "gate",
+         str(HIST_FX / "regressed"),
+         "--history", str(HIST_FX / "history.jsonl"), "--explain",
+         "--format", "json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)          # pure JSON stdout preserved
+    assert doc["ok"] is False
+    assert doc["explain"]["attributed"] is True
+    kinds = {f["kind"] for f in doc["explain"]["findings"]}
+    assert "compile" in kinds
+
+
+def test_gate_without_explain_unchanged():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "gate",
+         str(HIST_FX / "regressed"),
+         "--history", str(HIST_FX / "history.jsonl"), "--format", "json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "explain" not in json.loads(proc.stdout)
+
+
+def test_explain_gate_failure_with_unresolvable_cohort(tmp_path):
+    # records whose run dirs exist nowhere: typed note, attributed False
+    record = hist_mod.summarize_run(HIST_FX / "regressed")
+    records = [dict(r, run_dir="/nonexistent/run_%d" % i)
+               for i, r in enumerate(hist_mod.load_history(
+                   HIST_FX / "history.jsonl"))]
+    doc = explain_mod.explain_gate_failure(
+        HIST_FX / "regressed", record, records)
+    assert doc["attributed"] is False
+    assert any("no baseline cohort" in n for n in doc["notes"])
+    assert any("not present on this machine" in n for n in doc["notes"])
+
+
+def test_resolve_run_dir_repo_relative_and_absent():
+    d = explain_mod.resolve_run_dir(
+        "hfrep_tpu/obs/_fixture/history/run_a")
+    assert d is not None and d.name == "run_a"
+    assert explain_mod.resolve_run_dir("no/such/dir") is None
+    assert explain_mod.resolve_run_dir("") is None
+
+
+# ------------------------------------------------------------ CLI forms
+def test_explain_cli_human_and_json(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "explain",
+         str(FX / "base"), str(FX / "regressed")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "1. [program]" in proc.stdout.replace("  ", " ")
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "explain",
+         str(FX / "base"), str(FX / "regressed"), "--format", "json",
+         "--top", "3"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    doc = json.loads(proc.stdout)
+    assert len(doc["findings"]) == 3
+
+
+def test_explain_cli_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "explain",
+         str(FX / "base")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_explain_history_inventory():
+    records = hist_mod.load_history(HIST_FX / "history.jsonl")
+    doc = explain_mod.history_report(records)
+    assert doc["evidence"]["records"] == len(records)
+    assert doc["evidence"]["with_backend_compiles"] == len(records)
+    assert doc["series"]["steps_per_sec"]["n"] == len(records)
+    assert doc["series"]["steps_per_sec"]["slope_per_run"] is not None
+    rendered = explain_mod.render_history_report(doc)
+    assert "steps_per_sec" in rendered
+
+
+def test_explain_history_cli_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "explain",
+         "--history", str(HIST_FX / "history.jsonl"), "--format", "json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert "evidence" in doc and "series" in doc
+
+
+def test_explain_self_test_cli_pure_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.obs", "explain", "--self-test"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["attributed"] is True

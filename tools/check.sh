@@ -26,6 +26,15 @@ python -m hfrep_tpu.obs report --self-test 1>&2
 # (strict; emits one pure-JSON result doc, routed to stderr here for the
 # same stdout-purity reason).
 python -m hfrep_tpu.obs gate --self-test 1>&2
+# perf-microscope diagnosis gate: the committed two-run explain fixture
+# (base + planted regression) must yield a ranked diagnosis naming the
+# planted causes — new HLO digests at compile:multi_step, the
+# backend_compiles storm, the dispatch_frac jump — with base-vs-base
+# staying silent.  Env-stripped like the other self-tests: an ambient
+# HFREP_OBS_DIR/HFREP_HISTORY must not leak telemetry into (or a store
+# under) a CI self-test.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
+    python -m hfrep_tpu.obs explain --self-test 1>&2
 # AE chunked-drive probe fast path: trains the early-exit fixture at tiny
 # shapes and asserts the >=2x chunked-vs-monolithic win, so the probe (and
 # the hot path it guards) can't rot.  Pinned to CPU (a self-test of the

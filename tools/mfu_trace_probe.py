@@ -1,194 +1,16 @@
-"""Profiler cross-check of the analytic MFU numbers (VERDICT r4 item 6).
-
-`tools/flops_accounting.py` derives ~20 TFLOP/s achieved from analytic
-model FLOPs x measured steps/s (XLA's cost model can't see into
-`pallas_call`, so the *numerator* must be analytic).  This probe
-cross-checks the *time* side with the XLA profiler, carefully, because
-the tunneled axon runtime is involved:
-
-1. **Calibration**: a jitted chain of K large matmuls with known FLOPs
-   is wall-timed (device_get fence, distinct inputs) and then traced;
-   trace-derived device time vs wall tells whether the trace's absolute
-   scale can be trusted through the tunnel at all.
-2. **Epoch trace**: ONE flagship train epoch under `jax.profiler.trace`;
-   the perfetto trace's TPU "XLA Ops" track is reduced to
-   *interval-union* busy time (events on the op track nest — a `while`
-   op SPANS its body's ops, so a plain sum double-counts; the union
-   doesn't), plus the summed span of the pallas LSTM custom-calls.
-3. **Reconcile**: steady epoch wall (from an untraced 50-epoch block) vs
-   trace busy time per epoch; analytic executed FLOPs / busy time =
-   device-level TFLOP/s to compare with the wall-clock-derived figure.
-
-run (chip): python tools/mfu_trace_probe.py
+"""Shim: the MFU profiler cross-check folded into the consolidated
+perf probe (ISSUE 13) — one profiling instrument on the
+``hfrep_tpu.obs.attrib`` trace/fingerprint layer instead of private
+parsing.  Kept so RESULTS.md's historical command lines keep working;
+use ``tools/perf_probe.py mfu`` directly.
 """
 
-from __future__ import annotations
-
-import argparse
-import glob
-import gzip
-import json
 import os
 import sys
-import time
-from collections import defaultdict
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-
-from hfrep_tpu.config import ModelConfig, TrainConfig
-from hfrep_tpu.models.registry import build_gan
-from hfrep_tpu.train.states import init_gan_state
-from hfrep_tpu.train.steps import make_multi_step, make_train_step
-
-# resolved via the repo-root sys.path entry above; imported at module top
-# so a broken shim fails BEFORE the expensive traced run, not after (the
-# old late `from flops_accounting import ...` also only resolved when
-# launched as `python tools/...`)
-from tools.flops_accounting import HP, epoch_flops
-
-
-def _latest_trace(log_dir: str):
-    paths = glob.glob(os.path.join(log_dir, "plugins/profile/*/*.trace.json.gz"))
-    if not paths:
-        raise SystemExit(f"no perfetto trace emitted under {log_dir} — "
-                         "this platform's profiler exported nothing; the "
-                         "cross-check cannot run here")
-    return max(paths, key=os.path.getmtime)
-
-
-def _read_ops_events(path):
-    """All complete events on TPU-pid 'XLA Ops' threads: [(name, ts, dur)]."""
-    with gzip.open(path, "rt") as f:
-        tr = json.load(f)
-    ev = tr.get("traceEvents", [])
-    pid_name, tid_name = {}, {}
-    for e in ev:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_name[e["pid"]] = e["args"].get("name", "")
-        elif e.get("ph") == "M" and e.get("name") == "thread_name":
-            tid_name[(e["pid"], e["tid"])] = e["args"].get("name", "")
-    dev_pids = {p for p, n in pid_name.items()
-                if "TPU" in n.upper() or "device" in n.lower()}
-    op_tids = {pt for pt, n in tid_name.items()
-               if pt[0] in dev_pids and "XLA Ops" in n}
-    out = []
-    for e in ev:
-        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
-            out.append((e.get("name", ""), float(e["ts"]), float(e.get("dur", 0.0))))
-    return out, sorted(set(tid_name.values()))
-
-
-def _interval_union_s(events) -> float:
-    """Union length of [ts, ts+dur) — busy time without double-counting
-    parents (`while`/fusion wrappers) that span their children."""
-    ivs = sorted((ts, ts + d) for _, ts, d in events if d > 0)
-    total, cur_a, cur_b = 0.0, None, None
-    for a, b in ivs:
-        if cur_b is None or a > cur_b:
-            if cur_b is not None:
-                total += cur_b - cur_a
-            cur_a, cur_b = a, b
-        else:
-            cur_b = max(cur_b, b)
-    if cur_b is not None:
-        total += cur_b - cur_a
-    return total * 1e-6                                   # us -> s
-
-
-def calibrate(log_dir: str, k: int = 50, n: int = 2048) -> dict:
-    """Known-FLOPs matmul chain: wall vs trace-derived device time."""
-    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
-
-    @jax.jit
-    def chain(a, b):
-        def body(c, _):
-            return (c @ b) / jnp.float32(n), None
-        out, _ = jax.lax.scan(body, a, None, length=k)
-        return out
-
-    jax.device_get(chain(a, b))                           # compile + warm
-    t0 = time.perf_counter()
-    jax.device_get(chain(a * 1.0001, b))
-    wall = time.perf_counter() - t0
-    with jax.profiler.trace(log_dir):
-        jax.device_get(chain(a * 1.0002, b))
-    events, threads = _read_ops_events(_latest_trace(log_dir))
-    busy = _interval_union_s(events)
-    flops = 2.0 * k * n ** 3
-    return {"matmul_wall_s": wall, "matmul_trace_busy_s": busy,
-            "trace_vs_wall": busy / wall if wall else None,
-            "wall_tflops": flops / wall / 1e12,
-            "trace_tflops": (flops / busy / 1e12) if busy else None,
-            "thread_names": threads}
-
-
-def epoch_trace(log_dir: str) -> dict:
-    mcfg = ModelConfig(family="mtss_wgan_gp")             # flagship (48, 35)
-    key = jax.random.PRNGKey(0)
-    dataset = jax.random.uniform(key, (512, mcfg.window, mcfg.features))
-    pair = build_gan(mcfg)
-
-    # steady wall per epoch: one untraced 50-epoch block, bench discipline
-    tcfg50 = TrainConfig(batch_size=32, steps_per_call=50)
-    state = init_gan_state(jax.random.PRNGKey(1), mcfg, tcfg50, pair)
-    multi = make_multi_step(pair, tcfg50, dataset)
-    state, m = multi(state, jax.random.PRNGKey(2))        # compile + warm
-    float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
-    t0 = time.perf_counter()
-    state, m = multi(state, jax.random.PRNGKey(3))
-    float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
-    steady_epoch_wall = (time.perf_counter() - t0) / 50
-
-    # ONE epoch traced
-    tcfg1 = TrainConfig(batch_size=32, steps_per_call=1)
-    st1 = init_gan_state(jax.random.PRNGKey(4), mcfg, tcfg1, pair)
-    step = jax.jit(make_train_step(pair, tcfg1, dataset))
-    st1, m1 = step(st1, jax.random.PRNGKey(5))            # compile + warm
-    float(jax.device_get(m1["d_loss"]))
-    with jax.profiler.trace(log_dir):
-        st1, m1 = step(st1, jax.random.PRNGKey(6))
-        float(jax.device_get(m1["d_loss"]))
-    events, _ = _read_ops_events(_latest_trace(log_dir))
-    busy = _interval_union_s(events)
-    by_op = defaultdict(float)
-    for n_, _, d in events:
-        by_op[n_] += d * 1e-6
-    # pallas kernels surface as custom-calls named after the traced fn
-    # (LSTM/stack jvp/transpose chains) — match on the module names, and
-    # union the intervals (matched events can nest, same trap as the
-    # total).
-    kern = _interval_union_s(
-        [e for e in events if "LSTM" in e[0] or "lstm" in e[0]])
-    top = sorted(by_op.items(), key=lambda kv: -kv[1])[:12]
-    return {"steady_epoch_wall_s": steady_epoch_wall,
-            "trace_busy_s": busy,
-            "busy_frac_of_steady_wall": busy / steady_epoch_wall,
-            "lstm_op_span_s": kern,
-            "lstm_share_of_busy": kern / busy if busy else None,
-            "top_ops_ms": [(n_, round(d * 1e3, 3)) for n_, d in top]}
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--log-dir", default="/tmp/mfu_trace")
-    args = ap.parse_args()
-
-    out = {"calibration": calibrate(os.path.join(args.log_dir, "cal"))}
-    ep = epoch_trace(os.path.join(args.log_dir, "epoch"))
-    ex, lo = epoch_flops(48, 35, HP), epoch_flops(48, 35, 100)
-    ep["analytic_executed_gflops"] = ex / 1e9
-    ep["analytic_model_gflops"] = lo / 1e9
-    if ep["trace_busy_s"]:
-        ep["device_tflops_executed"] = ex / ep["trace_busy_s"] / 1e12
-        ep["device_tflops_model"] = lo / ep["trace_busy_s"] / 1e12
-    ep["wall_tflops_model"] = lo / ep["steady_epoch_wall_s"] / 1e12
-    out["epoch"] = ep
-    print(json.dumps(out, indent=2))
-
+from perf_probe import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main(["mfu"] + sys.argv[1:]))

@@ -1,0 +1,243 @@
+"""The ONE on-chip profiling instrument (ISSUE 13 consolidation).
+
+``tools/mfu_trace_probe.py`` (profiler cross-check of the analytic MFU
+numbers) and ``tools/sp_profile_probe.py`` (staged fwd/grad/gp2 timing
+of the sequence-parallel gap) each grew their own trace parsing and
+timing scaffolding; both are now subcommands of this probe, built on
+the perf microscope (:mod:`hfrep_tpu.obs.attrib`): the trace-event
+parsing, interval-union busy accounting and per-op tables are the SAME
+code ``obs profile`` runs over a run dir's captured artifacts, and each
+traced program additionally lands its lowered-HLO fingerprint +
+cost_analysis in the active obs run (when ``HFREP_OBS_DIR`` is set) so
+a probe session is diffable against a training run's programs.
+
+    python tools/perf_probe.py mfu [--log-dir DIR]
+    python tools/perf_probe.py sp  [--reps 20] [--backend xla|pallas]
+
+The historical entry points keep working as thin shims
+(``tools/mfu_trace_probe.py``, ``tools/sp_profile_probe.py`` — the
+PR-6 ``bench_bf16_kernel_probe`` pattern).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hfrep_tpu.obs import attrib
+from hfrep_tpu.obs.attrib import interval_union_s, load_trace_events
+
+# module top on purpose: a broken shim must fail BEFORE an expensive
+# traced on-chip session, not after (the mfu probe's hard-won rule)
+from tools.flops_accounting import HP, epoch_flops  # noqa: E402
+
+
+def _latest_trace(log_dir: str):
+    paths = glob.glob(os.path.join(log_dir,
+                                   "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        raise SystemExit(f"no perfetto trace emitted under {log_dir} — "
+                         "this platform's profiler exported nothing; the "
+                         "cross-check cannot run here")
+    return max(paths, key=os.path.getmtime)
+
+
+# ----------------------------------------------------------------- mfu
+def calibrate(log_dir: str, k: int = 50, n: int = 2048) -> dict:
+    """Known-FLOPs matmul chain: wall vs trace-derived device time."""
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+
+    @jax.jit
+    def chain(a, b):
+        def body(c, _):
+            return (c @ b) / jnp.float32(n), None
+        out, _ = jax.lax.scan(body, a, None, length=k)
+        return out
+
+    attrib.profile_jitted(chain, "perf_probe:calibration", a, b)
+    jax.device_get(chain(a, b))                           # compile + warm
+    t0 = time.perf_counter()
+    jax.device_get(chain(a * 1.0001, b))
+    wall = time.perf_counter() - t0
+    with jax.profiler.trace(log_dir):
+        jax.device_get(chain(a * 1.0002, b))
+    events, threads = load_trace_events(_latest_trace(log_dir))
+    busy = interval_union_s(events)
+    flops = 2.0 * k * n ** 3
+    return {"matmul_wall_s": wall, "matmul_trace_busy_s": busy,
+            "trace_vs_wall": busy / wall if wall else None,
+            "wall_tflops": flops / wall / 1e12,
+            "trace_tflops": (flops / busy / 1e12) if busy else None,
+            "thread_names": threads}
+
+
+def epoch_trace(log_dir: str) -> dict:
+    """ONE flagship train epoch under the profiler, reconciled against
+    an untraced 50-epoch steady block (the bench discipline)."""
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+    from hfrep_tpu.train.steps import make_multi_step, make_train_step
+
+    mcfg = ModelConfig(family="mtss_wgan_gp")             # flagship (48, 35)
+    key = jax.random.PRNGKey(0)
+    dataset = jax.random.uniform(key, (512, mcfg.window, mcfg.features))
+    pair = build_gan(mcfg)
+
+    tcfg50 = TrainConfig(batch_size=32, steps_per_call=50)
+    state = init_gan_state(jax.random.PRNGKey(1), mcfg, tcfg50, pair)
+    multi = make_multi_step(pair, tcfg50, dataset)
+    attrib.profile_jitted(multi, "perf_probe:multi_step_50", state,
+                          jax.random.PRNGKey(2))
+    state, m = multi(state, jax.random.PRNGKey(2))        # compile + warm
+    float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
+    t0 = time.perf_counter()
+    state, m = multi(state, jax.random.PRNGKey(3))
+    float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
+    steady_epoch_wall = (time.perf_counter() - t0) / 50
+
+    tcfg1 = TrainConfig(batch_size=32, steps_per_call=1)
+    st1 = init_gan_state(jax.random.PRNGKey(4), mcfg, tcfg1, pair)
+    step = jax.jit(make_train_step(pair, tcfg1, dataset))
+    attrib.profile_jitted(step, "perf_probe:train_step", st1,
+                          jax.random.PRNGKey(5))
+    st1, m1 = step(st1, jax.random.PRNGKey(5))            # compile + warm
+    float(jax.device_get(m1["d_loss"]))
+    with jax.profiler.trace(log_dir):
+        st1, m1 = step(st1, jax.random.PRNGKey(6))
+        float(jax.device_get(m1["d_loss"]))
+    events, _ = load_trace_events(_latest_trace(log_dir))
+    busy = interval_union_s(events)
+    # pallas kernels surface as custom-calls named after the traced fn;
+    # region accounting is the shared interval-union (nested events —
+    # the same trap as the total)
+    kern = interval_union_s(
+        [e for e in events if "LSTM" in e[0] or "lstm" in e[0]])
+    top = attrib.op_table(events, top=12)
+    out = {"steady_epoch_wall_s": steady_epoch_wall,
+           "trace_busy_s": busy,
+           "busy_frac_of_steady_wall": busy / steady_epoch_wall,
+           "lstm_op_span_s": kern,
+           "lstm_share_of_busy": kern / busy if busy else None,
+           "top_ops_ms": [(r["op"], round(r["total_s"] * 1e3, 3))
+                          for r in top]}
+    ex, lo = epoch_flops(48, 35, HP), epoch_flops(48, 35, 100)
+    out["analytic_executed_gflops"] = ex / 1e9
+    out["analytic_model_gflops"] = lo / 1e9
+    if busy:
+        out["device_tflops_executed"] = ex / busy / 1e12
+        out["device_tflops_model"] = lo / busy / 1e12
+    out["wall_tflops_model"] = lo / steady_epoch_wall / 1e12
+    return out
+
+
+def mfu_main(args) -> int:
+    out = {"calibration": calibrate(os.path.join(args.log_dir, "cal"))}
+    out["epoch"] = epoch_trace(os.path.join(args.log_dir, "epoch"))
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+# ------------------------------------------------------------------ sp
+def sp_main(args) -> int:
+    """Locate where the single-device sequence-parallel step's ~100× gap
+    vs the plain step comes from (RESULTS.md honest-bounds note): fwd /
+    grad / gp2 stages, state-threaded reps inside one jitted dispatch —
+    the only trustworthy timing through the tunnel."""
+    from hfrep_tpu.config import ModelConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.parallel.mesh import make_mesh
+    from hfrep_tpu.parallel.sequence import sp_critic
+
+    reps = args.reps
+    mesh = make_mesh()
+    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=100, window=168,
+                       features=36)
+    pair = build_gan(mcfg)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.uniform(key, (32, 168, 36))
+    d_params = pair.discriminator.init(key, x)["params"]
+    be = args.backend
+
+    def plain_apply(p, xx):
+        return pair.discriminator.apply({"params": p}, xx, backend=be)
+
+    def sp_apply(p, xx):
+        return sp_critic(p, xx, mesh, backend=be)
+
+    def chain(stage, apply):
+        """One dispatch = `reps` data-dependent repetitions of `stage`."""
+        def scalar(p, xx):
+            return jnp.sum(apply(p, xx) ** 2)
+
+        if stage == "fwd":
+            unit = lambda p, xx: jnp.sum(apply(p, xx))
+        elif stage == "grad":
+            unit = lambda p, xx: sum(
+                jnp.sum(t) for t in jax.tree_util.tree_leaves(
+                    jax.grad(scalar)(p, xx)))
+        else:  # gp2: d/dp of ||grad_x scalar||² — the GP second-order shape
+            def gp(p, xx):
+                g = jax.grad(scalar, argnums=1)(p, xx)
+                return jnp.sum(g ** 2)
+            unit = lambda p, xx: sum(
+                jnp.sum(t) for t in jax.tree_util.tree_leaves(
+                    jax.grad(gp)(p, xx)))
+
+        def run(p, xx):
+            def body(c, _):
+                v = unit(p, xx + 1e-9 * c)     # data dependence across reps
+                return v.astype(jnp.float32), None
+            out, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
+            return out
+
+        return jax.jit(run)
+
+    for stage in ("fwd", "grad", "gp2"):
+        row = {}
+        for name, apply in (("plain", plain_apply), ("sp", sp_apply)):
+            f = chain(stage, apply)
+            attrib.profile_jitted(f, f"perf_probe:sp:{stage}:{name}",
+                                  d_params, x)
+            t_c0 = time.perf_counter()
+            float(f(d_params, x))                       # compile + run
+            compile_s = time.perf_counter() - t_c0
+            t0 = time.perf_counter()
+            float(f(d_params, x * 1.0001))
+            row[name] = (time.perf_counter() - t0) / reps
+            print(f"  {stage:4s} {name:5s}: {row[name]*1e3:8.2f} ms/unit "
+                  f"(compile {compile_s:.0f}s)")
+        print(f"{stage}: sp/plain = {row['sp']/row['plain']:.1f}x")
+    return 0
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/perf_probe.py",
+        description="consolidated on-chip profiling instrument "
+                    "(mfu cross-check / sp gap stages)")
+    sub = ap.add_subparsers(dest="command", required=True)
+    m = sub.add_parser("mfu", help="profiler cross-check of the analytic "
+                                   "MFU numbers (VERDICT r4 item 6)")
+    m.add_argument("--log-dir", default="/tmp/mfu_trace")
+    s = sub.add_parser("sp", help="fwd/grad/gp2 staging of the "
+                                  "sequence-parallel gap")
+    s.add_argument("--reps", type=int, default=20)
+    s.add_argument("--backend", default="xla", choices=["xla", "pallas"])
+    args = ap.parse_args(argv)
+    return {"mfu": mfu_main, "sp": sp_main}[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

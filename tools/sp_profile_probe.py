@@ -1,96 +1,15 @@
-"""Locate where the single-device sequence-parallel step's ~100× gap vs
-the plain step comes from (RESULTS.md "Sequence-parallel pallas chunks"
-honest-bounds note).
-
-Stages, each state-threaded (the only trustworthy timing through the
-tunnel — see RESULTS.md round-3 addendum) and chained `reps`× inside one
-jitted dispatch:
-
-  fwd        critic forward only
-  grad       1st-order grad of a critic scalar loss (the critic-update path)
-  gp2        grad-of-grad (the gradient-penalty second-order path)
-
-run: python tools/sp_profile_probe.py [--reps 20] [--backend xla|pallas]
+"""Shim: the sequence-parallel gap staging probe folded into the
+consolidated perf probe (ISSUE 13) — one profiling instrument on the
+``hfrep_tpu.obs.attrib`` layer.  Kept so RESULTS.md's historical
+command lines keep working; use ``tools/perf_probe.py sp`` directly.
 """
 
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-
-from hfrep_tpu.config import ModelConfig
-from hfrep_tpu.models.registry import build_gan
-from hfrep_tpu.parallel.mesh import make_mesh
-from hfrep_tpu.parallel.sequence import sp_critic
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--reps", type=int, default=20)
-    ap.add_argument("--backend", default="xla", choices=["xla", "pallas"])
-    args = ap.parse_args()
-    reps = args.reps
-
-    mesh = make_mesh()
-    mcfg = ModelConfig(family="mtss_wgan_gp", hidden=100, window=168,
-                       features=36)
-    pair = build_gan(mcfg)
-    key = jax.random.PRNGKey(0)
-    x = jax.random.uniform(key, (32, 168, 36))
-    d_params = pair.discriminator.init(key, x)["params"]
-    be = args.backend
-
-    def plain_apply(p, xx):
-        return pair.discriminator.apply({"params": p}, xx, backend=be)
-
-    def sp_apply(p, xx):
-        return sp_critic(p, xx, mesh, backend=be)
-
-    def chain(stage, apply):
-        """One dispatch = `reps` data-dependent repetitions of `stage`."""
-        def scalar(p, xx):
-            return jnp.sum(apply(p, xx) ** 2)
-
-        if stage == "fwd":
-            unit = lambda p, xx: jnp.sum(apply(p, xx))
-        elif stage == "grad":
-            unit = lambda p, xx: sum(jnp.sum(t) for t in jax.tree_util.tree_leaves(
-                jax.grad(scalar)(p, xx)))
-        else:  # gp2: d/dp of ||grad_x scalar||² — the GP second-order shape
-            def gp(p, xx):
-                g = jax.grad(scalar, argnums=1)(p, xx)
-                return jnp.sum(g ** 2)
-            unit = lambda p, xx: sum(jnp.sum(t) for t in jax.tree_util.tree_leaves(
-                jax.grad(gp)(p, xx)))
-
-        def run(p, xx):
-            def body(c, _):
-                v = unit(p, xx + 1e-9 * c)     # data dependence across reps
-                return v.astype(jnp.float32), None
-            out, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
-            return out
-
-        return jax.jit(run)
-
-    for stage in ("fwd", "grad", "gp2"):
-        row = {}
-        for name, apply in (("plain", plain_apply), ("sp", sp_apply)):
-            f = chain(stage, apply)
-            t_c0 = time.perf_counter()
-            float(f(d_params, x))                       # compile + run
-            compile_s = time.perf_counter() - t_c0
-            t0 = time.perf_counter()
-            float(f(d_params, x * 1.0001))
-            row[name] = (time.perf_counter() - t0) / reps
-            print(f"  {stage:4s} {name:5s}: {row[name]*1e3:8.2f} ms/unit "
-                  f"(compile {compile_s:.0f}s)")
-        print(f"{stage}: sp/plain = {row['sp']/row['plain']:.1f}x")
-
+from perf_probe import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main(["sp"] + sys.argv[1:]))
