@@ -16,6 +16,7 @@ from hfrep_tpu.analysis.rules.hf_atomic_writes import AtomicWriteRule
 from hfrep_tpu.analysis.rules.hf_obs_doc import ObsDocRule
 from hfrep_tpu.analysis.rules.hf_version_gate import VersionGateRule
 from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
+from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
 
 ALL_RULES = (
     HostOpsInJitRule(),
@@ -32,6 +33,7 @@ ALL_RULES = (
     ObsDocRule(),
     VersionGateRule(),
     ThreadSignalRule(),
+    ExitCodeRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -553,13 +553,20 @@ def _cmd_train_gan_impl(args) -> int:
         if path is None:
             print("no checkpoint to resume from; training from scratch")
         else:
-            # restore failures (e.g. a partial checkpoint) must propagate,
-            # not silently retrain from scratch; a corrupt newest
-            # checkpoint falls back, so report the path actually restored
-            path = trainer.restore_checkpoint(path)
-            print(f"resumed from {path} (epoch {trainer.epoch})")
-            # recovery completes the original schedule, not epochs on top
-            target = max(0, target - trainer.epoch)
+            # a corrupt newest checkpoint falls back to the previous
+            # good one (report the path ACTUALLY restored); when every
+            # candidate incl. .prev is corrupt the walk degrades to a
+            # clean fresh start (ckpt_fallback_exhausted in the obs
+            # stream) instead of wedging the resume loop forever
+            path = trainer.restore_checkpoint()
+            if path:
+                print(f"resumed from {path} (epoch {trainer.epoch})")
+                # recovery completes the original schedule, not epochs
+                # on top
+                target = max(0, target - trainer.epoch)
+            else:
+                print("no restorable checkpoint (all candidates corrupt); "
+                      "training from scratch")
     if args.profile_dir and target:
         from hfrep_tpu.obs import trace_capture
 

@@ -61,7 +61,8 @@ METRIC_FIELDS = (
 #: microscope's ``attrib/<name>`` dispatch/compute splits become
 #: first-class history metrics without the store having to know each
 #: probe's vocabulary
-GAUGE_PREFIXES = ("bench/", "serve/", "scenario/", "health/", "attrib/")
+GAUGE_PREFIXES = ("bench/", "serve/", "scenario/", "health/", "attrib/",
+                  "chaos/")
 BENCH_GAUGE_PREFIX = "bench/"          # back-compat alias
 
 #: deadline-class ladder for the serve shape signature: a 10ms-deadline

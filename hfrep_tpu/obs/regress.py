@@ -190,6 +190,24 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                              "mad_mult": 5.0},
     "attrib/dispatch_frac": {"direction": "down", "rel_tol": 0.0,
                              "abs_tol": 0.10, "mad_mult": 5.0},
+    # chaos-search gauges (hfrep_tpu/resilience/chaos.py; ISSUE 14).
+    # ``violations`` is the one that MUST be explicit: it has no cost
+    # suffix, so the higher-is-better fallback would gate (and
+    # cross-host fold) a rising violation count as an improvement —
+    # the shed_rate class, on the one gauge whose whole job is to be
+    # zero.  ``schedules``/``subjects`` are coverage floors (a soak
+    # that silently drove fewer schedules is the disarmed-gate failure
+    # mode, absolute floors — counts are exact at a fixed seed);
+    # ``run_secs`` is a cost with a generous relative floor (spawned
+    # subprocess wall clocks are host-load noisy).
+    "chaos/schedules":      {"direction": "up",   "rel_tol": 0.0,
+                             "abs_tol": 0.5, "mad_mult": 0.0},
+    "chaos/subjects":       {"direction": "up",   "rel_tol": 0.0,
+                             "abs_tol": 0.5, "mad_mult": 0.0},
+    "chaos/violations":     {"direction": "down", "rel_tol": 0.0,
+                             "abs_tol": 0.5, "mad_mult": 0.0},
+    "chaos/run_secs":       {"direction": "down", "rel_tol": 0.50,
+                             "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

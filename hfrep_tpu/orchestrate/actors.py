@@ -305,9 +305,12 @@ def actor_main(name: str, role: str, payload: dict) -> None:
                     _consumer_loop(name, payload)
                 else:
                     raise ValueError(f"unknown actor role {role!r}")
-        except resilience.Preempted:
+        except resilience.Preempted as e:
             from hfrep_tpu.obs import get_obs
+            from hfrep_tpu.obs.crash import bundle_if_enabled
             get_obs().event("actor_drained", actor=name)
+            bundle_if_enabled(e)   # drain forensics (HF007: every
+            #                        handled-drain exit-75 handler)
             # the barrier crossing: an injected stall@drain_barrier hangs
             # HERE, driving the supervisor's timeout/escalation path
             resilience.tick("drain_barrier")

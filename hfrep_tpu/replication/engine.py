@@ -506,8 +506,21 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
                                         warmup=(calls_here == 1),
                                         epoch=pos)
             if snapshot is not None:
-                snapshot.save(carry, _concat_traces(traces), pos, chunks,
-                              stopped_all)
+                try:
+                    snapshot.save(carry, _concat_traces(traces), pos,
+                                  chunks, stopped_all)
+                except OSError as e:
+                    # a chunk snapshot is a RESUME OPTIMIZATION: a
+                    # persistent write failure (an EIO burst outlasting
+                    # retry_io's bounded attempts) costs resume
+                    # granularity — the drive falls back to the last
+                    # snapshot that did land (or a fresh start), both
+                    # bit-identical by determinism — never the drive
+                    # itself.  Found by the chaos engine: the
+                    # preempt→resume leg with io_fail@snapshot_save
+                    # killed the resumed sweep with a raw OSError
+                    # (corpus entry 001).
+                    _snapshot_save_failed(snapshot, pos, e)
             try:
                 resilience.boundary("chunk")
             except resilience.Preempted as e:
@@ -539,6 +552,24 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
             padded.append(jnp.concatenate([t, fill], axis=-1))
         out = tuple(padded)
     return carry, out, pos, chunks
+
+
+def _snapshot_save_failed(snapshot, pos: int, e: OSError) -> None:
+    """Degraded-snapshot accounting: the failure is loud in telemetry
+    (event + counter) and on stderr, but the drive keeps training."""
+    import sys
+
+    from hfrep_tpu.obs import get_obs
+    try:
+        obs = get_obs()
+        obs.counter("resilience/snapshot_save_failures").inc()
+        obs.event("snapshot_save_failed", path=str(snapshot.path),
+                  epoch=pos, error=str(e))
+    except Exception:
+        pass
+    print(f"warning: chunk snapshot {snapshot.path} not saved ({e}); "
+          "resume granularity degraded, training continues",
+          file=sys.stderr)
 
 
 def _boundary_sync(carry, tr, pos: int, snapshot) -> bool:

@@ -53,6 +53,50 @@ ENV_FAULTS = "HFREP_FAULTS"
 ENV_RETRIES = "HFREP_IO_RETRIES"
 
 
+class WatchdogTimeout(RuntimeError):
+    """A watched drive overran its watchdog budget (see :func:`watchdog`)."""
+
+
+@contextlib.contextmanager
+def watchdog(secs: float, name: str):
+    """SIGALRM watchdog around a drive: raise :class:`WatchdogTimeout`
+    naming ``name`` if the body runs longer than ``secs``.
+
+    The generalization of the selftest's per-scenario timeout, shared by
+    the chaos subjects (:mod:`hfrep_tpu.resilience.chaos_subjects`) and
+    the selftest alike: any wedged drive fails loudly with its own name
+    instead of silently eating the caller's (or CI's) whole budget.
+    Nests: the previous SIGALRM handler and any pending itimer are
+    restored on exit, so an outer watchdog keeps (approximately) its
+    remaining budget.  A no-op off the main thread or on platforms
+    without SIGALRM — a degraded watchdog must not block the drive.
+    """
+    import threading
+
+    if (not hasattr(signal, "SIGALRM")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise WatchdogTimeout(
+            f"{name!r} exceeded its {secs:.0f}s watchdog budget")
+
+    prev_handler = signal.signal(signal.SIGALRM, _alarm)
+    prev_delay, _ = signal.setitimer(signal.ITIMER_REAL, secs)
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev_handler if prev_handler is not None
+                      else signal.SIG_DFL)
+        if prev_delay:
+            # hand the remainder of the outer watchdog's budget back
+            remaining = max(prev_delay - (time.monotonic() - t0), 0.001)
+            signal.setitimer(signal.ITIMER_REAL, remaining)
+
+
 class Preempted(RuntimeError):
     """Graceful preemption: a drive stopped at a safe boundary after
     persisting its state.  Callers translate this into a resumable exit

@@ -1,10 +1,108 @@
-"""``python -m hfrep_tpu.resilience`` — see selftest.py."""
+"""``python -m hfrep_tpu.resilience`` — the resilience subsystem CLI.
+
+    selftest        the scripted kill→resume / chaos-scenario gate
+                    (selftest.py; wired into tools/check.sh)
+    chaos           property-based fault-schedule search: seeded random
+                    schedules over the fault registries, driven through
+                    real subjects in subprocesses, judged by the shared
+                    invariant oracles, failures auto-shrunk to minimal
+                    HFREP_FAULTS specs; --replay-corpus replays the
+                    committed regression corpus (chaos.py)
+    chaos-subject   internal: one subject run in THIS process (the
+                    chaos driver's spawn target; exit 0 complete /
+                    75 drained)
+    explain-faults  pretty-print a parsed HFREP_FAULTS spec — kind /
+                    site / counter-group / occurrence / count / effect —
+                    so a shrunk repro line is one paste from readable
+"""
 
 from __future__ import annotations
 
+import argparse
+import json
 import sys
+import time
+from typing import List, Optional
 
-from hfrep_tpu.resilience.selftest import main
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hfrep_tpu.resilience",
+        description="fault injection + recovery subsystem CLI")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("selftest",
+                   help="drive kill→resume + corrupt→fallback end to end "
+                        "and assert bit-identical recovery (fast fixture "
+                        "shapes; wired into tools/check.sh)")
+
+    chaos_p = sub.add_parser(
+        "chaos",
+        help="seeded property-based fault-schedule search with shrinking "
+             "and corpus replay (exit 1 on any invariant violation)")
+    from hfrep_tpu.resilience.chaos import add_chaos_args
+    add_chaos_args(chaos_p)
+
+    subj_p = sub.add_parser(
+        "chaos-subject",
+        help="internal: run ONE chaos subject in this process (the "
+             "driver spawns these; HFREP_FAULTS arms the schedule)")
+    subj_p.add_argument("name")
+    subj_p.add_argument("--out", required=True)
+    subj_p.add_argument("--fixture-seed", type=int, default=0)
+    subj_p.add_argument("--resume", action="store_true")
+
+    exp_p = sub.add_parser(
+        "explain-faults",
+        help="pretty-print a parsed HFREP_FAULTS spec (unknown sites "
+             "error with the registry's nearest candidates)")
+    exp_p.add_argument("spec")
+    exp_p.add_argument("--format", choices=("human", "json"),
+                       default="human")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "selftest":
+        from hfrep_tpu.resilience.selftest import run_selftest
+        t0 = time.perf_counter()
+        try:
+            doc = run_selftest()
+        except Exception as e:
+            print(json.dumps({"selftest": "FAIL",
+                              "error": f"{type(e).__name__}: {e}"}))
+            return 1
+        doc["selftest"] = "ok"
+        doc["secs"] = round(time.perf_counter() - t0, 2)
+        print(json.dumps(doc))
+        return 0
+
+    if args.cmd == "chaos":
+        from hfrep_tpu.resilience.chaos import run_chaos
+        return run_chaos(args)
+
+    if args.cmd == "chaos-subject":
+        from hfrep_tpu.resilience.chaos_subjects import subject_main
+        return subject_main(args.name, args.out, args.fixture_seed,
+                            args.resume)
+
+    # explain-faults
+    from hfrep_tpu.resilience.faults import (
+        FaultPlan,
+        FaultSpecError,
+        plan_rows,
+        render_plan,
+    )
+    try:
+        plan = FaultPlan.parse(args.spec)
+    except FaultSpecError as e:
+        print(f"explain-faults: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({"spec": plan.spec(), "directives":
+                          plan_rows(plan)}, sort_keys=True))
+    else:
+        print(render_plan(plan))
+    return 0
+
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,436 @@
+"""Chaos subjects: the real drives the fault-schedule search exercises.
+
+A *subject* is one end-to-end drive — chunked AE sweep, GAN
+train→checkpoint→resume, serving load, walk-forward sweep, orchestrate
+pipeline — wrapped so that a run is a **pure function of
+``(fixture_seed, schedule)``**: fixed fixture data derived from the
+seed, fixed configs, every artifact written deterministically.  The
+chaos engine (:mod:`hfrep_tpu.resilience.chaos`) spawns each run as a
+fresh subprocess (``python -m hfrep_tpu.resilience chaos-subject ...``)
+with the schedule's ``HFREP_FAULTS`` spec in the environment, under a
+watchdog, and judges the wreckage with the shared oracles
+(:mod:`hfrep_tpu.resilience.chaos_oracles`).
+
+Subject contract (what :func:`subject_main` enforces):
+
+* runs under the subject's own :func:`hfrep_tpu.resilience.watchdog`
+  and a real obs session at ``<out>/obs`` (stream parseability and
+  crash-bundle presence are oracle surfaces);
+* a drain (:class:`~hfrep_tpu.resilience.Preempted`) maps to exit 75
+  through :func:`hfrep_tpu.obs.crash.bundle_if_enabled` — the repo's
+  exit-code contract (analyzer rule HF007);
+* final outputs land under ``<out>/artifacts`` through the atomic
+  writers; scratch state (checkpoints, resume snapshots, queues) under
+  ``<out>/scratch``; a completed run publishes ``chaos_result.json``
+  with its invariant counters;
+* ``deterministic=True`` subjects must produce bit-identical
+  ``artifacts/`` for any faulted-then-resumed run vs. an undisturbed
+  reference run of the same ``fixture_seed``.
+
+``hint_sites`` bias the schedule generator toward fault sites the
+subject actually crosses; the full registry stays in scope regardless
+(:func:`hfrep_tpu.resilience.chaos.generate_schedule` mixes in
+registry-wide draws, so a new fault site is automatically explored).
+
+The ``_planted`` subject is the engine's own canary: a deliberately
+buggy drive (non-atomic artifact write that SWALLOWS an injected EIO —
+the silent-drop class every real drive types or retries) that the
+search must find and the shrinker must reduce to its one-directive
+minimal spec.  It is excluded from normal soaks (leading underscore)
+and pinned by ``tests/test_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Callable, Dict, Tuple
+
+#: serving and stalls: an injected ``stall`` holds its site for
+#: ``faults.STALL_SECS`` (120s) so that supervisor escalation paths win;
+#: inside a single-process chaos subject there is no escalator, so the
+#: subject harness scope-shortens it (documented knob on STALL_SECS) —
+#: a stall becomes a bounded delay the deadline machinery must absorb,
+#: not a watchdog-eating wedge.
+SUBJECT_STALL_SECS = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class Subject:
+    """One registered chaos subject."""
+
+    name: str
+    run: Callable[[Path, int, bool], dict]
+    timeout: float                 # in-process watchdog budget, seconds
+    deterministic: bool = True     # artifacts bit-identical to reference
+    tier: str = "fast"             # "fast" = soak default; "slow" = opt-in
+    hint_sites: Tuple[str, ...] = ()
+
+
+SUBJECTS: Dict[str, Subject] = {}
+
+
+def _register(name: str, *, timeout: float, deterministic: bool = True,
+              tier: str = "fast", hint_sites: Tuple[str, ...] = ()):
+    def deco(fn):
+        SUBJECTS[name] = Subject(name=name, run=fn, timeout=timeout,
+                                 deterministic=deterministic, tier=tier,
+                                 hint_sites=hint_sites)
+        return fn
+    return deco
+
+
+def fast_subjects() -> Tuple[str, ...]:
+    """The default soak set (registration order, hidden/slow excluded)."""
+    return tuple(n for n, s in SUBJECTS.items()
+                 if s.tier == "fast" and not n.startswith("_"))
+
+
+# ------------------------------------------------------------- fixtures
+def _panel(rows: int, feats: int, fixture_seed: int, salt: int):
+    from hfrep_tpu.utils.fixture_data import scaled_panel
+    return scaled_panel(rows, feats, seed=1000 + 31 * fixture_seed + salt)
+
+
+def _write_npz_artifact(out: Path, name: str, arrays: dict) -> None:
+    """Publish ``arrays`` as ``<out>/artifacts/<name>/data.npz`` through
+    the one crash-consistent writer (``result_save``/``result`` fault
+    sites — the artifact-publication boundary of every subject)."""
+    import numpy as np
+
+    from hfrep_tpu.utils import checkpoint as ckpt
+
+    def writer(tmp: Path) -> None:
+        np.savez(tmp / "data.npz", **arrays)
+
+    ckpt.write_atomic(out / "artifacts" / name, writer,
+                      metadata={"subject": name},
+                      io_site="result_save", fault_site="result")
+
+
+def _result_arrays(res) -> dict:
+    """An AEResult (params pytree + traces) as a flat npz-ready dict."""
+    import jax
+    import numpy as np
+
+    arrays = {f"p{i}": np.asarray(leaf) for i, leaf in
+              enumerate(jax.tree_util.tree_leaves(res.params))}
+    arrays["train_loss"] = np.asarray(res.train_loss)
+    arrays["val_loss"] = np.asarray(res.val_loss)
+    arrays["stop_epoch"] = np.asarray(res.stop_epoch)
+    return arrays
+
+
+# ------------------------------------------------------------- subjects
+@_register("ae_sweep", timeout=75.0,
+           hint_sites=("chunk", "snapshot_save", "snapshot", "obs_append",
+                       "result_save", "manifest"))
+def _run_ae_sweep(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The paper's latent sweep at fixture shape, chunked with resume —
+    kill→resume must stay bit-identical (PR-5's core contract)."""
+    import jax
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication.engine import sweep_autoencoders_chunked
+
+    xs = _panel(32, 4, fixture_seed, salt=1)
+    cfg = AEConfig(n_factors=4, latent_dim=3, epochs=4, batch_size=16,
+                   patience=2, seed=fixture_seed, chunk_epochs=2)
+    res, stats = sweep_autoencoders_chunked(
+        jax.random.PRNGKey(fixture_seed), xs, cfg, [1, 2, 3],
+        resume_dir=str(out / "scratch" / "resume"))
+    _write_npz_artifact(out, "sweep", _result_arrays(res))
+    return {"chunks": int(stats.chunks_dispatched)}
+
+
+@_register("ae_multi", timeout=75.0,
+           hint_sites=("chunk", "snapshot_save", "snapshot", "result_save",
+                       "obs_append"))
+def _run_ae_multi(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The padded multi-dataset fabric (ragged rows via the mask
+    operand) under the same kill→resume contract."""
+    import jax
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication.engine import (
+        stack_padded,
+        sweep_autoencoders_multi,
+    )
+
+    a = _panel(36, 4, fixture_seed, salt=2)
+    stack, rows = stack_padded([a, a[:28]])
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
+                   patience=2, seed=fixture_seed, chunk_epochs=2)
+    res, stats = sweep_autoencoders_multi(
+        jax.random.PRNGKey(fixture_seed + 1), stack, rows, cfg, [1, 2],
+        resume_dir=str(out / "scratch" / "resume"))
+    _write_npz_artifact(out, "multi", _result_arrays(res))
+    return {"chunks": int(stats.chunks_dispatched)}
+
+
+@_register("gan_ckpt", timeout=120.0,
+           hint_sites=("block", "ckpt_save", "ckpt", "obs_append",
+                       "manifest", "result_save"))
+def _run_gan_ckpt(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """GAN train→checkpoint→resume: periodic checkpoints, drain at a
+    block boundary, restore walking past torn/corrupt checkpoints —
+    including the all-candidates-corrupt degrade-to-fresh path (which a
+    fresh deterministic retrain makes bit-identical again)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hfrep_tpu.config import ExperimentConfig, ModelConfig, TrainConfig
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    epochs = 4
+    cfg = ExperimentConfig(
+        model=ModelConfig(features=4, window=8, hidden=8, family="gan"),
+        train=TrainConfig(epochs=epochs, batch_size=4, n_critic=1,
+                          steps_per_call=2, seed=fixture_seed,
+                          checkpoint_dir=str(out / "scratch" / "ckpts"),
+                          checkpoint_every=2))
+    rng = np.random.default_rng(2000 + fixture_seed)
+    ds = jnp.asarray(rng.standard_normal((12, 8, 4)), jnp.float32)
+    tr = GanTrainer(cfg, ds)
+    if resume:
+        try:
+            path = tr.restore_checkpoint()
+        except FileNotFoundError:
+            path = ""           # nothing persisted yet: clean fresh start
+        if not path:
+            print("gan_ckpt: no restorable checkpoint, fresh start",
+                  file=sys.stderr)
+    remaining = epochs - tr.epoch
+    if remaining > 0:
+        tr.train(epochs=remaining)
+    _write_npz_artifact(out, "gan", {
+        f"g{i}": np.asarray(leaf) for i, leaf in
+        enumerate(jax.tree_util.tree_leaves(tr.state.g_params))})
+    return {"epochs": int(tr.epoch)}
+
+
+@_register("serve_load", timeout=90.0, deterministic=False,
+           hint_sites=("serve_worker", "serve_result", "batcher",
+                       "serve_drive", "obs_append"))
+def _run_serve_load(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """Serving chaos load: a real server over a really-trained tiny AE
+    head under whatever the schedule throws at it.  Not bit-identical
+    (thread timing decides sheds/deadlines) — the oracles here are the
+    ledger (terminal == submitted, zero silent drops) and the exit-code
+    contract.  A resumed leg is simply a fresh load run."""
+    import jax
+
+    from hfrep_tpu import resilience
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication.engine import train_autoencoder_chunked
+    from hfrep_tpu.serve import AEServeModel, ReplicationServer, ServeConfig
+    from hfrep_tpu.serve.loadgen import make_panels
+
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=6, batch_size=16,
+                   patience=2, seed=fixture_seed, chunk_epochs=3)
+    res, _ = train_autoencoder_chunked(
+        jax.random.PRNGKey(fixture_seed), _panel(36, 4, fixture_seed, 3),
+        cfg)
+    model = AEServeModel.create(cfg, res.params)
+    scfg = ServeConfig(max_batch=4, batch_window_ms=5.0,
+                       request_timeout_ms=2000.0, max_queue=16, workers=1,
+                       row_buckets=(16, 32), breaker_failures=2,
+                       breaker_cooldown_s=0.2, compile_storm=64)
+    server = ReplicationServer(scfg, ae_model=model).start()
+    panels = make_panels(fixture_seed + 1, 4, (12, 20), variants=3)
+    from concurrent.futures import wait
+    try:
+        with resilience.graceful_drain():
+            futs = []
+            try:
+                for burst in range(2):
+                    futs += [server.replicate(panels[i % len(panels)],
+                                              timeout_ms=2000.0)
+                             for i in range(8)]
+                    wait(futs, timeout=30)
+                    # the drive boundary: injected sigterm/preempt land
+                    # here and drain the server like the CLI would
+                    resilience.boundary("serve_drive")
+            except resilience.Preempted:
+                server.drain(reason="chaos drain", timeout=30.0)
+                wait(futs, timeout=30)
+                raise
+        wait(futs, timeout=30)
+    finally:
+        ledger = server.outcomes.as_dict()
+        server.stop()
+    return {"submitted": int(ledger["submitted"]),
+            "terminal": int(ledger["terminal"])}
+
+
+@_register("walkforward", timeout=120.0,
+           hint_sites=("chunk", "window", "snapshot_save", "snapshot",
+                       "result_save", "obs_append"))
+def _run_walkforward(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The scenario factory's walk-forward regime sweep at fixture
+    shape: chunk-snapshot training, window-granular scoring, resume
+    byte-identical."""
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.scenario.walkforward import WalkForwardSpec, run_walkforward
+    from hfrep_tpu.utils.fixture_data import universe_arrays
+
+    x, y, rf = universe_arrays(3000 + fixture_seed, funds=6, months=48,
+                               n_factors=4)
+    spec = WalkForwardSpec(start=24, n_windows=2, horizon=10, step=2)
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
+                   patience=2, seed=fixture_seed, chunk_epochs=2,
+                   ols_window=8)
+    doc = run_walkforward(x, y, rf, spec, cfg, [1, 2],
+                          out / "scratch" / "wf", resume=resume)
+    _write_npz_artifact(out, "walkforward", {
+        "surface_post": doc["surface_post"],
+        "surface_ante": doc["surface_ante"]})
+    return {"windows": int(spec.n_windows)}
+
+
+@_register("pipeline", timeout=240.0, tier="slow",
+           hint_sites=("item", "idle", "actor", "queue_put", "queue_get",
+                       "queue_item", "result", "result_save",
+                       "snapshot_save", "drain_barrier"))
+def _run_pipeline(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The async actor fabric end to end (spawned members over the spool
+    queue).  Expensive — slow tier, soaked only with a real budget; the
+    artifact digest manifest is the fabric's determinism contract."""
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec, run_pipeline
+    from hfrep_tpu.utils.checkpoint import atomic_text
+
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=6, batch_size=16,
+                   patience=2, seed=0, chunk_epochs=3)
+    plan = PipelinePlan(
+        out_dir=str(out / "scratch" / "pipe"),
+        sources=[SourceSpec(name="s0", mode="fixture",
+                            params={"rows": 32, "feats": 4})],
+        blocks=2, consumers=1, capacity=1, ae_cfg=cfg, latent_dims=[1, 2],
+        consume_mode="direct", stream_seed=10 + fixture_seed,
+        drain_timeout=60.0, timeout=180.0)
+    doc = run_pipeline(plan, resume=resume)
+    digests = {name: src["items"]
+               for name, src in doc["summary"]["sources"].items()}
+    art = out / "artifacts"
+    art.mkdir(parents=True, exist_ok=True)
+    atomic_text(art / "pipeline_digests.json",
+                json.dumps(digests, indent=2, sort_keys=True))
+    n_items = sum(len(v) for v in digests.values())
+    return {"items": n_items, "expected_items": plan.blocks,
+            "restarts": int(doc["stats"]["restarts"])}
+
+
+@_register("_planted", timeout=15.0, tier="test",
+           hint_sites=("item", "result_save"))
+def _run_planted(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The engine's canary: a drive with a DELIBERATE silent-drop bug.
+
+    It writes its one artifact with a plain non-atomic write and — the
+    planted violation — swallows an injected EIO at the publication
+    site, so ``io_fail@result_save=1`` makes the artifact silently
+    vanish while the run still exits 0.  The search must catch the
+    digest mismatch against the reference and the shrinker must reduce
+    any schedule containing that directive to the one-fault minimal
+    spec.  Kept out of real soaks; driven by ``tests/test_chaos.py``.
+    """
+    import hashlib
+
+    from hfrep_tpu import resilience
+
+    payload = hashlib.sha256(f"planted:{fixture_seed}".encode()).hexdigest()
+    with resilience.graceful_drain():
+        for _ in range(3):
+            resilience.boundary("item")
+        art = out / "artifacts" / "planted"
+        art.mkdir(parents=True, exist_ok=True)
+        try:
+            resilience.io_point("result_save")
+            (art / "result.json").write_text(
+                json.dumps({"payload": payload}))
+        except OSError:
+            pass    # the planted bug: a swallowed publish EIO = silent drop
+    return {"items": 3}
+
+
+# ------------------------------------------------------------ subprocess
+RESULT_NAME = "chaos_result.json"
+
+#: EX_IOERR — the typed exit for a persistent storage failure (an
+#: injected EIO burst outlasting the bounded retry policy at a write
+#: the drive cannot proceed without)
+EXIT_IO = 74
+
+
+def subject_main(name: str, out_dir: str, fixture_seed: int,
+                 resume: bool) -> int:
+    """The ``chaos-subject`` subprocess entry: one subject run under the
+    watchdog, obs session, and the exit-code contract (0 = complete,
+    75 = drained with state persisted, anything else = a bug the
+    oracles will flag)."""
+    import hfrep_tpu.obs as obs_pkg
+    from hfrep_tpu import resilience
+    from hfrep_tpu.resilience import faults
+
+    subject = SUBJECTS.get(name)
+    if subject is None:
+        print(f"unknown chaos subject {name!r} "
+              f"(registry: {', '.join(sorted(SUBJECTS))})", file=sys.stderr)
+        return 2
+    out = Path(out_dir)
+    for sub in ("artifacts", "scratch"):
+        (out / sub).mkdir(parents=True, exist_ok=True)
+    faults.STALL_SECS = SUBJECT_STALL_SECS
+    # NO persistent XLA compile cache here, deliberately: with the
+    # persist threshold lowered so these ms-scale programs would cache,
+    # deserialized executables on this runtime returned numerically
+    # WRONG results on cache hit (a resumed gan_ckpt leg exploded to
+    # NaN from a bit-verified healthy checkpoint — this engine's own
+    # first catch; see utils/xla_cache.py).  Subjects pay their tiny
+    # compiles fresh; correctness of the oracle surface over ~1s/run.
+    #
+    # graceful_drain wraps the WHOLE run — the obs session open
+    # included: the soak found that a SIGTERM landing during the
+    # session's first stream append (sigterm@obs_append=1, before any
+    # drive had installed its handler) killed the process raw with
+    # -15.  With the handler up front, a pre-drive SIGTERM just sets
+    # the drain flag and the drive exits 75 at its first boundary
+    # (corpus entry; the drives' own graceful_drain entries nest).
+    with resilience.graceful_drain():
+        code = 0
+        with obs_pkg.session(out / "obs", command=f"chaos:{name}",
+                             chaos={"subject": name,
+                                    "fixture_seed": fixture_seed,
+                                    "resume": resume}):
+            try:
+                with resilience.watchdog(subject.timeout,
+                                         f"chaos subject {name}"):
+                    invariants = subject.run(out, fixture_seed, resume)
+            except resilience.Preempted as e:
+                from hfrep_tpu.obs.crash import bundle_if_enabled
+                bundle_if_enabled(e)   # drain forensics, like every CLI
+                print(f"chaos subject {name}: {e}", file=sys.stderr)
+                code = 75
+            except OSError as e:
+                # persistent storage failure: an I/O error that
+                # outlasted the bounded retry policy at a REQUIRED
+                # write (artifacts, checkpoints a drive cannot proceed
+                # without).  Typed exit 74 (EX_IOERR) — never a
+                # traceback; the oracle accepts it only on attempts
+                # whose own schedule armed io_fail
+                from hfrep_tpu.obs.crash import bundle_if_enabled
+                bundle_if_enabled(e)
+                print(f"chaos subject {name}: storage failed "
+                      f"persistently: {e}", file=sys.stderr)
+                code = EXIT_IO
+        if code:
+            return code
+    from hfrep_tpu.utils.checkpoint import atomic_text
+    atomic_text(out / RESULT_NAME, json.dumps(
+        {"v": 1, "subject": name, "fixture_seed": fixture_seed,
+         "resumed": bool(resume), "invariants": invariants or {}},
+        indent=2, sort_keys=True))
+    return 0

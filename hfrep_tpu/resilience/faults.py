@@ -86,13 +86,14 @@ and the sequence of hook calls — no randomness, no wall clock.
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import errno
 import os
 import re
 import signal
 import time
 from pathlib import Path
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 BOUNDARY_KINDS = ("sigterm", "preempt", "stall")
 IO_KINDS = ("io_fail",)
@@ -167,6 +168,51 @@ class FaultSpecError(ValueError):
     """An ``HFREP_FAULTS`` spec that does not parse."""
 
 
+#: which sites each kind can actually FIRE at — the hook dispatch above,
+#: as data.  Boundary kinds fire at boundary, io and actor sites (the
+#: signal lands between chunks, mid-I/O, or at an observed item); the
+#: other kinds are hook-specific.  :meth:`FaultPlan.parse` rejects a
+#: directive outside its kind's reach: such a spec would parse, never
+#: fire, and read as "the system survived" — the silently-disarmed
+#: injection again, one level up from an unknown site.
+def kind_sites(kind: str) -> Tuple[str, ...]:
+    if kind in BOUNDARY_KINDS:
+        return BOUNDARY_SITES + IO_SITES + ACTOR_SITES
+    if kind in IO_KINDS:
+        return IO_SITES
+    if kind in POST_SAVE_KINDS:
+        return POST_SAVE_SITES
+    if kind in ACTOR_KINDS:
+        return ACTOR_SITES
+    return ()
+
+
+def site_group(site: str) -> str:
+    """The occurrence-counter group a directive at ``site`` ticks
+    against (boundary kinds at an io site count io occurrences)."""
+    if site in BOUNDARY_SITES:
+        return "boundary"
+    if site in IO_SITES:
+        return "io"
+    if site in POST_SAVE_SITES:
+        return "post_save"
+    return "actor"
+
+
+#: one-line effect summaries, keyed by kind — the ``explain-faults``
+#: CLI's rendering vocabulary (the long-form table lives in the module
+#: docstring)
+KIND_EFFECTS = {
+    "sigterm": "REAL os.kill(SIGTERM) -> graceful-drain handler",
+    "preempt": "set the drain flag directly (no signal)",
+    "stall": f"sleep STALL_SECS ({STALL_SECS:.0f}s) at the site",
+    "io_fail": "raise OSError(EIO) from that host I/O call",
+    "torn": "truncate the just-published payload to half",
+    "corrupt": "XOR-flip bytes mid-payload (bit rot)",
+    "kill": "caller SIGKILLs the actor/worker behind the occurrence",
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class Directive:
     kind: str
@@ -176,6 +222,13 @@ class Directive:
 
     def hits(self, occurrence: int) -> bool:
         return self.n <= occurrence < self.n + self.count
+
+    def spec(self) -> str:
+        """The directive back in ``HFREP_FAULTS`` grammar — the shrink
+        loop re-emits reduced plans through this, so a minimal repro is
+        always a paste-able spec."""
+        return f"{self.kind}@{self.site}={self.n}" + (
+            f"x{self.count}" if self.count != 1 else "")
 
 
 class FaultPlan:
@@ -201,16 +254,34 @@ class FaultPlan:
             if site not in KNOWN_SITES:
                 # an unknown site would parse fine and then never fire —
                 # the silently-disarmed injection the registry exists to
-                # prevent; fail the spec as loudly as an unknown kind
+                # prevent; fail the spec as loudly as an unknown kind,
+                # and name the registry's nearest candidates (a repro
+                # line with one typo should correct itself in one paste)
+                near = difflib.get_close_matches(site, KNOWN_SITES, n=3,
+                                                 cutoff=0.4)
+                hint = (f"did you mean {', '.join(near)}? " if near else "")
                 raise FaultSpecError(
-                    f"unknown fault site {site!r} (registry: "
+                    f"unknown fault site {site!r} — {hint}(registry: "
                     f"{', '.join(KNOWN_SITES)})")
+            if site not in kind_sites(kind):
+                # parses, but the dispatching hook would never match it:
+                # e.g. io_fail@chunk or torn@actor can't fire by
+                # construction — reject as loudly as an unknown site
+                raise FaultSpecError(
+                    f"{part!r}: kind {kind!r} never fires at site "
+                    f"{site!r} (valid sites: "
+                    f"{', '.join(kind_sites(kind))})")
             n = int(m.group("n"))
             if n < 1:
                 raise FaultSpecError(f"{part!r}: N is 1-based, got {n}")
             directives.append(Directive(kind=kind, site=site, n=n,
                                         count=int(m.group("count") or 1)))
         return cls(directives)
+
+    def spec(self) -> str:
+        """The plan back in ``HFREP_FAULTS`` grammar (round-trips through
+        :meth:`parse`)."""
+        return ";".join(d.spec() for d in self.directives)
 
     def _tick(self, group: str, site: str) -> int:
         key = (group, site)
@@ -324,6 +395,40 @@ def tear_file(path: Path) -> None:
     size = path.stat().st_size
     with open(path, "r+b") as f:
         f.truncate(size // 2)
+
+
+# ----------------------------------------------------------- explanation
+def plan_rows(plan: FaultPlan) -> List[dict]:
+    """One dict per directive — the machine form behind
+    ``python -m hfrep_tpu.resilience explain-faults``: kind, site, the
+    occurrence-counter group the directive ticks against, the 1-based
+    trigger occurrence, the consecutive-fire count, and the effect."""
+    return [{"kind": d.kind, "site": d.site,
+             "counter": f"({site_group(d.site)}, {d.site})",
+             "occurrence": d.n, "count": d.count,
+             "spec": d.spec(), "effect": KIND_EFFECTS.get(d.kind, "?")}
+            for d in plan.directives]
+
+
+def render_plan(plan: FaultPlan) -> str:
+    """The human table for ``explain-faults`` — a shrunk repro spec one
+    paste away from readable."""
+    rows = plan_rows(plan)
+    if not rows:
+        return "(empty plan: no directives)"
+    headers = ("kind", "site", "counter", "fires at", "count", "effect")
+    cells = [(r["kind"], r["site"], r["counter"],
+              f"occurrence {r['occurrence']}"
+              + (f"..{r['occurrence'] + r['count'] - 1}"
+                 if r["count"] > 1 else ""),
+              str(r["count"]), r["effect"]) for r in rows]
+    widths = [max(len(h), *(len(c[i]) for c in cells))
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in cells]
+    return "\n".join(lines)
 
 
 def corrupt_file(path: Path) -> None:

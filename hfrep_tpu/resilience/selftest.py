@@ -46,10 +46,11 @@ contracts hold bitwise:
    in-flight flushes, :class:`~hfrep_tpu.resilience.Preempted` at the
    next boundary → the CLI's exit 75).
 
-Every scenario runs under its own watchdog timeout
-(:func:`_scenario_timeout`, SIGALRM): one wedged scenario fails loudly
-with its name and budget instead of eating the whole ``tools/check.sh``
-time budget as a silent hang.
+Every scenario runs under its own watchdog timeout (the shared
+:func:`hfrep_tpu.resilience.watchdog`, SIGALRM — the chaos subjects use
+the same one): one wedged scenario fails loudly with its name and
+budget instead of eating the whole ``tools/check.sh`` time budget as a
+silent hang.
 
 Exit 0 with one JSON line on stdout; any violated contract raises and
 exits 1.  Wired into ``tools/check.sh`` (env-stripped, CPU-pinned) next
@@ -58,47 +59,26 @@ to the analyzer/obs/bench gates.
 
 from __future__ import annotations
 
-import argparse
 import contextlib
-import json
 import os
 import signal
 import tempfile
-import threading
 import time
 from pathlib import Path
-from typing import List, Optional
 
 import numpy as np
 
+from hfrep_tpu.resilience import WatchdogTimeout, watchdog
 
-class ScenarioTimeout(RuntimeError):
-    """A selftest scenario overran its watchdog budget."""
+#: backwards-compatible alias — the scenario watchdog is now the shared
+#: :func:`hfrep_tpu.resilience.watchdog`
+ScenarioTimeout = WatchdogTimeout
 
 
 @contextlib.contextmanager
 def _scenario_timeout(name: str, secs: float):
-    """Per-scenario watchdog: SIGALRM raises :class:`ScenarioTimeout`
-    naming the wedged scenario.  A no-op off the main thread or on
-    platforms without SIGALRM (the scenario then runs unbounded, as
-    before — a degraded watchdog must not block the gate itself)."""
-    if (not hasattr(signal, "SIGALRM")
-            or threading.current_thread() is not threading.main_thread()):
+    with watchdog(secs, f"scenario {name}"):
         yield
-        return
-
-    def _alarm(signum, frame):
-        raise ScenarioTimeout(
-            f"scenario {name!r} exceeded its {secs:.0f}s watchdog budget")
-
-    prev = signal.signal(signal.SIGALRM, _alarm)
-    signal.setitimer(signal.ITIMER_REAL, secs)
-    try:
-        yield
-    finally:
-        signal.setitimer(signal.ITIMER_REAL, 0.0)
-        signal.signal(signal.SIGALRM, prev if prev is not None
-                      else signal.SIG_DFL)
 
 
 def _fixture_panel(rows: int = 90, feats: int = 6):
@@ -595,26 +575,3 @@ def run_selftest() -> dict:
         with _scenario_timeout("serving", SCENARIO_BUDGETS["serving"]):
             doc.update(_check_serving(td))
     return doc
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m hfrep_tpu.resilience",
-        description="fault injection + recovery subsystem CLI")
-    sub = ap.add_subparsers(dest="cmd", required=True)
-    sub.add_parser("selftest",
-                   help="drive kill→resume + corrupt→fallback end to end "
-                        "and assert bit-identical recovery (fast fixture "
-                        "shapes; wired into tools/check.sh)")
-    ap.parse_args(argv)
-
-    t0 = time.perf_counter()
-    try:
-        doc = run_selftest()
-    except Exception as e:
-        print(json.dumps({"selftest": "FAIL", "error": f"{type(e).__name__}: {e}"}))
-        return 1
-    doc["selftest"] = "ok"
-    doc["secs"] = round(time.perf_counter() - t0, 2)
-    print(json.dumps(doc))
-    return 0

@@ -33,6 +33,7 @@ import dataclasses
 import json
 import os
 import shutil
+import sys
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
@@ -273,7 +274,18 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
         grid, stats, _ = _train_grid(
             key, x, spec, cfg, latent_dims,
             resume_dir=str(resume_root / "chunks"))
-        _save_grid(resume_root / TRAINED_GRID, grid, fingerprint)
+        try:
+            _save_grid(resume_root / TRAINED_GRID, grid, fingerprint)
+        except OSError as e:
+            # the persisted grid is an eval-phase resume OPTIMIZATION
+            # (an eval kill retrains without it); a persistent write
+            # failure must not kill a drive that already holds the
+            # trained grid in memory (chaos-engine finding, same class
+            # as the engine's chunk-snapshot degrade)
+            obs.event("snapshot_save_failed",
+                      path=str(resume_root / TRAINED_GRID), error=str(e))
+            print(f"warning: trained grid not persisted ({e}); an "
+                  "eval-phase kill will retrain", file=sys.stderr)
     train_secs = time.perf_counter() - t0
 
     masks = jnp.stack([latent_mask(d, cfg.latent_dim)

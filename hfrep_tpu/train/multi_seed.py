@@ -283,7 +283,12 @@ class MultiSeedTrainer:
         configured dir (corrupt ones are skipped, like the single-model
         trainer); refuses a checkpoint taken with different seeds — the
         member axis would silently mean something else.  Returns the
-        path actually restored (≠ the requested one on fallback)."""
+        path actually restored (≠ the requested one on fallback).  On
+        the dir-walking path (``path=None``), when every candidate
+        incl. ``.prev`` siblings is corrupt
+        (``ckpt_fallback_exhausted``) this returns ``""`` and the
+        ensemble starts fresh from its init state instead of wedging;
+        an explicitly requested checkpoint still raises."""
         import numpy as np
         from hfrep_tpu.utils import checkpoint as ckpt
         ckpt_dir = self.cfg.train.checkpoint_dir
@@ -299,7 +304,9 @@ class MultiSeedTrainer:
             if not ckpt_dir:
                 raise FileNotFoundError("no checkpoint found")
             restored, path = ckpt.restore_latest_good(
-                ckpt_dir, target=self._ckpt_tree())
+                ckpt_dir, target=self._ckpt_tree(), on_exhausted="fresh")
+        if restored is None:
+            return ""
         saved_seeds = tuple(int(s) for s in np.asarray(restored["seeds"]))
         if saved_seeds != tuple(int(s) for s in self.seeds):
             raise ValueError(

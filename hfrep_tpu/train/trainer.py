@@ -456,7 +456,16 @@ class GanTrainer:
         bit rot) falls back to the previous good one instead of raising
         (``utils.checkpoint.restore_latest_good``).  Returns the path
         actually restored, which on the fallback path is NOT the one
-        asked for — callers reporting "resumed from X" must use it."""
+        asked for — callers reporting "resumed from X" must use it.
+        On the dir-walking resume path (``path=None``), when *every*
+        candidate (``.prev`` siblings included) is corrupt, the walk
+        emits ``ckpt_fallback_exhausted`` and this returns ``""`` with
+        the trainer's fresh init state untouched — a resume against
+        unrecoverable storage degrades to a clean fresh start instead
+        of wedging the drive.  An *explicitly requested* checkpoint
+        that cannot be recovered still raises: the caller named state
+        it needs (a generator to serve/sample), and fresh-init params
+        silently standing in for it would be worse than the crash."""
         ckpt_dir = self.cfg.train.checkpoint_dir
         if path is not None:
             try:
@@ -470,7 +479,9 @@ class GanTrainer:
             if not ckpt_dir:
                 raise FileNotFoundError("no checkpoint found")
             restored, path = ckpt.restore_latest_good(
-                ckpt_dir, target=self._ckpt_tree())
+                ckpt_dir, target=self._ckpt_tree(), on_exhausted="fresh")
+        if restored is None:
+            return ""
         self.state = jax.tree_util.tree_map(jnp.asarray, restored["state"])
         if not isinstance(self.state, GanState):
             self.state = GanState(**{f: restored["state"][f] for f in

@@ -254,13 +254,19 @@ def save(path: str, pytree: Any, metadata: Optional[dict] = None,
     ``coordination_free=True`` writes the msgpack format directly —
     required for leader-only multi-host checkpointing of replicated
     state, where orbax's internal cross-process barrier would deadlock a
-    single-process save (the other processes never reach it).
+    single-process save (the other processes never reach it).  The env
+    knob ``HFREP_CKPT_FORMAT=msgpack`` forces the same format globally:
+    restore is format-transparent either way, and the chaos soak sets it
+    for its dozens of spawned fixture drives (an orbax save pays a ~1s
+    internal barrier per call that msgpack doesn't).
 
     ``keep > 0`` prunes all but the newest ``keep`` sibling checkpoints
     sharing this one's numbered naming scheme (``ckpt_<epoch>``).
     """
     p = Path(path).absolute()
     pytree = jax.device_get(pytree)
+    if os.environ.get("HFREP_CKPT_FORMAT", "").lower() == "msgpack":
+        coordination_free = True
 
     def writer(tmp: Path) -> dict:
         if coordination_free:
@@ -313,35 +319,78 @@ def restore(path: str, target: Any = None, verify_checksum: bool = True) -> Any:
 
 
 def restore_latest_good(dirpath: str, target: Any = None,
-                        prefix: str = "ckpt_") -> Tuple[Any, str]:
+                        prefix: str = "ckpt_",
+                        on_exhausted: str = "raise") -> Tuple[Any, str]:
     """Restore the newest checkpoint that verifies and decodes, falling
     back past torn/corrupted ones instead of crashing.
 
-    Returns ``(pytree, path)``.  Each skipped checkpoint lands in the
-    obs stream as a ``ckpt_fallback`` event (+ counter); raises
-    :class:`FileNotFoundError` when the directory holds no candidates
-    and :class:`CheckpointCorrupt` when none of them restores.
+    Returns ``(pytree, path)``.  Each candidate's parked ``.prev``
+    sibling (the overwrite window's last complete payload,
+    :func:`prev_path`) is tried right after the candidate itself, so a
+    crash mid-overwrite costs one save, never the fallback chain.  Each
+    skipped checkpoint lands in the obs stream as a ``ckpt_fallback``
+    event (+ counter); raises :class:`FileNotFoundError` when the
+    directory holds no candidates.
+
+    When *every* candidate (``.prev`` siblings included) fails:
+    ``on_exhausted="raise"`` raises :class:`CheckpointCorrupt`;
+    ``"fresh"`` emits a ``ckpt_fallback_exhausted`` event and returns
+    ``(None, "")`` — the trainers' resume paths use it to degrade to a
+    clean fresh start instead of wedging a drive on unrecoverable state
+    (the chaos engine's ``corrupt@ckpt`` composition found the raise).
     """
-    cands = _numbered(dirpath, prefix)
-    if not cands:
+    # epoch -> the paths to try at that epoch, newest first.  A crash
+    # exactly between _atomic_publish's two renames leaves ONLY the
+    # parked `.ckpt_<n>.prev` (dst renamed away, tmp never promoted) —
+    # an ORPHANED prev is still that epoch's last complete payload and
+    # must join the walk at its epoch position, or the mid-overwrite
+    # crash window the .prev mechanism exists for would silently lose
+    # the newest save to an older sibling.
+    entries = {int(p.name[len(prefix):]): [p, prev_path(p)]
+               for p in _numbered(dirpath, prefix)}
+    d = Path(dirpath)
+    if d.exists():
+        for q in d.iterdir():
+            name = q.name
+            if not (q.is_dir() and name.startswith(f".{prefix}")
+                    and name.endswith(".prev")):
+                continue
+            digits = name[len(prefix) + 1:-len(".prev")]
+            if digits.isdigit() and int(digits) not in entries:
+                entries[int(digits)] = [q]
+    if not entries:
         raise FileNotFoundError(f"no {prefix}* checkpoints under {dirpath}")
     errors: List[str] = []
-    for cand in reversed(cands):
-        try:
-            out = restore(str(cand), target)
-        except (CheckpointCorrupt, FileNotFoundError) as e:
-            errors.append(f"{cand.name}: {e}")
+    for epoch in sorted(entries, reverse=True):
+        for attempt in entries[epoch]:
+            if not attempt.exists():
+                continue
             try:
-                from hfrep_tpu.obs import get_obs
-                obs = get_obs()
-                obs.counter("resilience/ckpt_fallbacks").inc()
-                obs.event("ckpt_fallback", skipped=cand.name, error=str(e))
-            except Exception:
-                pass
-            continue
-        return out, str(cand)
-    raise CheckpointCorrupt(
-        f"no restorable checkpoint under {dirpath}: " + "; ".join(errors))
+                out = restore(str(attempt), target)
+            except (CheckpointCorrupt, FileNotFoundError) as e:
+                errors.append(f"{attempt.name}: {e}")
+                try:
+                    from hfrep_tpu.obs import get_obs
+                    obs = get_obs()
+                    obs.counter("resilience/ckpt_fallbacks").inc()
+                    obs.event("ckpt_fallback", skipped=attempt.name,
+                              error=str(e))
+                except Exception:
+                    pass
+                continue
+            return out, str(attempt)
+    detail = (f"no restorable checkpoint under {dirpath}: "
+              + "; ".join(errors))
+    if on_exhausted == "fresh":
+        try:
+            from hfrep_tpu.obs import get_obs
+            get_obs().event("ckpt_fallback_exhausted", dir=str(dirpath),
+                            candidates=len(entries),
+                            error="; ".join(errors))
+        except Exception:
+            pass
+        return None, ""
+    raise CheckpointCorrupt(detail)
 
 
 # --------------------------------------------------------------- retention

@@ -22,6 +22,16 @@ def enable_compilation_cache() -> None:
     effective on standard CPU/TPU backends.)  Disable with
     ``HFREP_COMPILATION_CACHE=''``.  Failures degrade to no cache — a
     cache is an optimization, never a blocker.
+
+    The 1.0s persist threshold is load-bearing, not a tuning nit: with
+    it lowered to 0 so the chaos subjects' ms-scale fixture programs
+    would cache, deserialized executables on this runtime (jax 0.4.37,
+    CPU) returned NUMERICALLY WRONG results on cache hit — a resumed
+    GAN fixture drive exploded to NaN from a bit-verified healthy
+    checkpoint, and a cache-hit ``jnp.max`` over an f32[8] leaf
+    returned a different leaf's value (found by the chaos engine's
+    resume-bit-identity oracle, ISSUE 14).  The chaos subjects
+    therefore run cache-free; do not lower this threshold.
     """
     cache = os.environ.get("HFREP_COMPILATION_CACHE",
                            os.path.expanduser("~/.cache/hfrep_tpu_xla"))

@@ -1282,6 +1282,110 @@ class TestSignalThreadSafety:
         assert fs == []
 
 
+# ------------------------------------------------------------------ HF007
+class TestExitCodeContract:
+    def test_positive_wrong_exit_code(self):
+        fs = run_hf("""
+            from hfrep_tpu.resilience import Preempted
+            def main():
+                try:
+                    drive()
+                except Preempted:
+                    return 1
+            """, "HF007")
+        assert codes(fs) == ["HF007"]
+        assert "75" in fs[0].message
+
+    def test_positive_exit_75_without_bundle(self):
+        fs = run_hf("""
+            import sys
+            from hfrep_tpu.resilience import Preempted
+            def main():
+                try:
+                    drive()
+                except Preempted as e:
+                    print(e, file=sys.stderr)
+                    return 75
+            """, "HF007")
+        assert codes(fs) == ["HF007"]
+        assert "bundle_if_enabled" in fs[0].message
+
+    def test_positive_sys_exit_with_module_constant(self):
+        # actors.py idiom: sys.exit(EXIT_DRAINED) resolves through the
+        # module-level int constant; a wrong constant is still a finding
+        fs = run_hf("""
+            import sys
+            from hfrep_tpu import resilience
+            EXIT_BAD = 3
+            def loop():
+                try:
+                    drive()
+                except resilience.Preempted:
+                    sys.exit(EXIT_BAD)
+            """, "HF007")
+        assert codes(fs) == ["HF007"]
+
+    def test_negative_compliant_handler(self):
+        fs = run_hf("""
+            import sys
+            from hfrep_tpu.resilience import Preempted
+            EXIT_DRAINED = 75
+            def cmd():
+                try:
+                    drive()
+                except Preempted as e:
+                    from hfrep_tpu.obs.crash import bundle_if_enabled
+                    bundle_if_enabled(e)
+                    return 75
+            def actor():
+                try:
+                    drive()
+                except Preempted as e:
+                    bundle_if_enabled(e)
+                    sys.exit(EXIT_DRAINED)
+            """, "HF007")
+        assert fs == []
+
+    def test_negative_non_exit_handlers_exempt(self):
+        # re-raise with context, loop-continue and assert handlers are
+        # not exits — the engine/selftest/resume-drill patterns
+        fs = run_hf("""
+            from hfrep_tpu import resilience
+            def drive_chunks():
+                try:
+                    step()
+                except resilience.Preempted as e:
+                    raise resilience.Preempted(site=e.site, epoch=1) from None
+            def drill():
+                try:
+                    run()
+                except resilience.Preempted:
+                    preempts = 1
+            """, "HF007")
+        assert fs == []
+
+    def test_tests_exempt_and_noqa(self):
+        src = """
+            from hfrep_tpu.resilience import Preempted
+            def f():
+                try:
+                    g()
+                except Preempted:
+                    return 1
+            """
+        assert run_hf(src, "HF007",
+                      relpath="tests/test_x_fixture.py") == []
+        fs = run_hf("""
+            from hfrep_tpu.resilience import Preempted
+            def f():
+                try:
+                    g()
+                except Preempted:
+                    return 1  # noqa: HF007
+            """, "HF007")
+        assert fs == []
+
+
 # -------------------------------------------- review-hardening regressions
 class TestReviewHardening:
     def test_hf005_not_hasattr_polarity(self):

@@ -23,10 +23,18 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 
 def test_repo_is_clean_under_static_analysis():
     # drive tools/check.sh itself so the CI tier and the developer script
-    # can never check different target lists
+    # can never check different target lists.  The chaos step runs
+    # corpus-replay-only here (min-schedules 0, budget 0 — the soak loop
+    # exits immediately): the tier-1 suite has a hard global wall clock,
+    # and the full 25-schedule soak floor is the standalone check.sh
+    # default, not this smoke's job; the committed corpus still replays
+    # green in full on every tier-1 run
+    import os
+    env = dict(os.environ, HFREP_CHAOS_MIN="0", HFREP_CHAOS_BUDGET="0")
     proc = subprocess.run(
         ["bash", str(REPO_ROOT / "tools" / "check.sh")],
-        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=540,
+        env=env,
     )
     assert proc.returncode == 0, (
         "static analysis found non-baselined violations:\n"
@@ -42,7 +50,7 @@ def test_rules_registry_announces_all_rules():
     assert proc.returncode == 0
     for rid in ("JAX001", "JAX002", "JAX003", "JAX004", "JAX005",
                 "JAX006", "HF001", "HF002", "HF003", "HF004", "HF005",
-                "HF006"):
+                "HF006", "HF007"):
         assert rid in proc.stdout
 
 

@@ -1,0 +1,253 @@
+"""Chaos search (ISSUE 14): schedule grammar, generation, oracles,
+shrinking, the committed regression corpus, and the FaultPlan
+debuggability satellites.
+
+The expensive end-to-end pin — the deliberately planted silent-drop bug
+found by the seeded search and auto-shrunk to its one-directive minimal
+spec — runs real subprocesses of the jax-free ``_planted`` subject, so
+it costs seconds, not minutes.  The real subjects' soak is exercised by
+``tools/check.sh`` (corpus replay + budgeted soak), which tier-1 drives
+through ``tests/test_analysis_self.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hfrep_tpu.resilience import faults
+from hfrep_tpu.resilience.chaos import (
+    CORPUS_DIR,
+    ChaosError,
+    Schedule,
+    corpus_entries,
+    corpus_entry_doc,
+    generate_schedule,
+    repro_line,
+    run_soak,
+)
+from hfrep_tpu.resilience.chaos_oracles import (
+    Attempt,
+    check_exit_contract,
+    check_resume_bit_identical,
+    check_zero_silent_drop,
+)
+from hfrep_tpu.resilience.chaos_subjects import SUBJECTS, fast_subjects
+from hfrep_tpu.resilience.faults import FaultPlan, FaultSpecError
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------- schedule codec
+class TestScheduleCodec:
+    def test_round_trip(self):
+        for enc in ("ae_sweep|0|sigterm@chunk=2",
+                    "gan_ckpt|3|corrupt@ckpt=1x4;preempt@block=2",
+                    "ae_multi|1|preempt@chunk=1|io_fail@snapshot_save=1x4"):
+            assert Schedule.decode(enc).encode() == enc
+
+    def test_decode_rejects_malformed(self):
+        for bad in ("nope", "s|x|sigterm@chunk=1", "s|1",
+                    "s|1|zap@chunk=1", "s|1|sigterm@chnk=1"):
+            with pytest.raises((ChaosError, FaultSpecError)):
+                Schedule.decode(bad)
+
+    def test_directives_split_legs_and_rebuild(self):
+        s = Schedule.decode("a|0|sigterm@chunk=2;torn@ckpt=1|preempt@block=1")
+        pairs = s.directives()
+        assert [leg for leg, _ in pairs] == [0, 0, 1]
+        assert Schedule.from_directives("a", 0, pairs) == s
+        assert s.n_faults() == 3
+
+
+# ---------------------------------------------------------- generation
+class TestGeneration:
+    def test_deterministic_and_registry_valid(self):
+        """The soak's schedule sequence is a pure function of its seed,
+        and every drawn directive is registry-known AND reachable by
+        its kind's hooks (a new fault site joins the draw pool with no
+        chaos-side change — the single-source-of-truth contract)."""
+        subj = SUBJECTS["ae_sweep"]
+        a = [generate_schedule(random.Random(7), subj, 2) for _ in range(1)]
+        rng1, rng2 = random.Random(123), random.Random(123)
+        seq1 = [generate_schedule(rng1, subj, 3) for _ in range(20)]
+        seq2 = [generate_schedule(rng2, subj, 3) for _ in range(20)]
+        assert [s.encode() for s in seq1] == [s.encode() for s in seq2]
+        for s in seq1 + a:
+            assert 1 <= s.n_faults() <= 4
+            for leg, d in s.directives():
+                assert leg in (0, 1)
+                assert d.site in faults.KNOWN_SITES
+                assert d.site in faults.kind_sites(d.kind)
+                assert d.n >= 1 and d.count >= 1
+            # the whole thing must survive the spec grammar round trip
+            Schedule.decode(s.encode())
+
+    def test_hint_sites_subset_of_registry(self):
+        for name, subj in SUBJECTS.items():
+            unknown = set(subj.hint_sites) - set(faults.KNOWN_SITES)
+            assert not unknown, f"{name}: hint sites {unknown} not in registry"
+
+    def test_fast_tier_has_enough_subjects(self):
+        # the check.sh gate's "across >= 4 subjects" coverage floor
+        assert len(fast_subjects()) >= 4
+        assert "_planted" not in fast_subjects()
+        assert "pipeline" not in fast_subjects()       # slow tier
+
+
+# -------------------------------------------------------------- oracles
+class TestOracles:
+    def test_exit_contract(self):
+        ok = [Attempt("sigterm@chunk=1", 75, 1.0), Attempt("", 0, 1.0)]
+        assert check_exit_contract(ok) == []
+        wedge = [Attempt("stall@chunk=1", None, 60.0)]
+        assert any("wedged" in v.detail for v in check_exit_contract(wedge))
+        bad = [Attempt("torn@ckpt=1", 1, 1.0, "boom\n")]
+        assert any("exited 1" in v.detail for v in check_exit_contract(bad))
+        tb = [Attempt("", 0, 1.0,
+                      "Traceback (most recent call last):\n...")]
+        assert any("traceback" in v.detail for v in check_exit_contract(tb))
+        stuck = [Attempt("preempt@chunk=1", 75, 1.0),
+                 Attempt("", 75, 1.0)]
+        assert any("clean (fault-free) resume" in v.detail
+                   for v in check_exit_contract(stuck))
+
+    def test_exit_74_only_with_io_fault_armed(self):
+        earned = [Attempt("io_fail@ckpt_save=1x6", 74, 1.0)]
+        assert check_exit_contract(earned) == []
+        unearned = [Attempt("sigterm@chunk=1", 74, 1.0)]
+        assert any("74" in v.detail for v in check_exit_contract(unearned))
+
+    def test_bit_identity_names_the_drift(self):
+        vs = check_resume_bit_identical(
+            {"a/x.npz": "1", "b/y.npz": "2"},
+            {"a/x.npz": "1", "b/y.npz": "3", "c/z.npz": "4"})
+        assert len(vs) == 1
+        assert "b/y.npz" in vs[0].detail and "c/z.npz" in vs[0].detail
+
+    def test_zero_silent_drop(self):
+        bad = {"invariants": {"submitted": 40, "terminal": 39}}
+        assert check_zero_silent_drop(bad)
+        assert not check_zero_silent_drop(
+            {"invariants": {"submitted": 40, "terminal": 40}})
+        assert check_zero_silent_drop(
+            {"invariants": {"items": 1, "expected_items": 2}})
+
+
+# ------------------------------------------------- planted-violation pin
+class TestPlantedViolation:
+    def test_search_finds_and_shrinks_the_planted_bug(self, tmp_path):
+        """THE acceptance pin: the seeded search over the deliberately
+        buggy ``_planted`` subject (non-atomic artifact write that
+        swallows an injected EIO — a silent drop) must find the
+        violation on its own and auto-shrink the multi-fault schedule
+        to the <= 2-fault minimal ``HFREP_FAULTS`` spec, with a
+        paste-able repro line."""
+        doc = run_soak(seed=2, budget_secs=0.0, min_schedules=1,
+                       subjects=["_planted"], fixture_seeds=1,
+                       workdir=tmp_path / "soak", replay_corpus=False)
+        assert not doc["ok"] and doc["violations"] == 1
+        (finding,) = doc["findings"]
+        assert finding["shrunk"]
+        minimal = Schedule.decode(finding["schedule"])
+        assert minimal.n_faults() <= 2
+        assert minimal.spec == "io_fail@result_save=1"
+        assert not minimal.resume_spec
+        assert finding["repro"].startswith(
+            "python -m hfrep_tpu.resilience chaos --replay ")
+        # the found minimal schedule landed as a ready-to-commit corpus
+        # entry under the workdir
+        found = list((tmp_path / "soak" / "found").glob("*.json"))
+        assert found
+        entry = json.loads(found[0].read_text())
+        for field in ("schedule", "invariant", "found_by_seed", "repro"):
+            assert field in entry
+
+    def test_replay_cli_reports_the_violation(self, tmp_path):
+        """The one-line repro really reproduces, through the real CLI."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "hfrep_tpu.resilience", "chaos",
+             "--replay", "_planted|0|io_fail@result_save=1",
+             "--out", str(tmp_path)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert not doc["ok"]
+        assert any("resume_bit_identical" in v for v in doc["violations"])
+
+
+# --------------------------------------------------------------- corpus
+class TestCorpus:
+    def test_committed_entries_are_well_formed(self):
+        entries = corpus_entries()
+        assert entries, "the regression corpus must not be empty"
+        for e in entries:
+            sched = e["_schedule"]
+            assert sched.subject in SUBJECTS, \
+                f"{e['_file']}: unknown subject {sched.subject}"
+            assert e["invariant"]
+            assert isinstance(e["found_by_seed"], int)
+            assert e["repro"] == repro_line(sched)
+            # specs in the entry match the encoded schedule
+            assert e["spec"] == sched.spec
+            assert e.get("resume_spec", "") == sched.resume_spec
+
+    def test_corpus_dir_is_the_committed_one(self):
+        assert CORPUS_DIR == (REPO_ROOT / "hfrep_tpu" / "resilience"
+                              / "_chaos_corpus")
+
+    def test_malformed_entry_fails_loudly(self, tmp_path):
+        (tmp_path / "bad.json").write_text('{"schedule": "x|0|"}')
+        with pytest.raises(ChaosError):
+            corpus_entries(tmp_path)
+
+    def test_entry_doc_round_trips(self):
+        sched = Schedule.decode("ae_sweep|0|sigterm@chunk=2")
+        doc = corpus_entry_doc(sched, "exit_contract", 7, "detail")
+        assert Schedule.decode(doc["schedule"]) == sched
+        assert doc["invariant"] == "exit_contract"
+        assert doc["found_by_seed"] == 7
+
+
+# ----------------------------------------- FaultPlan debuggability (sat)
+class TestFaultPlanDebuggability:
+    def test_spec_round_trip(self):
+        spec = "sigterm@chunk=2;io_fail@ckpt_save=1x3;torn@snapshot=2"
+        assert FaultPlan.parse(spec).spec() == spec
+
+    def test_unknown_site_names_nearest_candidates(self):
+        with pytest.raises(FaultSpecError, match="chunk"):
+            FaultPlan.parse("sigterm@chnk=1")
+
+    def test_kind_site_mismatch_rejected(self):
+        for bad in ("io_fail@chunk=1", "torn@ckpt_save=1",
+                    "kill@chunk=1", "corrupt@actor=1"):
+            with pytest.raises(FaultSpecError, match="never fires"):
+                FaultPlan.parse(bad)
+
+    def test_explain_faults_cli(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "hfrep_tpu.resilience",
+             "explain-faults", "sigterm@chunk=2;io_fail@ckpt_save=1x3",
+             "--format", "json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["spec"] == "sigterm@chunk=2;io_fail@ckpt_save=1x3"
+        rows = doc["directives"]
+        assert rows[0]["counter"] == "(boundary, chunk)"
+        assert rows[1]["counter"] == "(io, ckpt_save)"
+        assert rows[1]["count"] == 3
+
+    def test_explain_faults_cli_suggests_on_typo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "hfrep_tpu.resilience",
+             "explain-faults", "sigterm@chnk=2"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2
+        assert "chunk" in proc.stderr       # nearest candidate named

@@ -176,6 +176,99 @@ class TestCheckpoint:
         assert path.endswith("ckpt_1")
         np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
 
+    def test_fallback_tries_prev_sibling_before_older(self, tmp_path):
+        """A corrupt checkpoint whose overwrite parked a healthy
+        ``.prev`` payload must restore from the sibling, not walk all
+        the way back to an older epoch."""
+        t1 = {"w": jnp.arange(4.0)}
+        t2 = {"w": jnp.arange(4.0) * 2}
+        ckpt.save(str(tmp_path / "ckpt_1"), t1)
+        p2 = ckpt.save(str(tmp_path / "ckpt_2"), t2)
+        # overwrite ckpt_2 keeping the previous payload parked at .prev
+        ckpt.write_atomic(p2, lambda tmp: ckpt._write_msgpack(tmp, t2),
+                          keep_prev=True)
+        faults.corrupt_file(faults._payload_file(Path(p2)))
+        out, path = ckpt.restore_latest_good(str(tmp_path), target=t1)
+        assert path.endswith(".ckpt_2.prev")
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(4.0) * 2)
+
+    def test_orphaned_prev_is_a_candidate_at_its_epoch(self, tmp_path):
+        """A crash exactly BETWEEN _atomic_publish's two renames leaves
+        only the parked ``.ckpt_<n>.prev`` — the walk must restore it
+        at its epoch position (newest first), not skip to an older
+        sibling or claim the directory empty (code-review finding)."""
+        t1 = {"w": jnp.arange(4.0)}
+        t2 = {"w": jnp.arange(4.0) * 2}
+        ckpt.save(str(tmp_path / "ckpt_1"), t1)
+        p2 = Path(ckpt.save(str(tmp_path / "ckpt_2"), t2))
+        p2.rename(ckpt.prev_path(p2))      # the mid-overwrite crash shape
+        out, path = ckpt.restore_latest_good(str(tmp_path), target=t1)
+        assert path.endswith(".ckpt_2.prev")
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(4.0) * 2)
+        # orphan-only directory: still restorable, not FileNotFoundError
+        only = tmp_path / "only"
+        p = Path(ckpt.save(str(only / "ckpt_3"), t2))
+        p.rename(ckpt.prev_path(p))
+        out, path = ckpt.restore_latest_good(str(only), target=t1)
+        assert path.endswith(".ckpt_3.prev")
+
+    def test_all_candidates_corrupt_exhausts(self, tmp_path):
+        """EVERY candidate (incl. ``.prev``) corrupt: the default walk
+        raises; ``on_exhausted='fresh'`` degrades to ``(None, '')``
+        with a ``ckpt_fallback_exhausted`` event — the chaos engine's
+        ``corrupt@ckpt=1x4;preempt@block=2`` composition found the
+        raise wedging the resume loop (corpus entry 002)."""
+        t1 = {"w": jnp.arange(4.0)}
+        for i in (1, 2):
+            p = ckpt.save(str(tmp_path / f"ckpt_{i}"), t1)
+            faults.corrupt_file(faults._payload_file(Path(p)))
+        with pytest.raises(ckpt.CheckpointCorrupt, match="no restorable"):
+            ckpt.restore_latest_good(str(tmp_path), target=t1)
+        obs_dir = tmp_path / "obs"
+        with obs_pkg.session(obs_dir):
+            out, path = ckpt.restore_latest_good(
+                str(tmp_path), target=t1, on_exhausted="fresh")
+        assert out is None and path == ""
+        events = [json.loads(line) for line in
+                  (obs_dir / "events.jsonl").read_text().splitlines()]
+        names = [e.get("name") for e in events if e.get("type") == "event"]
+        assert "ckpt_fallback_exhausted" in names
+        assert names.count("ckpt_fallback") >= 2    # each skip announced
+
+    def test_trainer_resume_degrades_fresh_on_exhausted(self, tmp_path):
+        """The drive-level contract: ``GanTrainer.restore_checkpoint()``
+        over an all-corrupt dir returns ``""`` and leaves the fresh
+        init state intact (a resume against unrecoverable storage
+        starts clean instead of wedging); an EXPLICITLY requested
+        checkpoint still raises — fresh params must never silently
+        stand in for state the caller named."""
+        from hfrep_tpu.train.trainer import GanTrainer
+
+        cfg = ExperimentConfig(
+            model=ModelConfig(features=4, window=8, hidden=8,
+                              family="gan"),
+            train=TrainConfig(epochs=2, batch_size=4, n_critic=1,
+                              steps_per_call=2, seed=0,
+                              checkpoint_dir=str(tmp_path / "cks"),
+                              checkpoint_every=2))
+        rng = np.random.default_rng(9)
+        ds = jnp.asarray(rng.standard_normal((8, 8, 4)), jnp.float32)
+        tr = GanTrainer(cfg, ds)
+        tr.train(epochs=2)
+        p = tr.save_checkpoint()
+        faults.corrupt_file(faults._payload_file(Path(p)))
+        tr2 = GanTrainer(cfg, ds)
+        fresh_before = jax.tree_util.tree_leaves(tr2.state.g_params)
+        assert tr2.restore_checkpoint() == ""
+        assert tr2.epoch == 0
+        for a, b in zip(fresh_before,
+                        jax.tree_util.tree_leaves(tr2.state.g_params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        with pytest.raises(ckpt.CheckpointCorrupt):
+            tr2.restore_checkpoint(p)   # explicit path: no silent fresh
+
     def test_torn_msgpack_detected(self, tmp_path):
         tree = {"w": jnp.arange(6.0)}
         p = ckpt.save(str(tmp_path / "ckpt_1"), tree, coordination_free=True)
@@ -564,9 +657,37 @@ def test_resilience_selftest_smoke():
     assert doc["serving_breaker_trips"] >= 1
 
 
-def test_scenario_timeout_watchdog():
-    """One wedged scenario must fail loudly with its name, not eat the
-    whole check.sh budget (ISSUE 8 satellite)."""
+def test_watchdog():
+    """One wedged drive must fail loudly with its name, not eat the
+    caller's budget — the shared ``resilience.watchdog`` (ISSUE 14
+    satellite) behind both the selftest scenarios and the chaos
+    subjects."""
+    import time as _time
+
+    with res.watchdog(5.0, "fast"):
+        pass                                   # no alarm leaks...
+    with pytest.raises(res.WatchdogTimeout, match="wedged.*budget"):
+        with res.watchdog(0.2, "wedged"):
+            _time.sleep(2.0)
+    # ...and the timer is disarmed after the raise
+    _time.sleep(0.3)
+
+
+def test_watchdog_nests_restoring_outer_budget():
+    """An inner watchdog must not disarm the outer one: the selftest's
+    scenario timeouts run inside check.sh-level guards."""
+    import time as _time
+
+    with pytest.raises(res.WatchdogTimeout, match="outer"):
+        with res.watchdog(0.6, "outer"):
+            with res.watchdog(5.0, "inner"):
+                _time.sleep(0.2)               # inner passes
+            _time.sleep(2.0)                   # outer must still fire
+
+
+def test_selftest_scenario_timeout_is_the_shared_watchdog():
+    """Back-compat: the selftest's aliases point at the shared
+    implementation."""
     import time as _time
 
     from hfrep_tpu.resilience.selftest import (
@@ -574,10 +695,7 @@ def test_scenario_timeout_watchdog():
         _scenario_timeout,
     )
 
-    with _scenario_timeout("fast", 5.0):
-        pass                                   # no alarm leaks...
+    assert ScenarioTimeout is res.WatchdogTimeout
     with pytest.raises(ScenarioTimeout, match="wedged.*budget"):
         with _scenario_timeout("wedged", 0.2):
             _time.sleep(2.0)
-    # ...and the timer is disarmed after the raise
-    _time.sleep(0.3)

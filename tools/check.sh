@@ -92,3 +92,23 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH \
 # synthesis determinism.  Env-stripped + CPU-pinned like the others.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH JAX_PLATFORMS=cpu \
     python tools/bench_scenario.py --self-test 1>&2
+# chaos-search gate (ISSUE 14): replay the committed regression corpus
+# (hfrep_tpu/resilience/_chaos_corpus/ — every entry a shrunk schedule
+# that once violated an invariant, green forever at HEAD), then a
+# seeded budgeted soak of random fault schedules over the real
+# subjects (chunked AE sweep, padded multi-sweep, GAN ckpt/resume,
+# serving load, walk-forward), judged by the shared oracles
+# (exit-code contract, resume bit-identity, atomic artifacts, ledger
+# conservation, obs-stream health) with automatic shrinking of any
+# finding to a minimal HFREP_FAULTS repro.  Seeded + a deterministic
+# --min-schedules coverage floor, so the gate's verdict is
+# reproducible; the budget only lets a longer soak explore further.
+# HFREP_CHAOS_MIN/HFREP_CHAOS_BUDGET shrink the floor for callers on
+# a tight clock (tests/test_analysis_self.py runs this whole script
+# inside tier-1 and passes a small floor; the default is the full
+# 25-schedule gate).  Env-stripped + CPU-pinned like the others.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS -u HFREP_HEALTH JAX_PLATFORMS=cpu \
+    python -m hfrep_tpu.resilience chaos --seed 11 \
+    --budget-secs "${HFREP_CHAOS_BUDGET:-60}" \
+    --min-schedules "${HFREP_CHAOS_MIN:-25}" \
+    --fixture-seeds 2 --replay-corpus 1>&2
