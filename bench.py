@@ -178,13 +178,16 @@ def measure(mcfg: ModelConfig, include_rf: bool, n_calls: int,
 
 def measure_dp(n_calls: int) -> float:
     """The distributed path on real hardware: the same flagship epoch
-    through `make_dp_multi_step` (shard_map over a Mesh of the available
-    chips — dp=1 on a single-chip host, where the delta vs the plain jit
-    number is pure shard_map/collective overhead).  TWO warmups: the
-    first compile runs with unsharded inputs, the second retraces once
-    the state carries its mesh sharding."""
-    from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
-    from hfrep_tpu.parallel.mesh import make_mesh
+    through the unified partition-rule mesh launch
+    (`hfrep_tpu.parallel.rules` via `make_dp_multi_step` — pjit with the
+    batch sharding-constrained over ``dp``; on a 1-chip host the
+    program is the literal single-device program, so the delta vs the
+    plain jit number is pure launch overhead).  The gauge keeps its
+    historical ``dp_shard_map`` name so the committed `_bench_history`
+    series stays one series across the shard_map→pjit migration.  TWO
+    warmups: the first compile runs with unsharded inputs, the second
+    retraces once the state carries its mesh sharding."""
+    from hfrep_tpu.parallel import make_dp_multi_step, make_mesh
 
     mcfg = ModelConfig(family="mtss_wgan_gp")
     tcfg = TrainConfig(steps_per_call=50)
@@ -199,14 +202,18 @@ def measure_dp(n_calls: int) -> float:
 
 def measure_sp(n_calls: int) -> float:
     """The window-sharded (sequence-parallel) epoch at the production
-    shape — `make_sp_multi_step` on a 1-device ('sp',) mesh, the same
-    program a pod runs per chip.  Reported so the sp tax vs the plain
-    prod number is regression-tracked in the bench artifact (RESULTS.md
-    'Sequence-parallel pallas chunks': 7.5 vs 6.0 ms/epoch)."""
+    shape — `make_sp_multi_step` on a 1-device ('sp',) mesh through the
+    unified mesh launch.  Under pjit a 1-device sp mesh runs the
+    LITERAL single-device program (sharding constraints no-op at size
+    1), so the old manual-pipeline "sp tax" (134 vs ~167 steps/s,
+    RESULTS.md) disappears by construction — expect this series to step
+    UP to ~prod level at the migration round (improvements never fail
+    the gate; the drift tracker flags the step as the discontinuity it
+    is)."""
     import numpy as np
     from jax.sharding import Mesh
 
-    from hfrep_tpu.parallel.sequence import make_sp_multi_step
+    from hfrep_tpu.parallel import make_sp_multi_step
 
     mcfg = ModelConfig(family="mtss_wgan_gp", window=168, features=36)
     tcfg = TrainConfig(steps_per_call=50)
@@ -267,12 +274,19 @@ def _main_measured(obs_dir) -> None:
             # never written (the JSON line survives the tooling failure)
             obs_degraded = True
             obs_dir = None
+        # the `mesh` CONFIG section documents the unified-launch layout
+        # of the dp/sp probes; deliberately under config (the top-level
+        # manifest `mesh` key is part of the history comparability key,
+        # and the committed series must stay continuous across the
+        # shard_map→pjit migration)
+        from hfrep_tpu.parallel.rules import MeshSpec
         obs.annotate(config={
             "model": {"family": mcfg.family, "window": mcfg.window,
                       "features": mcfg.features, "hidden": mcfg.hidden,
                       "dtype": mcfg.dtype, "param_dtype": mcfg.param_dtype},
             "train": {"batch_size": tcfg.batch_size,
-                      "steps_per_call": tcfg.steps_per_call}})
+                      "steps_per_call": tcfg.steps_per_call},
+            "mesh": MeshSpec(dp=len(jax.devices())).describe()})
         rc = _bench(obs, mcfg, tcfg)
     # Perf-regression sentinel: gate this run against the rolling
     # median/MAD baseline of comparable past runs, then ingest it on
@@ -354,6 +368,7 @@ def _bench(obs, mcfg: ModelConfig, tcfg: TrainConfig) -> int:
         "dp_shard_map_steps_per_sec": dp,
         "sp_prod_steps_per_sec": sp,
         "dp_devices": len(jax.devices()),
+        "mesh_unified": True,
     }))
 
     # The same numbers as gauges: the bench/ prefix makes them
@@ -371,6 +386,14 @@ def _bench(obs, mcfg: ModelConfig, tcfg: TrainConfig) -> int:
         # that quietly stops paying (or starts hurting) shows up as this
         # ratio drifting below 1.0, independent of host-speed noise
         obs.gauge("bench/bf16_headline_speedup").set(float(steps / f32))
+    # structural marker: 1.0 from the round the dp/sp probes launch
+    # through the unified partition-rule mesh path (ROADMAP item 1).
+    # The gate's absolute floor flags a run that sets it BELOW 1.0; a
+    # rollback that deletes this line entirely is NOT gate-caught
+    # (regress treats a missing metric as not-measured, deliberately) —
+    # absence shows up in the committed series diff and in HF001's
+    # gauge inventory, not as a gate failure
+    obs.gauge("bench/mesh_unified").set(1.0)
     obs.memory_snapshot(phase="bench_end")
 
     # Regression floors (RESULTS.md §bench-gate): fail loudly on silent

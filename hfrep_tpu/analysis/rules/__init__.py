@@ -17,6 +17,7 @@ from hfrep_tpu.analysis.rules.hf_obs_doc import ObsDocRule
 from hfrep_tpu.analysis.rules.hf_version_gate import VersionGateRule
 from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
 from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
+from hfrep_tpu.analysis.rules.hf_mesh_launch import MeshLaunchRule
 
 ALL_RULES = (
     HostOpsInJitRule(),
@@ -34,6 +35,7 @@ ALL_RULES = (
     VersionGateRule(),
     ThreadSignalRule(),
     ExitCodeRule(),
+    MeshLaunchRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,129 @@
+"""HF008 — single-mesh-API launch discipline.
+
+ISSUE 15 replaced seven hand-built ``shard_map`` launch paths with the
+one partition-rule-driven ``NamedSharding``/``pjit`` API
+(:mod:`hfrep_tpu.parallel.rules.mesh_launch`).  The refactor only stays
+done if no NEW manual SPMD region grows outside the sanctioned package:
+a fresh ``shard_map(...)`` / ``jax.pmap(...)`` launch in a feature
+module re-creates exactly the per-path plumbing (per-device sampling,
+replication proofs, version-gated APIs) the migration deleted — and on
+the pinned runtime (jax 0.4.37, no ``jax.shard_map``) it is dead code
+from the day it lands.
+
+Flagged: any CALL of ``shard_map`` or ``pmap`` — by bare name when the
+file imports it from a jax module or the compat gates, as a dotted
+``jax.*``/``jax.experimental.shard_map.*`` reference, or qualified
+through a module alias (``from jax.experimental import shard_map`` →
+``shard_map.shard_map(...)``, ``import jax.experimental.shard_map as
+sm`` → ``sm.shard_map(...)``, ``from hfrep_tpu.parallel import
+_compat`` → ``_compat.shard_map(...)``) — outside the allowlist:
+
+* ``hfrep_tpu/parallel/`` — the mesh API's home, including
+  ``layer_pipeline.py`` (the one schedule pjit cannot express: GPipe
+  stage masking with per-superstep ppermutes) and the ``_compat`` gate;
+* ``hfrep_tpu/utils/jax_compat.py`` — the gate's definition site.
+
+Tests are exempt (fixtures exercise the rule itself); references
+without a call (e.g. the HF005 registry's strings, ``HAS_SHARD_MAP``
+feature probes) are not launches and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hfrep_tpu.analysis.engine import FileContext, Finding
+from hfrep_tpu.analysis.rules.base import Rule, dotted_name
+
+#: repo-relative posix prefixes/files where manual SPMD launches remain
+#: sanctioned
+ALLOWED_PATHS = (
+    "hfrep_tpu/parallel/",
+    "hfrep_tpu/utils/jax_compat.py",
+)
+
+#: modules whose ``shard_map``/``pmap`` member is a launch constructor
+_LAUNCH_MODULES = (
+    "jax",
+    "jax.experimental.shard_map",
+    "jax.experimental",
+    "hfrep_tpu.parallel._compat",
+    "hfrep_tpu.utils.jax_compat",
+)
+
+_LAUNCH_NAMES = {"shard_map", "pmap"}
+
+
+def _launch_aliases(tree: ast.AST) -> Set[str]:
+    """Bare names this file binds to a shard_map/pmap constructor via
+    ``from <launch module> import shard_map [as sm]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in _LAUNCH_MODULES:
+            for a in node.names:
+                if a.name in _LAUNCH_NAMES:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _module_aliases(tree: ast.AST) -> Set[str]:
+    """Bare names this file binds to a MODULE that exports a launch
+    constructor — ``<alias>.shard_map(...)`` is the same launch as the
+    bare form: ``from jax.experimental import shard_map`` (the module),
+    ``import jax.experimental.shard_map as sm``, ``from hfrep_tpu.parallel
+    import _compat``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _LAUNCH_MODULES and a.asname is not None:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module is not None:
+            for a in node.names:
+                if f"{node.module}.{a.name}" in _LAUNCH_MODULES:
+                    out.add(a.asname or a.name)
+    return out
+
+
+class MeshLaunchRule(Rule):
+    id = "HF008"
+    name = "single-mesh-api"
+    description = ("manual shard_map/pmap launch construction outside "
+                   "hfrep_tpu/parallel/ — use the partition-rule mesh "
+                   "API (parallel/rules.py mesh_launch) instead")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        from hfrep_tpu.analysis.project import _is_test_path
+
+        relpath = ctx.relpath.replace("\\", "/")
+        if _is_test_path(relpath):
+            return []
+        if any(relpath == p or relpath.startswith(p) for p in ALLOWED_PATHS):
+            return []
+        aliases = _launch_aliases(ctx.tree)
+        mod_aliases = _module_aliases(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            head, _, tail = name.rpartition(".")
+            launch = None
+            if not head and name in aliases:
+                launch = name
+            elif tail in _LAUNCH_NAMES and (head.split(".")[0] == "jax"
+                                            or head in mod_aliases):
+                launch = name
+            if launch is None:
+                continue
+            findings.append(ctx.finding(
+                "HF008", node,
+                f"direct {launch}(...) launch outside hfrep_tpu/parallel/: "
+                "the single-mesh-API discipline (ISSUE 15) routes every "
+                "multi-device launch through parallel/rules.py "
+                "mesh_launch — partition rules + pjit, alive on every "
+                "jax version"))
+        return findings

@@ -87,14 +87,13 @@ class TrainConfig:
                                    # identical to the alternating form
                                    # (pinned); n_critic > 1 keeps the loop
                                    # (the carry chain is inherently serial)
-    sp_remat: bool = False         # rematerialize each sp superstep in the
-                                   # backward pass (jax.checkpoint around the
-                                   # pipeline's scan body): trades recompute
-                                   # for O(W)-residual memory on the xla-scan
-                                   # backend — the same strategy the pallas
-                                   # kernels' adjoints use natively.  For
-                                   # long-window training near the HBM wall
-                                   # (RESULTS.md sp capacity study).
+    sp_remat: bool = False         # RETIRED (ISSUE 15): rematerialized each
+                                   # superstep of the MANUAL sp pipeline for
+                                   # O(W)-residual memory near the HBM wall
+                                   # (RESULTS.md sp capacity study); the
+                                   # unified mesh launch has no superstep and
+                                   # IGNORES it — long-window memory control
+                                   # under GSPMD is a ROADMAP follow-on
 
 
 @dataclasses.dataclass(frozen=True)

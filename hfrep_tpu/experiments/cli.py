@@ -72,17 +72,22 @@ def _build_parser() -> argparse.ArgumentParser:
                         "over sp, hidden units over tp in one step "
                         "(parallel/dp_sp_tp.py)")
     t.add_argument("--sp-remat", action="store_true",
-                   help="rematerialize the sp pipeline's backward in time "
-                        "blocks (TrainConfig.sp_remat): O(W)-residual "
-                        "memory for long-window runs near the HBM wall, "
-                        "identical trajectory (RESULTS.md sp capacity "
-                        "study).  --sp-mesh / --dp-sp only")
+                   help="RETIRED knob, accepted for compatibility: the "
+                        "superstep schedule it rematerialized went with "
+                        "the manual sp pipeline (ISSUE 15 mesh refactor) "
+                        "— the unified launch traces the plain scan and "
+                        "IGNORES this flag.  Long-window memory control "
+                        "under GSPMD is an open ROADMAP follow-on "
+                        "(RESULTS.md sp capacity study documents the "
+                        "retired mechanism).  --sp-mesh / --dp-sp only")
     t.add_argument("--sp-microbatches", type=int, default=None, metavar="M",
-                   help="pipeline microbatch count for the window-sharded "
-                        "paths (--sp-mesh/--dp-sp/--dp-sp-tp); default: the "
-                        "sp axis size.  The measured recommendation at "
-                        "shipped shapes is 1 (latency-bound regime — "
-                        "parallel/sequence.py::sp_microbatch_plan)")
+                   help="RETIRED knob, accepted for compatibility: the "
+                        "unified mesh launch (parallel/rules.py) has no "
+                        "pipeline schedule to tune — GSPMD lays out the "
+                        "window-sharded step itself.  Validated, threaded "
+                        "to TrainConfig, ignored by the step builders "
+                        "(parallel/sequence.py::sp_microbatch_plan keeps "
+                        "the analytic model the retired schedule anchored)")
     t.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port — every "
                         "process runs this same command with its own "

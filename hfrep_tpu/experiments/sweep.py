@@ -104,7 +104,8 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
               latent_dims: Sequence[int] = tuple(range(1, 22)),
               key: Optional[jax.Array] = None,
               strategy_names: Optional[Sequence[str]] = None,
-              resume_dir: Optional[str] = None) -> SweepResult:
+              resume_dir: Optional[str] = None,
+              mesh=None) -> SweepResult:
     """Train all latent dims in one vmapped program, then evaluate each.
 
     ``x_train``/``y_train`` may be GAN-augmented (synthetic rows stacked
@@ -118,6 +119,11 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
     same arguments resumes from the last chunk bit-identically.  Only
     meaningful on the chunked path — the monolithic single-scan drive
     (``cfg.chunk_epochs == 0``) has no safe boundary to resume from.
+
+    ``mesh`` (a ``('dp',)`` mesh; ``hfrep_tpu.parallel.rules.lane_mesh``
+    picks a divisor of L) shards the latent-lane axis over ``dp``
+    through the unified pjit launch — bit-identical results (pinned).
+    Chunked drive only, like ``resume_dir``.
     """
     cfg = cfg or AEConfig()
     key = key if key is not None else jax.random.PRNGKey(cfg.seed)
@@ -136,9 +142,13 @@ def run_sweep(x_train, y_train, x_test, y_test, rf_test, factor_full,
         # the monolithic scan (pinned by test), minus the dead epochs
         swept, stats = sweep_autoencoders_chunked(key, engine.x_train, cfg,
                                                   latent_dims,
-                                                  resume_dir=resume_dir)
+                                                  resume_dir=resume_dir,
+                                                  mesh=mesh)
         emit_chunk_stats(stats)
     else:
+        if mesh is not None:
+            raise ValueError("mesh requires the chunked drive "
+                             "(cfg.chunk_epochs > 0)")
         swept = sweep_autoencoders(key, engine.x_train, cfg, latent_dims)
 
     # One compiled program evaluates every latent dim (IS/OOS metrics,
@@ -221,11 +231,12 @@ def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
     per dataset on the *unpadded* panels, one compiled program per
     distinct row count.
 
-    ``mesh``: an optional ``('dp', ...)`` Mesh — the stacked cube is
-    ``device_put`` with the dataset axis sharded over ``dp`` and the
-    jitted chunk program follows its operand shardings (the row-count
-    vector stays host-derived: the engine reads it back to compute the
-    exact validation boundaries anyway).
+    ``mesh``: an optional ``('dp',)`` Mesh — the whole (K+1)×L lane
+    grid launches through the unified pjit path
+    (:mod:`hfrep_tpu.parallel.rules`) with the dataset axis sharded
+    over ``dp``: the stacked cube, per-dataset keys and row counts are
+    placed once by the shard fns and every chunk dispatch runs
+    multi-device, bit-identical to the meshless drive (pinned).
 
     ``resume_dir``: chunk-boundary snapshots + resume for the fused
     (K+1)×L program, same contract as :func:`run_sweep` — a killed
@@ -247,13 +258,10 @@ def run_sweep_multi(datasets, x_test, y_test, rf_test, factor_full,
     engines = [ReplicationEngine(x, y, x_test, y_test, cfg)
                for x, y in datasets]
     x_stack, n_rows = stack_padded([e.x_train for e in engines])
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-        x_stack = jax.device_put(
-            x_stack, NamedSharding(mesh, PartitionSpec("dp")))
     swept, stats = sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
                                             latent_dims,
-                                            resume_dir=resume_dir)
+                                            resume_dir=resume_dir,
+                                            mesh=mesh)
     emit_chunk_stats(stats)
 
     results = [

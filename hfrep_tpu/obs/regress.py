@@ -88,6 +88,14 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                          "mad_mult": 5.0},
     "bench/bf16_headline_speedup":      {"direction": "up", "rel_tol": 0.05,
                                          "mad_mult": 5.0},
+    # structural marker (ISSUE 15): 1.0 while the dp/sp probes launch
+    # through the unified partition-rule mesh path — identical run to
+    # run, so the absolute floor flags a run that REPORTS a lower
+    # value.  (A rollback that stops emitting the gauge reads as
+    # not-measured and passes — missing metrics are never failures by
+    # design; the committed series diff is the absence tripwire.)
+    "bench/mesh_unified":               {"direction": "up", "rel_tol": 0.0,
+                                         "abs_tol": 0.5, "mad_mult": 0.0},
     # tools/bench_ae.py (chunked early-exit + multi-dataset fabric)
     "bench/ae_chunk_speedup":   {"direction": "up",   "rel_tol": 0.15,
                                  "mad_mult": 5.0},

@@ -8,6 +8,25 @@ from hfrep_tpu.parallel.mesh import (  # noqa: F401
     replicate_to_global,
     spans_processes,
 )
+# The unified partition-rule-driven mesh API (ROADMAP item 1) — the one
+# launch path every consumer dispatches through.
+from hfrep_tpu.parallel.rules import (  # noqa: F401
+    AE_LANE_RULES,
+    AE_LANE_SPEC,
+    GAN_PARTITION_RULES,
+    MeshSpec,
+    build_mesh,
+    data_constraint,
+    lane_mesh,
+    make_gan_multi_step,
+    make_gan_train_step,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    mesh_launch,
+    mesh_spec,
+    shard_put,
+)
+# Historical per-axis entry points, now thin shims over the rules API.
 from hfrep_tpu.parallel.data_parallel import make_dp_multi_step  # noqa: F401
 from hfrep_tpu.parallel.dp_sp import (  # noqa: F401
     make_dp_sp_multi_step,

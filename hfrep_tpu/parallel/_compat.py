@@ -1,10 +1,12 @@
 """The parallel-package face of the version-gated JAX API gate.
 
-The real gate lives in :mod:`hfrep_tpu.utils.jax_compat` (utils has no
-eager package ``__init__``, so ``train/steps.py`` can import it without
-cycling through ``hfrep_tpu.parallel``'s submodule re-exports).  The
-launch-path modules and tests import from here — the parallel package
-is where the gated APIs are consumed.
+The real gate lives in :mod:`hfrep_tpu.utils.jax_compat`.  Since the
+partition-rule mesh refactor (ISSUE 15) the ONLY consumer of the
+``shard_map`` gate is :mod:`hfrep_tpu.parallel.layer_pipeline` — the
+one manual schedule pjit cannot express — plus the tools/tests that
+probe ``HAS_SHARD_MAP`` to skip it gracefully.  Everything else
+launches through :mod:`hfrep_tpu.parallel.rules`, which needs no gate
+(pjit exists on every supported jax).
 """
 
 from __future__ import annotations
@@ -12,6 +14,5 @@ from __future__ import annotations
 from hfrep_tpu.utils.jax_compat import (  # noqa: F401
     HAS_SHARD_MAP,
     ShardMapUnavailable,
-    axis_size,
     shard_map,
 )

@@ -1,104 +1,29 @@
-"""Data-parallel GAN training over a 1-D mesh via `shard_map`.
+"""Data-parallel GAN training — thin shim over the unified mesh launch.
 
-Design (SURVEY §5.8): the global batch (reference: 32,
-``GAN/MTSS_WGAN_GP.py:292``) is split evenly across the ``dp`` axis; each
-device samples its own batch shard and noise with a per-device folded
-PRNG key and computes local gradients.  Under ``check_vma=True``'s type
-system the backward pass cross-device-sums those gradients automatically
-(the transpose of broadcasting replicated params into varying data is a
-psum), so the train step only divides by the axis size
-(``steps._psum_if``) — every device then applies the identical
-global-batch-mean update and parameter / optimizer state stay replicated
-without any explicit broadcast, a fact the static checker *proves* at
-trace time.  Losses are `pmean`'d for logging.  The window dataset
-(≤7 MB) is replicated; sampling indices differ per device, which is
-exactly the reference's i.i.d.-batch semantics at global-batch
-granularity.
-
-Single-device equivalence: axis-normalized gradients of mean-of-shard
-losses equal the global-batch gradient, so dp=N at global batch B
-matches dp=1 at batch B in expectation — and *exactly* (to f32
-round-off) under ``controlled_sampling=True``, which
-``tests/test_parallel.py`` uses to assert full trajectory + final-params
-equivalence on an 8-way virtual CPU mesh.
+The hand-built ``shard_map`` path (per-device folded-key sampling,
+vma-typed gradient normalization, ~100 LoC) is gone: a ``('dp',)`` mesh
+now launches the SINGLE-DEVICE program under ``pjit`` with the batch
+sharding-constrained over ``dp`` (:mod:`hfrep_tpu.parallel.rules`), so
+the dp run follows the single-device sample stream and trajectory by
+construction — what the old *controlled* mode simulated by hand, now
+the only mode — and it runs on every JAX version.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Tuple
-
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from hfrep_tpu.parallel._compat import shard_map
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.train.states import GanState
-from hfrep_tpu.train.steps import make_multi_step
-
-
-def wrap_batch_parallel(inner, mesh: Mesh, batch_axis: str,
-                        controlled_sampling: bool, jit: bool = True):
-    """shard_map a replicated-state step over ``mesh``, batch-parallel
-    along ``batch_axis``: i.i.d. mode folds the key by axis position so
-    each row samples independently (controlled mode leaves the shared
-    key — the inner step shards by axis index instead), metrics are
-    pmean'd over the axis, and ``check_vma=True`` proves parameters and
-    optimizer state stay replicated.  The single home of the dp sampling
-    contract — used by both the 1-D dp trainer here and the composed
-    dp×sp step (:mod:`hfrep_tpu.parallel.dp_sp`)."""
-
-    def per_device(state: GanState, key: jax.Array) -> Tuple[GanState, dict]:
-        if not controlled_sampling:
-            key = jax.random.fold_in(key, lax.axis_index(batch_axis))
-        state, metrics = inner(state, key)
-        return state, lax.pmean(metrics, batch_axis)
-
-    fn = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P()),
-        check_vma=True,
-    )
-    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
 
 
 def make_dp_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
                        mesh: Mesh, controlled_sampling: bool = False):
-    """Build the jitted data-parallel multi-epoch step.
-
-    Returns ``fn(state, key) -> (state, metrics)`` where ``state`` is
-    replicated over the mesh and ``metrics`` are global (pmean'd) with one
-    entry per inner epoch.
-
-    ``controlled_sampling=True`` draws the *global* batch identically on
-    every device (shared key) and feeds each device its shard — the dp
-    run then follows the exact sample stream of a single-device run at
-    the same global batch, making full trajectories comparable
-    (``tests/test_parallel.py``).  Default is i.i.d. per-device sampling
-    (key folded by mesh position): cheaper, same semantics at
-    global-batch granularity.
-
-    Static replication safety: ``check_vma=True`` — the checker proves at
-    trace time that parameters and optimizer state stay replicated across
-    the mesh (pmean'd gradients ⇒ invariant updates), with loop carries
-    pre-cast to their true variance (:mod:`hfrep_tpu.utils.vma`).
-    """
-    (axis_name,) = mesh.axis_names
-    n_dev = mesh.devices.size
-    if tcfg.batch_size % n_dev:
-        raise ValueError(
-            f"global batch {tcfg.batch_size} not divisible by dp={n_dev}")
-    local_tcfg = dataclasses.replace(tcfg, batch_size=tcfg.batch_size // n_dev)
-    inner = make_multi_step(
-        pair, local_tcfg, dataset, axis_name=axis_name, jit=False,
-        sample_batch=tcfg.batch_size if controlled_sampling else None)
-    fn = wrap_batch_parallel(inner, mesh, axis_name, controlled_sampling)
-    # telemetry hook — decided at build time: a no-op (fn returned
-    # unchanged, zero wrapper frames) unless hfrep_tpu.obs is enabled
-    from hfrep_tpu.obs import instrument_launch
-    return instrument_launch(fn, "dp_multi_step", mesh=mesh, tcfg=tcfg,
-                             steps_per_call=tcfg.steps_per_call)
+    """``tcfg.steps_per_call`` data-parallel epochs as ONE compiled
+    program.  ``controlled_sampling`` is accepted for source
+    compatibility and ignored: the mesh launch always follows the
+    single-device sample stream (the stronger guarantee)."""
+    del controlled_sampling
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh)

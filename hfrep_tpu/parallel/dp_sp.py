@@ -1,179 +1,35 @@
-"""Composed data × sequence parallelism on one 2-D ``('dp', 'sp')`` mesh.
+"""Composed data × sequence parallelism — thin shim over the unified
+mesh launch (:mod:`hfrep_tpu.parallel.rules`).
 
-Round-3 state of the framework had two disjoint scaling stories: batch
-sharding over a 1-D dp mesh (:mod:`hfrep_tpu.parallel.data_parallel`) and
-window sharding over a 1-D sp mesh (:mod:`hfrep_tpu.parallel.sequence`).
-A pod training a long-window MTSS-WGAN-GP wants BOTH — the window axis
-pipelined over ``sp`` to fit/parallelize the recurrence, and the batch
-sharded over ``dp`` so the remaining chips contribute throughput.  This
-module composes them in ONE ``shard_map`` region over the 2-D mesh:
-
-* **dp axis** — each dp row samples its own batch shard (i.i.d. folded
-  keys, or controlled global sampling for trajectory tests); gradients
-  are globally batch-mean normalized by the existing
-  :func:`hfrep_tpu.train.steps._psum_if` vma machinery (AD's automatic
-  psum over dp for standard paths, explicit pmean for varying
-  custom-vjp leaves).
-* **sp axis** — every generator/critic forward inside the step (and the
-  gradient penalty's second-order path) runs the pipelined
-  window-sharded recurrence in *manual* mode
-  (:func:`hfrep_tpu.parallel.sequence._sp_pipeline` with
-  ``manual=True``): each device slices its own window chunk, carries
-  hop via ``ppermute``, the critic head psums over ``sp``, and the
-  generator reassembles full windows by masked psum (typed
-  sp-*invariant* — an all_gather's sp-varying output would poison every
-  downstream loss type; see :func:`~hfrep_tpu.parallel.sequence.sp_generate`).
-* **params/optimizer state** — replicated over both axes;
-  ``check_vma=True`` proves replication is preserved at trace time.
-
-The reference anchor is the training loop being scaled,
-``GAN/MTSS_WGAN_GP.py:254-292`` — single-device, window ≤168.  Here
-dp×sp at the same global batch follows the plain step's trajectory to
-f32 round-off (``tests/test_dp_sp.py``, controlled sampling on a 2×4
-virtual mesh), so scaling out is a layout change, not a semantics
-change.
+The one ``('dp', 'sp')`` mesh now carries both axes as sharding
+constraints on the sampled batch (batch over ``dp``, window over
+``sp``) of the SINGLE-DEVICE program; GSPMD derives the collectives the
+old manual pipeline hand-wrote (ppermute carry handoffs, masked-psum
+reassembly, vma replication proofs — see the git history).
 """
 
 from __future__ import annotations
-
-import dataclasses
-from typing import Tuple
 
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.parallel.sequence import (sp_critic, sp_generate,
-                                         validate_sp_pair)
-
-
-def _split_axes(mesh: Mesh, tp_axis=None) -> Tuple[str, str]:
-    want = ("dp", "sp", "tp") if tp_axis is not None else ("dp", "sp")
-    if tuple(mesh.axis_names) != want:
-        raise ValueError(
-            f"dp×sp{'×tp' if tp_axis is not None else ''} composition wants "
-            f"a {want} mesh, got {mesh.axis_names}")
-    return "dp", "sp"
-
-
-def _make_inner(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                mesh: Mesh, controlled_sampling: bool, tp_axis=None):
-    """The per-device epoch step: plain-step semantics with manual-mode
-    window-sharded apply fns, dp-axis gradient normalization.  The ONE
-    home of the composed-mesh inner-step contract: ``tp_axis`` extends
-    it to the 3-D ``('dp', 'sp', 'tp')`` mesh
-    (:mod:`hfrep_tpu.parallel.dp_sp_tp`) with the hidden units
-    additionally sharded inside every pipeline chunk (XLA-scan chunks —
-    see the tp backend note in :mod:`hfrep_tpu.parallel.tensor`)."""
-    from hfrep_tpu.train.steps import make_train_step, resolve_lstm_backend
-
-    dp_axis, sp_axis = _split_axes(mesh, tp_axis)
-    validate_sp_pair(pair)
-    if tp_axis is not None:
-        from hfrep_tpu.parallel.tensor import (_check_width,
-                                               _validate_tp_backend)
-        if tcfg.sp_remat:
-            # build-time twin of _sp_pipeline's refusal: the tp chunk
-            # scan is not time-blocked, so remat would silently degrade
-            raise NotImplementedError(
-                "sp_remat supports the sp and dp×sp meshes only, not the "
-                "3-D dp×sp×tp composition (the per-timestep hidden-slice "
-                "all_gather is not time-blocked)")
-        _validate_tp_backend(tcfg)
-        _check_width(pair.generator.hidden, mesh.shape[tp_axis])
-        backend = "xla"
-    else:
-        backend = resolve_lstm_backend(tcfg.lstm_backend)
-    n_dp = mesh.shape[dp_axis]
-    n_sp = mesh.shape[sp_axis]
-    if tcfg.batch_size % n_dp:
-        raise ValueError(
-            f"global batch {tcfg.batch_size} not divisible by dp={n_dp}")
-    local_batch = tcfg.batch_size // n_dp
-    if tcfg.sp_microbatches is None:
-        if local_batch % n_sp:
-            raise ValueError(
-                f"per-dp-row batch {local_batch} not divisible by sp={n_sp} "
-                "(the pipeline's default microbatch count)")
-    elif tcfg.sp_microbatches < 1:
-        raise ValueError(
-            f"sp_microbatches must be >= 1, got {tcfg.sp_microbatches}")
-    elif local_batch % tcfg.sp_microbatches:
-        raise ValueError(
-            f"per-dp-row batch {local_batch} not divisible by "
-            f"sp_microbatches={tcfg.sp_microbatches}")
-    if dataset.shape[1] % n_sp:
-        raise ValueError(
-            f"window {dataset.shape[1]} not divisible by sp={n_sp}")
-    slope = pair.generator.slope
-    g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=sp_axis,
-                                       activation="sigmoid", slope=slope,
-                                       microbatches=tcfg.sp_microbatches,
-                                       backend=backend, manual=True,
-                                       tp_axis=tp_axis,
-                                       remat=tcfg.sp_remat)
-    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=sp_axis,
-                                     microbatches=tcfg.sp_microbatches,
-                                     backend=backend, manual=True,
-                                     tp_axis=tp_axis,
-                                     remat=tcfg.sp_remat)
-    local_tcfg = dataclasses.replace(tcfg, batch_size=local_batch)
-    return make_train_step(
-        pair, local_tcfg, dataset, axis_name=dp_axis,
-        sample_batch=tcfg.batch_size if controlled_sampling else None,
-        apply_fns=(g_apply, d_apply))
-
-
-def _wrap(inner, mesh: Mesh, controlled_sampling: bool, jit: bool,
-          tp_axis=None):
-    """The shared batch-parallel shard_map wrapper along the dp axis —
-    on the composed meshes, check_vma additionally proves state
-    replication over sp (and tp on the 3-D mesh)."""
-    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
-
-    dp_axis, _ = _split_axes(mesh, tp_axis)
-    return wrap_batch_parallel(inner, mesh, dp_axis, controlled_sampling, jit)
 
 
 def make_dp_sp_train_step(pair: GanPair, tcfg: TrainConfig,
                           dataset: jnp.ndarray, mesh: Mesh, *,
                           controlled_sampling: bool = False,
                           jit: bool = True):
-    """One dp×sp epoch: ``fn(state, key) -> (state, metrics)`` with state
-    replicated over the 2-D mesh and metrics pmean'd over ``dp``.
-
-    ``controlled_sampling=True`` draws the global batch identically on
-    every device and shards by dp position — the run then consumes the
-    exact sample stream of a single-device run at the same global batch
-    (the dp trajectory-test pattern, composed with window sharding).
-    """
-    inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    return _instrument(_wrap(inner, mesh, controlled_sampling, jit),
-                       "dp_sp_train_step", mesh, tcfg, jit)
-
-
-def _instrument(fn, name: str, mesh: Mesh, tcfg: TrainConfig, jit: bool):
-    """The launch paths' telemetry hook: build-time no-op (``fn``
-    returned unchanged) when obs is disabled or the caller asked for the
-    raw un-jitted step (composition builds must stay wrappable).
-    Delegates to the one shared contract in ``hfrep_tpu.obs``."""
-    from hfrep_tpu.obs import instrument_launch
-    return instrument_launch(fn, name, mesh=mesh, tcfg=tcfg, jit=jit,
-                             sp=True)
+    del controlled_sampling         # the mesh launch's one (stronger) mode
+    from hfrep_tpu.parallel.rules import make_gan_train_step
+    return make_gan_train_step(pair, tcfg, dataset, mesh, jit=jit)
 
 
 def make_dp_sp_multi_step(pair: GanPair, tcfg: TrainConfig,
                           dataset: jnp.ndarray, mesh: Mesh, *,
                           controlled_sampling: bool = False,
                           jit: bool = True):
-    """``tcfg.steps_per_call`` dp×sp epochs scanned into ONE compiled
-    program — the launch shape for real pod training (same per-dispatch
-    amortization argument as :func:`make_sp_multi_step`; the trainer
-    dispatches this from its ordinary block loop)."""
-    from hfrep_tpu.train.steps import make_multi_step
-
-    step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _instrument(_wrap(inner, mesh, controlled_sampling, jit),
-                       "dp_sp_multi_step", mesh, tcfg, jit)
+    del controlled_sampling
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh, jit=jit)

@@ -1,45 +1,7 @@
-"""Full 3-D composition: data × sequence × tensor parallelism on one
-``('dp', 'sp', 'tp')`` mesh.
-
-Round 4 built the pairwise compositions — dp×sp
-(:mod:`hfrep_tpu.parallel.dp_sp`) and dp×tp
-(:mod:`hfrep_tpu.parallel.tensor`).  This module closes the set: one
-``shard_map`` region over the 3-D mesh where
-
-* **dp** shards the batch — each dp slab samples its own rows (i.i.d.
-  folded keys, or controlled global sampling for trajectory tests) and
-  gradients are globally batch-mean normalized by the existing
-  `_psum_if` vma machinery;
-* **sp** shards the window — the pipelined chunk recurrence with
-  ppermute carry handoffs (:func:`hfrep_tpu.parallel.sequence._sp_pipeline`);
-* **tp** shards the hidden units *inside* each pipeline chunk — the
-  chunk scans carry (Bm, H/T) unit slices and all_gather them per
-  timestep (:func:`~hfrep_tpu.parallel.sequence._local_chunk_scan_tp`),
-  the :mod:`hfrep_tpu.parallel.tensor` layout composed into the sp
-  superstep schedule.  Carry handoffs ppermute the slices over ``sp``
-  (the T unit pipelines run the same schedule in lockstep); inter-layer
-  transforms and the heads see full-H tp-invariant chunks via masked
-  psum, so :func:`~hfrep_tpu.parallel.sequence.sp_generate` /
-  :func:`~hfrep_tpu.parallel.sequence.sp_critic` compose unchanged.
-
-Honest costing note (ADVICE r4): in this 3-D path the inter-layer
-``_tp_assemble`` masked psum runs **once per superstep per layer** —
-O((M + D_sp − 1) · layers) collectives, including on inactive fill/drain
-supersteps — where the plain tp path reassembles once per layer.  At the
-shipped shapes (M=1, D_sp ≤ 4, 2 LSTM layers) that is ≤ 10 extra psums
-of (Bm, W/D, H) chunks per epoch; on a pod, weigh it against the 2-D
-meshes before picking the 3-D layout (RESULTS.md §tensor-parallel
-honest-costing).
-
-Params and optimizer state stay replicated over all three axes
-(``check_vma=True`` proves it), and a controlled-sampling run at the
-same global batch follows the single-device trajectory to f32 round-off
-(``tests/test_dp_sp_tp.py`` on a 2×2×2 virtual mesh) — on a pod,
-scaling any of batch, window length, or model width is a mesh-shape
-change, not a semantics change.  The reference anchor is the loop being
-scaled, ``GAN/MTSS_WGAN_GP.py:254-292`` (single device, W ≤ 168,
-H = 100).  XLA-scan chunks only (see the tp backend note in
-:mod:`hfrep_tpu.parallel.tensor`).
+"""Full 3-D dp × sp × tp composition — thin shim over the unified mesh
+launch: batch over ``dp``, window over ``sp`` (data constraints), gate
+columns over ``tp`` (partition rules on the param pytree).  See
+:mod:`hfrep_tpu.parallel.rules`.
 """
 
 from __future__ import annotations
@@ -49,43 +11,21 @@ from jax.sharding import Mesh
 
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
-from hfrep_tpu.parallel.dp_sp import _instrument, _make_inner, _wrap
 
 
 def make_dp_sp_tp_train_step(pair: GanPair, tcfg: TrainConfig,
                              dataset: jnp.ndarray, mesh: Mesh, *,
                              controlled_sampling: bool = False,
                              jit: bool = True):
-    """One dp×sp×tp epoch: ``fn(state, key) -> (state, metrics)`` with
-    state replicated over the 3-D mesh and metrics pmean'd over ``dp``.
-    ``controlled_sampling=True`` consumes the exact single-device sample
-    stream at the same global batch (the trajectory-test mode).
-
-    Both the inner step and the batch-parallel wrapper are the dp×sp
-    contract's ONE home (:func:`hfrep_tpu.parallel.dp_sp._make_inner` /
-    ``_wrap``) with ``tp_axis`` threaded through — validation, sampling
-    streams, gradient normalization, and the shard_map wrap cannot
-    drift between the 2-D and 3-D meshes.
-    """
-    inner = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
-                        tp_axis="tp")
-    return _instrument(_wrap(inner, mesh, controlled_sampling, jit,
-                             tp_axis="tp"),
-                       "dp_sp_tp_train_step", mesh, tcfg, jit)
+    del controlled_sampling         # the mesh launch's one (stronger) mode
+    from hfrep_tpu.parallel.rules import make_gan_train_step
+    return make_gan_train_step(pair, tcfg, dataset, mesh, jit=jit)
 
 
 def make_dp_sp_tp_multi_step(pair: GanPair, tcfg: TrainConfig,
                              dataset: jnp.ndarray, mesh: Mesh, *,
                              controlled_sampling: bool = False,
                              jit: bool = True):
-    """``tcfg.steps_per_call`` dp×sp×tp epochs scanned into ONE compiled
-    program — the launch shape for real pod runs (dispatched from the
-    trainer's ordinary block loop)."""
-    from hfrep_tpu.train.steps import make_multi_step
-
-    step = _make_inner(pair, tcfg, dataset, mesh, controlled_sampling,
-                       tp_axis="tp")
-    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return _instrument(_wrap(inner, mesh, controlled_sampling, jit,
-                             tp_axis="tp"),
-                       "dp_sp_tp_multi_step", mesh, tcfg, jit)
+    del controlled_sampling
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh, jit=jit)

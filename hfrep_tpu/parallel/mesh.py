@@ -116,3 +116,34 @@ def replicate_to_global(tree, mesh: Mesh):
     return jax.tree_util.tree_map(
         lambda x: multihost_utils.host_local_array_to_global_array(
             np.asarray(x), mesh, P()), tree)
+
+
+def shard_to_global(tree, mesh: Mesh, specs):
+    """Identical-per-process host data → *global* arrays laid out per
+    ``specs`` — a single :class:`PartitionSpec` prefix, or a per-leaf
+    pytree of them (:func:`hfrep_tpu.parallel.rules.gan_launch_specs`).
+
+    The generalization of :func:`replicate_to_global` the tp launch
+    needs: every process holds the FULL host copy (identically-seeded
+    init, or a restored checkpoint), so each materializes only its
+    addressable shards from it (``make_array_from_callback``) — no
+    cross-host transfer, and the result's committed sharding matches
+    the launch's ``in_shardings`` exactly (pjit refuses a mismatch).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _is_spec(s):
+        return s is None or isinstance(s, P)
+
+    def put(x, spec):
+        arr = np.asarray(x)
+        s = NamedSharding(mesh, spec if spec is not None else P())
+        return jax.make_array_from_callback(arr.shape, s,
+                                            lambda idx: arr[idx])
+
+    if _is_spec(specs):
+        return jax.tree_util.tree_map(lambda x: put(x, specs), tree)
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    flat_specs = jax.tree_util.tree_flatten(specs, is_leaf=_is_spec)[0]
+    return jax.tree_util.tree_unflatten(
+        treedef, [put(x, s) for x, s in zip(flat, flat_specs)])

@@ -1,0 +1,507 @@
+"""One mesh to rule the launch paths: partition-rule-driven
+``NamedSharding``/``pjit`` (ROADMAP item 1).
+
+The round-11 state of the parallel package was seven hand-built
+``shard_map`` launch paths (~2,150 LoC), several dead on the pinned
+runtime (jax 0.4.37 has no ``jax.shard_map`` — the committed
+``HF005_KILL_LIST.md``), each re-implementing per-device sampling, key
+folding, gradient normalization and replication proofs by hand.  This
+module replaces the per-path plumbing with the rule-driven GSPMD idiom
+(SNIPPETS.md [2] ``match_partition_rules`` / ``make_shard_and_gather_fns``;
+the approach Podracer-style fabrics, arxiv 2104.06272, and TPU GAN
+training, arxiv 2111.04628, use to scale one program across chips):
+
+* **one mesh** — :class:`MeshSpec` declares ``dp``/``sp``/``tp``/``pp``
+  as axis *sizes*; :func:`build_mesh` turns it into the single
+  :class:`jax.sharding.Mesh` every launch shares;
+* **regex partition rules** — :func:`match_partition_rules` maps
+  ``(pattern, PartitionSpec)`` rules over the '/'-joined param-pytree
+  paths (scalar leaves replicated, unmatched params a hard error naming
+  the offending path); axis names a mesh does not carry are stripped,
+  so ONE rule set serves every mesh shape;
+* **pjit** — :func:`mesh_launch` jits a *global-semantics* program with
+  ``in_shardings``/``out_shardings`` derived from those rules plus
+  data/batch specs.  The traced jaxpr is the single-device program —
+  GSPMD partitions it — so a 1×1-mesh launch is jaxpr- AND
+  trajectory-identical to the plain jit by construction, and an N-device
+  launch differs only by collective reduction order (f32 round-off;
+  pinned in tests/test_mesh_rules.py and the MULTICHIP dry run);
+* **shard/gather fns** — :func:`make_shard_and_gather_fns` /
+  :func:`shard_put` move host data (the padded (K+1)×L dataset cube, GAN
+  train states) onto the mesh once, so steady dispatches copy nothing.
+
+This runs on every JAX version (no ``shard_map`` dependency).  The
+sampling semantics are the old *controlled* mode, now the only mode:
+the global batch is drawn exactly as the single-device program draws it
+and sharding constraints hand GSPMD the layout — dp=N follows the
+single-device trajectory at the same global batch by construction,
+which is what every trajectory pin in this repo asserts.  Compile-cache
+policy is untouched (chaos corpus entry 004 pins the 1.0 s persistent
+XLA cache threshold as load-bearing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Canonical axis order.  ``dp`` shards batch / lane-grid rows, ``sp``
+#: the window (time) axis, ``tp`` hidden units (gate columns), ``pp``
+#: the stack depth (layer_pipeline.py — the one remaining manual path).
+AXES = ("dp", "sp", "tp", "pp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis sizes, not separate modules.
+
+    ``MeshSpec(dp=8)`` is the 1-D data-parallel mesh; ``MeshSpec(dp=2,
+    sp=4)`` the composed 2-D mesh the old ``dp_sp.py`` hand-built; all
+    sizes 1 is the single-device mesh (axes collapse to ``('dp',)`` so
+    there is always one named axis to spec against)."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+    pp: int = 1
+
+    def __post_init__(self):
+        for name in AXES:
+            if getattr(self, name) < 1:
+                raise ValueError(f"mesh axis sizes must be >= 1, got "
+                                 f"{name}={getattr(self, name)}")
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp * self.pp
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        names = tuple(n for n in AXES if getattr(self, n) > 1)
+        return names or ("dp",)
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, n) for n in self.axis_names)
+
+    def describe(self) -> dict:
+        """JSON-safe manifest section (the ``mesh`` config annotation
+        bench/bench_pp write into run.json — under ``config``, NOT the
+        top-level ``mesh`` key, so history comparability keys stay
+        continuous across the shard_map→pjit migration)."""
+        return {"axes": {n: int(s) for n, s in
+                         zip(self.axis_names, self.axis_sizes)},
+                "devices": int(self.size), "unified": True}
+
+
+def build_mesh(spec: MeshSpec = MeshSpec(),
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The ONE :class:`jax.sharding.Mesh` from a declarative spec."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if spec.size > len(devices):
+        raise ValueError(f"mesh spec {spec} wants {spec.size} devices but "
+                         f"only {len(devices)} are present")
+    arr = np.asarray(devices[:spec.size]).reshape(spec.axis_sizes)
+    return Mesh(arr, spec.axis_names)
+
+
+def mesh_spec(mesh: Optional[Mesh]) -> MeshSpec:
+    """The :class:`MeshSpec` a mesh realizes (unknown axis names refuse —
+    the trainer's name-based dispatch contract)."""
+    if mesh is None:
+        return MeshSpec()
+    sizes = {}
+    for name in mesh.axis_names:
+        if name not in AXES:
+            raise ValueError(f"mesh axis {name!r} not in {AXES}")
+        sizes[name] = int(mesh.shape[name])
+    return MeshSpec(**sizes)
+
+
+# ------------------------------------------------------------ rule matching
+def named_leaves(tree):
+    """``[(path, leaf)]`` with '/'-joined human-readable paths — the
+    names the regex rules match (``g_params/KerasLSTM_0/kernel``,
+    ``g_opt/0/mu/KerasLSTM_0/recurrent_kernel``, …)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+
+    def part(entry) -> str:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                return str(getattr(entry, attr))
+        return str(entry)
+
+    return [("/".join(part(e) for e in path), leaf) for path, leaf in flat]
+
+
+def normalize_spec(spec: P, mesh: Mesh) -> P:
+    """Strip axis names the mesh does not carry (size-1 axes are not in
+    ``mesh.axis_names``), so one rule set serves every mesh shape."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    kept = [keep(e) for e in spec]
+    while kept and kept[-1] is None:    # P(None, None) is NOT P(): trim
+        kept.pop()
+    return P(*kept)
+
+
+def match_partition_rules(rules, tree, mesh: Optional[Mesh] = None):
+    """Pytree of :class:`PartitionSpec` per ``rules`` over ``tree``.
+
+    ``rules`` is a sequence of ``(regex, PartitionSpec)`` pairs matched
+    (``re.search``) against each leaf's '/'-joined path, first match
+    wins.  Scalar leaves (rank 0 or a single element) are always
+    replicated — optimizer step counts never deserve an axis.  A leaf no
+    rule matches is a HARD error naming the offending path: silence here
+    is how a new param sneaks in unsharded/unreplicated by accident
+    (SNIPPETS.md [2]'s contract, kept).  With ``mesh``, axis names the
+    mesh lacks are stripped from every matched spec."""
+    specs = []
+    for name, leaf in named_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and (len(shape) == 0 or int(np.prod(shape)) <= 1):
+            specs.append(P())
+            continue
+        for pattern, ps in rules:
+            if re.search(pattern, name) is not None:
+                specs.append(normalize_spec(ps, mesh) if mesh is not None
+                             else ps)
+                break
+        else:
+            raise ValueError(
+                f"partition rule not found for param: {name!r} "
+                f"(shape {shape}); every leaf must match a rule — add one "
+                f"or extend the catch-all")
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def tree_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree (or prefix) → NamedSharding pytree (prefix).
+    ``None`` entries mean replicated."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else P()),
+        specs, is_leaf=lambda s: s is None or isinstance(s, P))
+
+
+def _check_divisible(name: str, leaf, spec: P, mesh: Mesh) -> None:
+    shape = getattr(leaf, "shape", ())
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        n = int(np.prod([mesh.shape[a] for a in axes]))
+        if n > 1 and shape[dim] % n:
+            raise ValueError(
+                f"cannot shard {name!r}: dimension {dim} (size {shape[dim]}) "
+                f"is not divisible by the {'×'.join(axes)}={n} mesh extent")
+
+
+def _is_spec(s) -> bool:
+    return s is None or isinstance(s, P)
+
+
+def broadcast_specs(tree, specs):
+    """Align ``specs`` — a single :class:`PartitionSpec` or a pytree
+    *prefix* of them — to ``tree``'s exact structure (``None`` →
+    replicated).  PartitionSpec is a tuple subclass, so every traversal
+    here must treat it as a LEAF, never a container."""
+    if _is_spec(specs):
+        one = specs if specs is not None else P()
+        return jax.tree_util.tree_map(lambda _: one, tree)
+    return jax.tree_util.tree_map(
+        lambda s, sub: jax.tree_util.tree_map(
+            lambda _: s if s is not None else P(), sub),
+        specs, tree, is_leaf=_is_spec)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs) -> Tuple[Callable, Callable]:
+    """``(shard_fn, gather_fn)`` for host↔mesh movement (SNIPPETS.md [2]).
+
+    ``shard_fn(tree)`` device_puts every leaf under its spec'd
+    NamedSharding — ONE placement, after which steady pjit dispatches
+    copy nothing (an uncommitted operand would be re-laid-out every
+    call).  Divisibility is checked leaf-by-leaf with the offending
+    path named.  ``gather_fn(tree)`` is the inverse: fully-addressable
+    host numpy copies."""
+
+    def shard_fn(tree):
+        spec_tree = broadcast_specs(tree, specs)
+        flat = named_leaves(tree)
+        flat_specs = jax.tree_util.tree_flatten(spec_tree,
+                                                is_leaf=_is_spec)[0]
+        out = []
+        for (name, leaf), spec in zip(flat, flat_specs):
+            _check_divisible(name, leaf, spec, mesh)
+            out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+        treedef = jax.tree_util.tree_structure(tree)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def gather_fn(tree):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), tree)
+
+    return shard_fn, gather_fn
+
+
+def shard_put(tree, mesh: Mesh, specs):
+    """One-shot :func:`make_shard_and_gather_fns` shard: place ``tree``
+    on the mesh under ``specs`` (a PartitionSpec, or a pytree of them
+    matching ``tree``)."""
+    shard_fn, _ = make_shard_and_gather_fns(mesh, specs)
+    return shard_fn(tree)
+
+
+# ----------------------------------------------------------------- launch
+def mesh_launch(fn, mesh: Mesh, in_specs, out_specs,
+                donate_argnums: Tuple[int, ...] = (),
+                static_argnums: Tuple[int, ...] = ()):
+    """jit ``fn`` across ``mesh`` with rule/spec-derived shardings.
+
+    ``in_specs``/``out_specs`` are pytrees (or prefixes) of
+    :class:`PartitionSpec` aligned with ``fn``'s args/outputs; ``None``
+    means replicated.  The function itself stays a GLOBAL program —
+    GSPMD inserts every collective — so this is jaxpr-identical to
+    ``jax.jit(fn)`` and the 1×1-mesh executable is the single-device
+    executable (the pinned identity every migrated path rests on)."""
+    return jax.jit(fn,
+                   in_shardings=tree_shardings(mesh, in_specs),
+                   out_shardings=tree_shardings(mesh, out_specs),
+                   donate_argnums=donate_argnums,
+                   static_argnums=static_argnums)
+
+
+def data_constraint(mesh: Optional[Mesh]) -> Optional[Callable]:
+    """The batch/window layout hint for sampled tensors inside a step:
+    ``hint(x, batch_axis)`` constrains ``x``'s batch axis over ``dp``
+    and (rank ≥ batch_axis+2, divisible) window axis over ``sp``.
+
+    Returns ``None`` — the LITERAL identity, no constraint ops traced —
+    when the mesh has no dp/sp extent to shard over, which is what makes
+    the 1×1-mesh jaxpr identical to the single-device program."""
+    if mesh is None:
+        return None
+    n_dp = int(mesh.shape["dp"]) if "dp" in mesh.axis_names else 1
+    n_sp = int(mesh.shape["sp"]) if "sp" in mesh.axis_names else 1
+    if n_dp <= 1 and n_sp <= 1:
+        return None
+
+    def hint(x, batch_axis: int = 0):
+        entries = [None] * x.ndim
+        if n_dp > 1:
+            entries[batch_axis] = "dp"
+        w_axis = batch_axis + 1
+        if (n_sp > 1 and x.ndim > w_axis + 1
+                and x.shape[w_axis] % n_sp == 0 and x.shape[w_axis] > 1):
+            entries[w_axis] = "sp"
+        if all(e is None for e in entries):
+            return x
+        # TWO constraints, deliberately: the replicated pin first BLOCKS
+        # the sharded layout from propagating backward into the
+        # producer.  That producer is usually jax.random — and on this
+        # runtime (threefry_partitionable=False) a PARTITIONED threefry
+        # computes DIFFERENT values per shard, which silently changes
+        # the sample stream and every trajectory pin with it (measured:
+        # normal() under a bare dp×sp constraint drifted by O(1)).  The
+        # pin makes the random values the literal single-device values;
+        # the second constraint then hands GSPMD the compute layout.
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*entries)))
+
+    return hint
+
+
+# ------------------------------------------------------------- GAN rules
+#: Partition rules for the GAN train state (params + optimizer state —
+#: optax trees mirror param paths, so one vocabulary covers both).
+#: ``tp`` shards every LSTM layer's gate columns (kernel (F, 4H) and
+#: recurrent_kernel (H, 4H) on their 4H axis, bias (4H,) on its only
+#: axis) — the Megatron-style layout the old ``tensor.py`` hand-sliced
+#: inside shard_map, now a LAYOUT declaration GSPMD lowers to the same
+#: per-step hidden-state all_gather.  Everything else (Dense heads,
+#: LayerNorms, dense-family stacks) replicates.  On a mesh without a
+#: ``tp`` axis the tp names strip away and the whole state replicates —
+#: the dp/sp story.
+GAN_PARTITION_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"KerasLSTM_\d+/(kernel|recurrent_kernel)$", P(None, "tp")),
+    (r"KerasLSTM_\d+/bias$", P("tp")),
+    (r".*", P()),
+)
+
+#: THE lane-grid layout: every carry leaf leads with the lane grid's
+#: dataset axis (``multi``) or lane axis (``lanes``) — shard it over
+#: ``dp``.  The AE engine's chunk programs broadcast this spec as their
+#: operand/carry prefix (``replication/engine.py::_lane_specs``); the
+#: rule form below is the same declaration for per-leaf resolution
+#: (scalars replicate via the matcher's guard — pinned against the
+#: real engine carry in tests/test_mesh_rules.py).
+AE_LANE_SPEC = P("dp")
+AE_LANE_RULES: Tuple[Tuple[str, P], ...] = ((r".*", AE_LANE_SPEC),)
+
+
+def gan_state_specs(state, mesh: Mesh):
+    """Rule-resolved PartitionSpec pytree for a :class:`GanState`."""
+    return match_partition_rules(GAN_PARTITION_RULES, state, mesh)
+
+
+def _validate_gan_mesh(pair, tcfg, dataset, mesh: Mesh) -> MeshSpec:
+    spec = mesh_spec(mesh)      # refuses unknown axis names
+    if spec.pp > 1:
+        raise ValueError(
+            "pp is the layer_pipeline.py axis (manual schedule); the "
+            "rule-driven mesh launch shards dp/sp/tp only")
+    if spec.dp > 1 and tcfg.batch_size % spec.dp:
+        raise ValueError(
+            f"global batch {tcfg.batch_size} not divisible by dp={spec.dp}")
+    if spec.sp > 1 and dataset.shape[1] % spec.sp:
+        raise ValueError(
+            f"window {dataset.shape[1]} not divisible by sp={spec.sp}")
+    if spec.tp > 1:
+        if pair.family != "mtss_wgan_gp":
+            raise ValueError(
+                f"tp (hidden-unit) sharding supports the mtss_wgan_gp "
+                f"family's LSTM stacks, got {pair.family!r}")
+        for h in {int(pair.generator.hidden), int(pair.discriminator.hidden)}:
+            if h % spec.tp:
+                raise ValueError(
+                    f"hidden width {h} not divisible by tp={spec.tp} devices")
+    return spec
+
+
+def _launch_name(mesh: Mesh, kind: str) -> str:
+    """Historical launch names, preserved: ``dp_multi_step``,
+    ``sp_train_step``, ``dp_sp_multi_step``, … — the obs compile-span /
+    dispatch-counter vocabulary stays continuous across the migration."""
+    return f"{'_'.join(mesh.axis_names)}_{kind}"
+
+
+def _resolve_mesh_backend(tcfg, mesh: Mesh):
+    """GSPMD cannot partition an opaque pallas call, so a >1-device
+    mesh must not trace the pallas kernels the single-device TPU step
+    prefers: ``lstm_backend='auto'`` (a preference, not a demand)
+    resolves to the partitionable XLA scan here, while an EXPLICIT
+    ``'pallas'`` refuses loudly — the contract the retired tp path
+    enforced with ``_validate_tp_backend``, kept.  1-device meshes
+    (the single-chip bench, the chip oracles) keep whatever resolves."""
+    if mesh.devices.size <= 1:
+        return tcfg
+    from hfrep_tpu.train.steps import resolve_lstm_backend
+    if resolve_lstm_backend(tcfg.lstm_backend) != "pallas":
+        return tcfg
+    if tcfg.lstm_backend == "pallas":
+        raise ValueError(
+            "lstm_backend='pallas' cannot be GSPMD-partitioned over a "
+            f"{mesh.devices.size}-device mesh; use 'auto' (resolves to "
+            "the xla scan on multi-device meshes) or 'xla'")
+    return dataclasses.replace(tcfg, lstm_backend="xla")
+
+
+def _gan_step(pair, tcfg, dataset, mesh: Mesh, multi: bool):
+    from hfrep_tpu.train.steps import make_multi_step, make_train_step
+
+    _validate_gan_mesh(pair, tcfg, dataset, mesh)
+    tcfg = _resolve_mesh_backend(tcfg, mesh)
+    step = make_train_step(pair, tcfg, dataset,
+                           shard_data=data_constraint(mesh))
+    if multi:
+        return make_multi_step(pair, tcfg, dataset, jit=False, step=step)
+    return step
+
+
+def gan_launch_specs(pair, tcfg, dataset, mesh: Mesh):
+    """The state layout the rule-driven launch compiles against:
+    everything replicated (one ``P()`` prefix) on dp/sp meshes — the
+    state IS replicated there, pinned as a compiled fact so GSPMD can
+    never leave a param leaf sharded at a multi-host checkpoint
+    boundary (the old ``_jit_replicated_out`` lesson).  A tp mesh
+    rule-resolves the real per-leaf layout over an abstract
+    ``eval_shape`` of the state (build-time-cheap, nothing
+    materializes); every leaf must match a rule — the hard-error
+    contract.  The trainer promotes/checkpoints multi-host state
+    against these SAME specs (pjit refuses committed args whose
+    layout disagrees)."""
+    if "tp" not in mesh.axis_names:
+        return P()
+    from hfrep_tpu.train.states import init_gan_state
+    state_shape = jax.eval_shape(
+        lambda: init_gan_state(
+            jax.random.PRNGKey(0),
+            _model_cfg_of(pair, dataset), tcfg, pair))
+    return gan_state_specs(state_shape, mesh)
+
+
+def _gan_launch(pair, tcfg, dataset, mesh: Mesh, kind: str, fn):
+    from hfrep_tpu.obs import instrument_launch
+
+    specs = gan_launch_specs(pair, tcfg, dataset, mesh)
+    launched = mesh_launch(fn, mesh,
+                           in_specs=(specs, P()),
+                           out_specs=(specs, P()),
+                           donate_argnums=(0,))
+    return instrument_launch(launched, _launch_name(mesh, kind), mesh=mesh,
+                             tcfg=tcfg)
+
+
+def _model_cfg_of(pair, dataset):
+    """Reconstruct the ModelConfig init needs from the pair + data —
+    the builders take (pair, tcfg, dataset) like every launch factory
+    before them, so the config is derived, not re-threaded.  Only the
+    tp path needs it, and tp validation has already pinned the family
+    to the LSTM stack (hidden is a real attribute there)."""
+    from hfrep_tpu.config import ModelConfig
+    return ModelConfig(family=pair.family,
+                       window=int(dataset.shape[1]),
+                       features=int(dataset.shape[2]),
+                       hidden=int(pair.generator.hidden))
+
+
+def make_gan_train_step(pair, tcfg, dataset, mesh: Mesh, *, jit: bool = True):
+    """ONE epoch (n_critic critic updates + generator update) launched
+    across ``mesh`` — the unified replacement for the seven hand-built
+    single-epoch builders.  Global-stream sampling: the dp=N run follows
+    the single-device trajectory at the same global batch and key (f32
+    round-off on >1 device, bit-identical on a 1×1 mesh)."""
+    step = _gan_step(pair, tcfg, dataset, mesh, multi=False)
+    if not jit:
+        return step
+    return _gan_launch(pair, tcfg, dataset, mesh, "train_step", step)
+
+
+def make_gan_multi_step(pair, tcfg, dataset, mesh: Mesh, *, jit: bool = True):
+    """``tcfg.steps_per_call`` epochs scanned into ONE compiled program
+    across ``mesh`` — the launch shape real training dispatches
+    (per-dispatch amortization unchanged from the single-device
+    multi-step)."""
+    fn = _gan_step(pair, tcfg, dataset, mesh, multi=True)
+    if not jit:
+        return fn
+    return _gan_launch(pair, tcfg, dataset, mesh, "multi_step", fn)
+
+
+# ---------------------------------------------------------------- helpers
+def lane_mesh(n_lanes: int,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A ``('dp',)`` mesh sized to the largest divisor of ``n_lanes``
+    that fits the host — the convenience the sweep/walk-forward drives
+    use to shard a (K+1)- or L-row lane grid without the caller doing
+    divisor arithmetic.  ``n_lanes`` prime (or 1) degrades to a 1-device
+    mesh (still the unified launch path, just unsharded)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = max((d for d in range(1, min(n_lanes, len(devices)) + 1)
+             if n_lanes % d == 0), default=1)
+    return build_mesh(MeshSpec(dp=n), devices=devices)

@@ -1,42 +1,24 @@
-"""Sequence (context) parallelism: pipelined LSTM over a window-sharded mesh.
+"""Sequence (window-axis) parallelism — GSPMD edition.
 
-The reference processes windows of 48-168 months sequentially on one
-device (SURVEY §5.7 — no sequence parallelism exists to port).  For
-long-window synthesis (W ≫ 168) a recurrent model cannot use ring
-attention's trick of reordering blockwise softmax — the carry is a hard
-sequential dependency.  The idiomatic TPU answer is *pipeline parallelism
-over the time axis*:
+The 850-line manual pipeline (superstep schedule, ppermute carry
+handoffs, masked-psum reassembly, vma casts — all dead on runtimes
+without ``jax.shard_map``) is replaced by the unified mesh launch: the
+window axis of the sampled batch is sharding-constrained over ``sp``
+and GSPMD partitions the per-timestep math, inserting the collectives
+the old code hand-wrote (:mod:`hfrep_tpu.parallel.rules`).  On a
+1-device ``('sp',)`` mesh the program is the literal single-device
+program, so the old "sp tax" (134 vs 167 steps/s at prod shape,
+RESULTS.md) disappears by construction.
 
-* the window axis W is sharded into contiguous chunks, one per device on
-  the ``sp`` mesh axis (device k owns timesteps [k·W/D, (k+1)·W/D));
-* the batch is split into M microbatches; device k runs its chunk of
-  microbatch m at pipeline superstep s = k + m, so after the k-step
-  fill the pipe all D devices compute concurrently;
-* the (h, c) carry crosses device boundaries via `lax.ppermute` over
-  ICI — the only communication, 2·Bm·H floats per superstep.
+What intentionally remains here:
 
-Per-chunk compute follows :class:`hfrep_tpu.ops.lstm.KerasLSTM`: the
-input projection for the whole local chunk is one big MXU matmul hoisted
-out of the recurrence; only the (Bm, H) @ (H, 4H) recurrent matmul runs
-per timestep.
-
-Exactness: the pipeline computes the identical recurrence (same order,
-same arithmetic) as the single-device scan — verified to float32
-round-off in tests/test_sequence.py on an 8-device CPU mesh.
-
-Backends: ``backend="xla"`` scans the fused cell; ``backend="pallas"``
-dispatches each device's chunk to the carry-injection pallas kernels
-(:func:`hfrep_tpu.ops.pallas_lstm.lstm_seq_carry` — nonzero (h0, c0) in,
-final carry out, twice-differentiable).  The pallas path compiles only
-on real TPU (interpret-mode pallas cannot propagate vma under
-``shard_map(check_vma=True)``); on TPU the default ``lstm_backend='auto'``
-resolves to it; in the full sp training composition the kernels are
-3.8× the scan backend and bring the window-sharded step to ~80% of the
-plain single-device step's speed (7.5 vs 6.0 ms/epoch at prod shape on
-one chip; RESULTS.md "Sequence-parallel pallas chunks" — note the two
-measurement traps documented there).  The kernels are oracle-tested against the scan twin on a
-single chip (tests/test_pallas_lstm.py carry tests,
-tools/chip_check_carry.py).
+* the plain param-level LSTM-stack forwards (:func:`sp_generate` /
+  :func:`sp_critic` / :func:`sp_lstm`) — the single source of the
+  flagship arithmetic shared with :mod:`hfrep_tpu.parallel.tensor` and
+  :mod:`hfrep_tpu.parallel.layer_pipeline` (``_local_chunk_scan`` /
+  ``_sp_ln`` / ``_sp_head_impl`` live here for that reason);
+* :func:`sp_microbatch_plan` — the analytic microbatch model the chip
+  studies anchored (advisory; the GSPMD path has no M knob).
 """
 
 from __future__ import annotations
@@ -45,675 +27,43 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from hfrep_tpu.parallel._compat import shard_map
 from hfrep_tpu.ops.layers import ACTIVATIONS
 from hfrep_tpu.ops.lstm import lstm_cell_step
-from hfrep_tpu.utils.vma import match_vma
 
 
-def _local_chunk_scan(xz_chunk: jnp.ndarray, carry: Tuple[jnp.ndarray, jnp.ndarray],
+def _local_chunk_scan(xz_chunk: jnp.ndarray,
+                      carry: Tuple[jnp.ndarray, jnp.ndarray],
                       recurrent: jnp.ndarray, act, rec_act):
-    """Scan one (Wl, Bm, 4H) pre-projected chunk from the given carry,
-    using the same fused cell as the single-device :class:`KerasLSTM`."""
+    """Scan one (W, B, 4H) pre-projected sequence from the given carry,
+    using the same fused cell as the single-device :class:`KerasLSTM` —
+    shared by the layer pipeline's stage scans and the plain forwards
+    below, so no path can drift arithmetically."""
 
     def cell(c, xz_t):
-        return lstm_cell_step(c, xz_t, recurrent=recurrent, act=act, rec_act=rec_act)
+        return lstm_cell_step(c, xz_t, recurrent=recurrent, act=act,
+                              rec_act=rec_act)
 
     return lax.scan(cell, carry, xz_chunk)
 
 
-#: Time-block length for rematerialized chunk scans: sized so one
-#: block's transient recompute residuals (~16 × (REMAT_BLOCK, Bm, 4Hp)
-#: buffers in the GP second-order pass — the chip OOM dump's census) stay
-#: ~100 MB while the stored per-block carries remain negligible.
-REMAT_BLOCK = 512
-
-
-def _local_chunk_scan_remat(y_chunk, kernel, bias, carry, recurrent,
-                            act, rec_act, block: Optional[int] = None):
-    """:func:`_local_chunk_scan` with remat over the TIME axis — and the
-    input projection pulled INSIDE each block: the chunk scans in
-    ``block``-timestep slices, each slice's ``y @ kernel + bias``
-    projection AND recurrence wrapped together in one `jax.checkpoint`,
-    so the stored residual per block is the raw (block, Bm, F/H) input —
-    not the 4H-wide gate buffer (the difference is what XLA's memory
-    report showed: a hoisted projection kept an 11.5 GiB gate tensor
-    alive as a checkpoint input at W=37 632).  The backward (and the GP
-    second-order backward-of-backward) recomputes one block at a time:
-    O(Wl/block · Bm·H) carries + one transient block of residuals,
-    instead of O(Wl · Bm·4Hp · ~16).  This is what lets remat move the
-    memory wall even at sp=1, where superstep checkpointing alone
-    degenerates (one superstep = the whole window — measured: W=37 632
-    still wants 55 GiB without time blocking, 40 GiB with blocking but a
-    hoisted projection, see RESULTS.md).  Identical recurrence,
-    identical order — trajectory pinned in tests/test_sequence.py."""
-    if block is None:
-        block = REMAT_BLOCK          # late-bound so tests can shrink it
-    gates = kernel.shape[1]
-
-    def proj_scan(c, y_b):
-        rows = y_b.shape[0] * y_b.shape[1]
-        xz_b = (y_b.reshape(rows, y_b.shape[-1]) @ kernel
-                + bias).reshape(*y_b.shape[:-1], gates)
-        return _local_chunk_scan(xz_b, c, recurrent, act, rec_act)
-
-    wl = y_chunk.shape[0]
-    if wl <= block:
-        return proj_scan(carry, y_chunk)
-    nb = wl // block
-    main = y_chunk[:nb * block].reshape(nb, block, *y_chunk.shape[1:])
-    carry, hs = lax.scan(jax.checkpoint(proj_scan), carry, main)
-    h_seq = hs.reshape(nb * block, *y_chunk.shape[1:-1], hs.shape[-1])
-    if wl % block:
-        carry, h_tail = proj_scan(carry, y_chunk[nb * block:])
-        h_seq = jnp.concatenate([h_seq, h_tail], axis=0)
-    return carry, h_seq
-
-
-def _local_chunk_scan_tp(xz_chunk: jnp.ndarray,
-                         carry: Tuple[jnp.ndarray, jnp.ndarray],
-                         r_loc: jnp.ndarray, act, rec_act, tp_axis: str):
-    """The tp twin of :func:`_local_chunk_scan`: the chunk's gates and
-    (h, c) carry are this device's Hl = H/T unit slices, and the
-    recurrence is the SAME shared cell the plain tp layer scans
-    (:func:`hfrep_tpu.parallel.tensor.tp_chunk_scan` — per-step hidden
-    all_gather against the local gate columns), so the sp-pipelined and
-    standalone tp paths cannot drift arithmetically."""
-    from hfrep_tpu.parallel.tensor import tp_chunk_scan
-
-    return tp_chunk_scan(xz_chunk, carry, r_loc, act, rec_act, tp_axis)
-
-
-def _resolve_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
-    """Default the sharded-window axis: the mesh's only axis for a 1-D
-    mesh (dp- or sp-named — callers need not thread axis names), or an
-    axis literally named ``"sp"`` on a multi-axis mesh."""
-    if axis_name is not None:
-        return axis_name
-    if len(mesh.axis_names) == 1:
-        return mesh.axis_names[0]
-    if "sp" in mesh.axis_names:
-        return "sp"
-    raise ValueError(
-        f"pass axis_name explicitly for multi-axis mesh {mesh.axis_names}")
-
-
-def _sp_pipeline(layers, x: jnp.ndarray, mesh: Mesh, *,
-                 axis_name: Optional[str] = None,
-                 microbatches: Optional[int] = None,
-                 activation: str = "tanh",
-                 recurrent_activation: str = "sigmoid",
-                 backend: str = "xla",
-                 inters=None,
-                 manual: bool = False,
-                 tp_axis: Optional[str] = None,
-                 remat: bool = False) -> jnp.ndarray:
-    """N stacked LSTMs through ONE window-sharded pipeline pass.
-
-    ``layers`` is a list of KerasLSTM param dicts ({kernel,
-    recurrent_kernel, bias}); ``inters[i]`` is an optional *per-timestep*
-    transform applied between layer i and i+1 (e.g. the generator's
-    LayerNorm), given as a ``(fn, params)`` pair — ``fn(params, y)`` with
-    ``params`` threaded through `shard_map` as a real operand (closure
-    capture of arrays inside the manual-mesh body trips jax's
-    mesh-consistency check when the pipeline is scanned over epochs).
-    Per-timestep means position-independent, so applying it chunk-wise
-    inside the pipeline computes exactly what applying it to the full
-    sequence would.  Each superstep runs this device's chunk
-    through every layer back-to-back (layer i+1's chunk input is layer
-    i's chunk output, same device, same superstep) and hands ALL layers'
-    (h, c) carries forward together — one pipeline fill/drain and one
-    shard_map region for the whole stack, where per-layer passes pay
-    both per layer.
-
-    ``manual=True`` runs the pipeline *inside an enclosing*
-    ``shard_map`` region (the dp×sp composed step,
-    :mod:`hfrep_tpu.parallel.dp_sp`): ``x`` is then this device's
-    full-window batch shard (replicated over the sp axis), the body
-    slices its own window chunk by ``lax.axis_index(axis_name)``, and
-    the return value is the LOCAL (B, W/D, H) chunk — the caller owns
-    reassembly (masked psum for the generator, sliced-head psum for the
-    critic; never all_gather — see :func:`sp_generate`).  The vma casts adapt automatically: loop carries are
-    matched against the pre-projected chunk's actual variance
-    (``match_vma``), which is {sp} standalone and {dp, sp} composed.
-
-    ``tp_axis`` (manual mode only) additionally shards every layer's
-    HIDDEN UNITS over that mesh axis, the
-    :mod:`hfrep_tpu.parallel.tensor` layout composed into the pipeline:
-    each device's chunk scan carries its (Bm, H/T) unit slices (carry
-    handoffs ppermute the slices over ``axis_name`` — the T unit
-    pipelines run the same schedule in lockstep), every timestep
-    all_gathers the slices over ``tp_axis``
-    (:func:`_local_chunk_scan_tp`), inter-layer transforms see the full
-    width via a masked-psum reassembly per chunk, and the returned
-    chunk is full-H, typed tp-*invariant* — so the sp callers
-    (:func:`sp_generate` / :func:`sp_critic`) work unchanged on top.
-    XLA-scan backend only (a per-step cross-chip gather is what the
-    fused kernels cannot express).
-    """
-    axis_name = _resolve_axis(mesh, axis_name)
-    n_dev = mesh.shape[axis_name]
-    b, w, f = x.shape
-    h_dims = [l["recurrent_kernel"].shape[0] for l in layers]
-    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
-    if remat and tp_axis is not None:
-        raise NotImplementedError(
-            "sp_remat supports the sp and dp×sp meshes only: under tp the "
-            "chunk scan all_gathers the hidden slices per timestep "
-            "(_local_chunk_scan_tp) and is not time-blocked, so remat "
-            "would silently keep the hoisted gate buffer it exists to "
-            "eliminate — refuse instead of degrading")
-    if tp_axis is not None:
-        if not manual:
-            raise ValueError("tp_axis requires manual mode (an enclosing "
-                             "shard_map over the ('…', 'sp', 'tp') mesh)")
-        if backend == "pallas":
-            raise NotImplementedError(
-                "the pipelined chunks run the XLA scan under tp_axis: the "
-                "pallas kernels cannot express the per-timestep cross-chip "
-                "all_gather of the hidden slices")
-        from hfrep_tpu.parallel.tensor import _check_width
-        for h in h_dims:
-            _check_width(h, n_tp)
-    m = n_dev if microbatches is None else microbatches
-    if m < 1:
-        raise ValueError(f"microbatches must be >= 1, got {m}")
-    if b % m:
-        raise ValueError(f"batch {b} not divisible by microbatches {m}")
-    if w % n_dev:
-        raise ValueError(f"window {w} not divisible by sp devices {n_dev}")
-    bm = b // m
-    n_lay = len(layers)
-    inters = list(inters) if inters is not None else [None] * n_lay
-    inter_fns = [i[0] if i is not None else None for i in inters]
-    inter_params = [i[1] if i is not None else () for i in inters]
-    act, rec_act = ACTIVATIONS[activation], ACTIVATIONS[recurrent_activation]
-
-    use_kernel = backend == "pallas"
-    if use_kernel:
-        from hfrep_tpu.ops.pallas_lstm import (LANE, _supported,
-                                               kernel_eligible,
-                                               lstm_seq_carry,
-                                               pad_keras_params)
-        _supported(activation, recurrent_activation)
-        if jax.default_backend() != "tpu":
-            raise NotImplementedError(
-                "sp_lstm(backend='pallas') needs a real TPU: interpret-mode "
-                "pallas cannot propagate vma under shard_map(check_vma)")
-        if x.dtype != jnp.float32:
-            # a pallas backend request with an unsupported dtype must raise,
-            # not silently run scan chunks; only the width gate below falls
-            # back quietly.  (The framework's sp/dp×sp steps can't get here
-            # — validate_sp_pair pins f32 before the backend resolves.)
-            raise NotImplementedError("sp_lstm pallas backend runs f32")
-        if not kernel_eligible("pallas", x.dtype, hidden=max(h_dims)):
-            # measured VMEM ceiling (ops/pallas_lstm.py): oversized widths
-            # take the scan chunks instead of OOMing in the carry adjoint
-            use_kernel = False
-    if use_kernel:
-        hp = [((h + LANE - 1) // LANE) * LANE for h in h_dims]
-        lay = []
-        for l, h, hpi in zip(layers, h_dims, hp):
-            k_p, r_p, b_p = pad_keras_params(l, h, hpi)
-            lay.append({"kernel": k_p, "recurrent_kernel": r_p, "bias": b_p})
-        act_name = activation if activation else "linear"
-    else:
-        hp = h_dims
-        lay = list(layers)
-    # Per-device gate/carry widths: the tp-sliced Hl when the hidden
-    # units are sharded, the (possibly lane-padded) full width otherwise.
-    wid = [h // n_tp for h in h_dims] if tp_axis is not None else hp
-
-    fwd = [(k, k + 1) for k in range(n_dev - 1)]        # no wraparound: dev0 keeps zeros
-
-    def per_device(lay, inter_params, x_local):
-        # x_local: (B, Wl, F) — this device's time chunk for every row.
-        wl = x_local.shape[1]
-        k_idx = lax.axis_index(axis_name)
-        if tp_axis is not None:
-            # Composed width sharding: slice this tp rank's gate columns
-            # out of every layer — the same shared layout helper the
-            # plain tp path uses (parallel/tensor.py).
-            from hfrep_tpu.parallel.tensor import _slice_gate_params
-
-            t_tp = lax.axis_index(tp_axis)
-            lay = [_slice_gate_params(l, t_tp, hl)
-                   for l, hl in zip(lay, wid)]
-        # Hoisted layer-0 input projection: one MXU matmul for the whole
-        # chunk (padded-gate layout when the pallas kernels run it).
-        # Deeper layers' projections run per superstep — their inputs
-        # only exist once the previous layer's chunk has run.
-        # EXCEPT under remat: the hoisted 4H-wide gate buffer would live
-        # the whole backward as a checkpoint input (11.5 GiB at
-        # W=37 632); the remat path feeds RAW features through and
-        # projects inside each checkpointed time block
-        # (_local_chunk_scan_remat).
-        no_hoist = remat and not use_kernel and tp_axis is None
-        if no_hoist:
-            xz = jnp.swapaxes(x_local, 0, 1)            # (Wl, B, F) raw
-            xz_mb = xz.reshape(wl, m, bm, f)
-        else:
-            g0 = 4 * wid[0]
-            xz = (x_local.reshape(b * wl, f) @ lay[0]["kernel"]
-                  + lay[0]["bias"]).reshape(b, wl, g0)
-            xz = jnp.swapaxes(xz, 0, 1)                 # (Wl, B, 4Hp0)
-            xz_mb = xz.reshape(wl, m, bm, g0)           # microbatch split
-
-        # Cast the loop state to the variance the loop body will produce:
-        # the pre-projected chunk carries the true vma ({sp} standalone,
-        # {dp, sp} under the composed dp×sp step, plus {tp} when the
-        # units are sharded), so matching against it keeps the scan's
-        # carry-in/carry-out types equal in every mode.
-        carry_reg = tuple(
-            (match_vma(jnp.zeros((bm, hpi), xz.dtype), xz),
-             match_vma(jnp.zeros((bm, hpi), xz.dtype), xz)) for hpi in wid)
-
-        # Kernel mode: the pallas custom_vjp emits *varying* cotangents
-        # (hand-computed per-device, never auto-psum'd), so a replicated
-        # rec would give the AD-generated reverse scan a drec accumulator
-        # whose carry-in (invariant zeros) mismatches its carry-out under
-        # check_vma.  Casting rec to varying keeps the whole cotangent
-        # chain varying; the pcast's own transpose then psums it back to
-        # the replicated param exactly once at the boundary.
-        recs = [(match_vma(l["recurrent_kernel"], xz) if use_kernel
-                 else l["recurrent_kernel"]) for l in lay]
-
-        def run_chunk(i, xz_s, h0, c0):
-            """((h_fin, c_fin), h_seq) for one chunk: (Wl, Bm, 4Hp_i)
-            pre-projected gates, or the RAW (Wl, Bm, F/H) layer input in
-            remat mode (projection happens inside the time blocks)."""
-            if use_kernel:
-                h_seq, c_f = lstm_seq_carry(xz_s, recs[i], h0, c0, act_name)
-                return (h_seq[-1], c_f), h_seq
-            if tp_axis is not None:
-                return _local_chunk_scan_tp(xz_s, (h0, c0), recs[i],
-                                            act, rec_act, tp_axis)
-            if remat:
-                # time-blocked remat inside the chunk: without it the
-                # superstep-level checkpoint still recomputes (and thus
-                # transiently stores) the WHOLE chunk's residuals in each
-                # backward — degenerate at sp=1 where Wl = W.
-                return _local_chunk_scan_remat(
-                    xz_s, lay[i]["kernel"], lay[i]["bias"], (h0, c0),
-                    recs[i], act, rec_act)
-            return _local_chunk_scan(xz_s, (h0, c0), recs[i], act, rec_act)
-
-        # Scan-then-gather: every superstep emits its chunk's last-layer
-        # hidden sequence; afterwards this device keeps exactly its m
-        # active supersteps (s = k_idx + mb).  No output masking is
-        # needed — device k is active precisely for s ∈ [k, k+m-1], so
-        # (a) every gathered output comes from an active compute, and
-        # (b) a carry consumed by an active step was always produced by
-        # an active step at s-1 (k active at s ⟺ k-1 active at s-1);
-        # inactive chunks produce bounded garbage that nothing selects.
-        # This replaces the earlier fori_loop that scatter-updated a
-        # (Wl, M, Bm, H) buffer under a `where` every superstep — two
-        # full-buffer copies per superstep that AD then re-materialized.
-        def superstep(carry, s):
-            mb = s - k_idx                              # microbatch this device runs now
-            active = jnp.logical_and(mb >= 0, mb < m)
-            mb_c = jnp.clip(mb, 0, m - 1)
-            seq = lax.dynamic_index_in_dim(xz_mb, mb_c, axis=1, keepdims=False)
-            new_carry = []
-            for i in range(n_lay):
-                if i > 0:
-                    # previous layer's real lanes → inter-layer transform
-                    # → this layer's input projection (one (Wl·Bm)-row
-                    # MXU matmul per chunk).  Under tp the chunk holds
-                    # only this rank's unit slices: reassemble the full
-                    # width by masked psum so the transform (LayerNorm
-                    # normalizes over ALL H units) and the projection's
-                    # H-contraction see the true sequence.
-                    if tp_axis is not None:
-                        from hfrep_tpu.parallel.tensor import _tp_assemble
-                        y = _tp_assemble(seq, tp_axis)
-                    else:
-                        y = seq[..., :h_dims[i - 1]]
-                    if inter_fns[i - 1] is not None:
-                        y = inter_fns[i - 1](inter_params[i - 1], y)
-                    if no_hoist:
-                        seq = y          # raw input; blocks project it
-                    else:
-                        gi = 4 * wid[i]
-                        seq = (y.reshape(wl * bm, h_dims[i - 1])
-                               @ lay[i]["kernel"]
-                               + lay[i]["bias"]).reshape(wl, bm, gi)
-                h_in, c_in = carry[i]
-                # Device 0 always starts microbatches from the zero carry.
-                h0 = jnp.where(k_idx == 0, 0.0, 1.0) * h_in
-                c0 = jnp.where(k_idx == 0, 0.0, 1.0) * c_in
-                (h_f, c_f), seq = run_chunk(i, seq, h0, c0)
-                # Inactive fill/drain chunks never feed a *selected*
-                # output, but their carries must still be zeroed at the
-                # handoff: with a non-saturating activation an unselected
-                # garbage chain could otherwise compound across
-                # supersteps to inf, and 0-cotangent × inf residuals
-                # would NaN the real gradients.
-                h_f = jnp.where(active, h_f, 0.0)
-                c_f = jnp.where(active, c_f, 0.0)
-                # Hand the finished carry to the next pipeline stage
-                # (padding lanes ride along in kernel mode; their
-                # outgoing recurrent weights are zero, so they never
-                # touch real lanes).
-                new_carry.append((lax.ppermute(h_f, axis_name, perm=fwd),
-                                  lax.ppermute(c_f, axis_name, perm=fwd)))
-            return tuple(new_carry), seq
-
-        # remat: store only the superstep carries + emitted chunks and
-        # re-run each body (projection, chunk scan, ppermute) inside the
-        # backward — the scan-level residuals drop from ~16 (Wl, Bm, 4Hp)
-        # buffers per GP-grad layer (the chip OOM dump's census) to the
-        # carry chain, the same strategy the pallas kernels' adjoints use
-        # natively.  The recomputed ppermutes re-run as collectives in
-        # the backward; gradient values are unchanged (pinned vs the
-        # plain step in tests/test_sequence.py).
-        body = jax.checkpoint(superstep) if remat else superstep
-        _, ys = lax.scan(body, carry_reg,
-                         jnp.arange(m + n_dev - 1))     # (S, Wl, Bm, Hp[-1])
-        out = ys[k_idx + jnp.arange(m)]                 # (M, Wl, Bm, Hp[-1])
-        # (M, Wl, Bm, Hp) → (Wl, M, Bm, Hp) → (B, Wl, H)
-        out = jnp.swapaxes(out, 0, 1).reshape(wl, b, wid[-1])
-        out = jnp.swapaxes(out, 0, 1)
-        if tp_axis is not None:
-            # Full-H, typed tp-invariant — the sp callers' reassembly
-            # and head logic work unchanged on top.
-            from hfrep_tpu.parallel.tensor import _tp_assemble
-            return _tp_assemble(out, tp_axis)
-        return out[..., :h_dims[-1]]
-
-    if manual:
-        # Already inside a shard_map region: slice this device's window
-        # chunk and run the body directly; the caller reassembles.
-        wl = w // n_dev
-        k_sp = lax.axis_index(axis_name)
-        x_loc = lax.dynamic_slice_in_dim(x, k_sp * wl, wl, axis=1)
-        return per_device(lay, inter_params, x_loc)
-    mapped = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(), P(None, axis_name, None)),
-        out_specs=P(None, axis_name, None))
-    return mapped(lay, inter_params, x)
-
-
-def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
-            x: jnp.ndarray, mesh: Mesh, *, axis_name: Optional[str] = None,
-            microbatches: Optional[int] = None,
-            activation: str = "tanh",
-            recurrent_activation: str = "sigmoid",
-            backend: str = "xla") -> jnp.ndarray:
-    """LSTM over (B, W, F) with W sharded across ``axis_name`` (defaults
-    to the mesh's only axis).
-
-    Returns the full hidden sequence (B, W, H), sharded over W the same
-    way.  ``microbatches`` defaults to the number of ``sp`` devices
-    (square pipeline — fill/drain overhead D/(M+D-1)).  Activation
-    defaults mirror :class:`hfrep_tpu.ops.lstm.KerasLSTM` (tanh candidate
-    transform, sigmoid gates); the reference's generators override the
-    candidate transform with sigmoid (``GAN/MTSS_WGAN_GP.py:224-226``).
-
-    ``backend="pallas"`` runs each chunk through the carry-injection
-    pallas kernels (TPU-only; see module docstring).
-    """
-    return _sp_pipeline(
-        [{"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}],
-        x, mesh, axis_name=axis_name, microbatches=microbatches,
-        activation=activation, recurrent_activation=recurrent_activation,
-        backend=backend)
-
-
-def sp_lstm2(p0: dict, p1: dict, x: jnp.ndarray, mesh: Mesh, *,
-             inter=None, axis_name: Optional[str] = None,
-             microbatches: Optional[int] = None,
-             activation: str = "tanh",
-             recurrent_activation: str = "sigmoid",
-             backend: str = "xla",
-             manual: bool = False,
-             tp_axis: Optional[str] = None,
-             remat: bool = False) -> jnp.ndarray:
-    """Two stacked LSTMs fused into ONE pipeline pass (optionally with a
-    per-timestep ``inter = (fn, params)`` transform between them, applied
-    as ``fn(params, y)``) — the sp analogue of the single-device fused
-    stack kernels (`ops/pallas_lstm_stack.py`): one fill/drain and one
-    shard_map region instead of two of each.  ``manual=True`` runs
-    inside an enclosing shard_map and returns the local window chunk
-    (see :func:`_sp_pipeline`); ``tp_axis`` additionally shards the
-    hidden units of both layers over that axis (manual mode only)."""
-    return _sp_pipeline([p0, p1], x, mesh, inters=[inter, None],
-                        axis_name=axis_name, microbatches=microbatches,
-                        activation=activation,
-                        recurrent_activation=recurrent_activation,
-                        backend=backend, manual=manual, tp_axis=tp_axis,
-                        remat=remat)
-
-
-def sp_microbatch_plan(batch: int, n_dev: int, window: int = 168,
-                       hidden: int = 100,
-                       step_latency_s: float = 2e-6,
-                       mxu_flops: float = 1e14) -> dict:
-    """Analytic model of the microbatch count's two competing effects —
-    the M-vs-Bm trade the round-3 numbers (measured at D=1, where no
-    pipeline exists) do not constrain.
-
-    Critical path: S = M + D − 1 supersteps of W/D recurrence timesteps,
-    each costing ``t_step(Bm) = max(t_lat, 8·Bm·Hp² / mxu_flops)`` with
-    Bm = B/M rows.  Relative to the single-device scan (W steps at B
-    rows):
-
-    * **latency-bound** (t_lat dominates — true for every shape this
-      framework ships: at Hp=128, Bm=32 the matmul is ~21 ns against
-      ~2 µs of per-step latency): time ∝ S·W/D, so SMALL M wins — M=1
-      is latency-*parity* with the single device while cutting per-device
-      window state D×.  In this regime sequence parallelism is a memory/
-      capacity play, not a throughput play, and the pipeline 'utilization'
-      M/(M+D−1) is the wrong metric to optimize.
-    * **work-bound** (huge Bm·Hp²): time ∝ S·(W/D)·Bm ∝ (M+D−1)/M, so
-      LARGE M wins, approaching D× speedup — the classical pipeline
-      regime.  The crossover Bm* = t_lat·mxu_flops/(8·Hp²) sits at
-      ~1500 rows for Hp=128: far above any realistic batch here, which
-      is why the recommendation is latency-regime M unless hidden is
-      scaled into the thousands.
-
-    Returns per-M predictions (supersteps, Bm, predicted time relative
-    to the single-device scan) and the recommended M.  The model's core
-    assumption — t_step flat in Bm at these shapes — is validated on
-    chip by ``tools/bench_sp_microbatch.py`` (RESULTS.md round 4).
-    The pipeline's DEFAULT stays M = D (every published number used it);
-    this planner is advisory for pod runs.
-    """
-    from hfrep_tpu.ops.pallas_lstm import LANE
-
-    hp = ((hidden + LANE - 1) // LANE) * LANE
-    plans = []
-    for m in range(1, batch + 1):
-        if batch % m:
-            continue
-        bm = batch // m
-        t_step = max(step_latency_s, 8.0 * bm * hp * hp / mxu_flops)
-        t_single = window * max(step_latency_s, 8.0 * batch * hp * hp / mxu_flops)
-        rel = (m + n_dev - 1) * (window / n_dev) * t_step / t_single
-        plans.append({"microbatches": m, "rows": bm,
-                      "supersteps": m + n_dev - 1,
-                      "relative_time": rel})
-    best = min(plans, key=lambda p: p["relative_time"])
-    return {"plans": plans, "recommended": best["microbatches"]}
-
-
-def validate_sp_pair(pair) -> None:
-    """The sp modules mirror the flagship LSTMGenerator/LSTMFlatCritic
-    param trees and run f32 — shared precondition of the standalone sp
-    step and the composed dp×sp step (:mod:`hfrep_tpu.parallel.dp_sp`)."""
-    if pair.family != "mtss_wgan_gp":
-        raise ValueError(f"sequence-parallel step supports the "
-                         f"mtss_wgan_gp family, got {pair.family!r}")
-    if (pair.generator.dtype or jnp.float32) != jnp.float32:
-        raise NotImplementedError(
-            "sequence-parallel step runs f32; configure dtype=float32")
-
-
-def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
-                       axis_name: Optional[str] = None,
-                       microbatches: Optional[int] = None, jit: bool = True):
-    """Sequence-parallel MTSS-WGAN-GP training: the full epoch (n_critic
-    GP critic updates + generator update) with the window axis sharded.
-
-    Long-window training, not just synthesis: every generator/critic
-    forward — including the gradient penalty's input-grad and the
-    second-order path through it — runs the pipelined window-sharded
-    recurrences (:func:`sp_generate` / :func:`sp_critic`); AD transposes
-    the ppermute carry handoffs and the psum'd critic head
-    automatically.  All other step semantics (sampling streams, critic
-    loop, optimizer updates) are shared verbatim with the single-device
-    step via ``make_train_step(apply_fns=...)``, so a moderate-W sp run
-    is trajectory-comparable to the plain step (tests/test_sequence.py).
-
-    Requires the flagship ``mtss_wgan_gp`` family (the sp modules mirror
-    its LSTMGenerator / LSTMFlatCritic trees).
-    """
-    from hfrep_tpu.train.steps import make_train_step
-
-    axis_name = _resolve_axis(mesh, axis_name)
-    validate_sp_pair(pair)
-    if microbatches is None:
-        # config-driven M (TrainConfig.sp_microbatches; the measured
-        # recommendation at shipped shapes is M=1 — sp_microbatch_plan);
-        # an explicit kwarg wins.
-        microbatches = tcfg.sp_microbatches
-    # Mirror the dp×sp builder's build-time checks (dp_sp.py:87-103) so a
-    # bad M refuses here rather than on the first call inside _sp_pipeline.
-    n_sp = mesh.shape[axis_name]
-    m_eff = _effective_sp_microbatches(mesh, axis_name, tcfg, microbatches)
-    if m_eff < 1:
-        raise ValueError(f"sp_microbatches must be >= 1, got {m_eff}")
-    if tcfg.batch_size % m_eff:
-        raise ValueError(
-            f"batch {tcfg.batch_size} not divisible by sp_microbatches="
-            f"{m_eff}" + ("" if microbatches is not None else
-                          " (the pipeline's default M = sp devices)"))
-    if dataset.shape[1] % n_sp:
-        raise ValueError(
-            f"window {dataset.shape[1]} not divisible by sp={n_sp} devices")
-    slope = pair.generator.slope
-
-    # Same resolution/validation as the plain step: 'auto' → pallas on a
-    # real TPU, xla elsewhere; anything else raises.
-    from hfrep_tpu.train.steps import resolve_lstm_backend
-    backend = resolve_lstm_backend(tcfg.lstm_backend)
-    # TrainConfig.sp_remat: superstep rematerialization for long-window
-    # runs near the HBM wall (config.py; only meaningful on the scan
-    # backend — the pallas kernels' adjoints already recompute).
-    remat = tcfg.sp_remat
-    g_apply = lambda p, z: sp_generate(p, z, mesh, axis_name=axis_name,
-                                       activation="sigmoid", slope=slope,
-                                       microbatches=microbatches,
-                                       backend=backend, remat=remat)
-    d_apply = lambda p, x: sp_critic(p, x, mesh, axis_name=axis_name,
-                                     microbatches=microbatches,
-                                     backend=backend, remat=remat)
-    step = make_train_step(pair, tcfg, dataset, apply_fns=(g_apply, d_apply))
-    if not jit:
-        return step
-    from hfrep_tpu.obs import instrument_launch
-    # sp_microbatches passed explicitly: the telemetry must report the
-    # effective M (kwarg > config > one-per-device), not whatever
-    # tcfg.sp_microbatches happens to hold — a microbatch sweep's points
-    # would otherwise all log the same value.
-    return instrument_launch(_jit_replicated_out(step, mesh),
-                             "sp_train_step", mesh=mesh, tcfg=tcfg, sp=True,
-                             sp_microbatches=m_eff)
-
-
-def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
-                       axis_name: Optional[str] = None,
-                       microbatches: Optional[int] = None, jit: bool = True):
-    """``fn(state, key) -> (state, stacked_metrics)``:
-    ``tcfg.steps_per_call`` sequence-parallel epochs scanned into ONE
-    compiled program — the sp twin of
-    :func:`hfrep_tpu.train.steps.make_multi_step` and the launch shape
-    real sp training should use.  Measured on chip (RESULTS.md): a
-    single-epoch dispatch pays ~1 s of fixed per-dispatch overhead
-    through the tunneled runtime, so one-epoch-at-a-time timing
-    overstates the sp program's cost by ~6×; 50-epoch blocks amortize it
-    exactly as the plain trainer's ``steps_per_call`` does."""
-    from hfrep_tpu.train.steps import make_multi_step
-
-    step = make_sp_train_step(pair, tcfg, dataset, mesh,
-                              axis_name=axis_name,
-                              microbatches=microbatches, jit=False)
-    multi = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    if not jit:
-        return multi
-    # telemetry hook — the shared build-time contract (obs disabled ⇒
-    # the raw jitted step back, zero wrapper frames)
-    from hfrep_tpu.obs import instrument_launch
-    m_eff = _effective_sp_microbatches(
-        mesh, _resolve_axis(mesh, axis_name), tcfg, microbatches)
-    return instrument_launch(_jit_replicated_out(multi, mesh),
-                             "sp_multi_step", mesh=mesh, tcfg=tcfg, sp=True,
-                             sp_microbatches=m_eff)
-
-
-def _effective_sp_microbatches(mesh: Mesh, axis_name: str, tcfg,
-                               microbatches: Optional[int]) -> int:
-    """The M the sp pipeline actually runs: explicit kwarg beats
-    ``TrainConfig.sp_microbatches`` beats one microbatch per sp device.
-    Both sp builders and their telemetry attrs resolve through here so
-    a sweep's ``parallel_build`` events report the swept value."""
-    if microbatches is None:
-        microbatches = tcfg.sp_microbatches
-    return mesh.shape[axis_name] if microbatches is None else microbatches
-
-
-def _jit_replicated_out(fn, mesh: Mesh):
-    """jit with the (state, metrics) outputs pinned REPLICATED over the
-    mesh.  The sp step's state is logically replicated (every update is
-    computed from window-summed gradients), but an unconstrained jit
-    lets GSPMD pick output layouts, and with window-sharded
-    intermediates it may leave param leaves sharded — harmless on one
-    host, but on a multi-host mesh the trainer's checkpoint
-    `device_get` then faces non-addressable arrays.  Pinning P() makes
-    the replication a compiled fact.  Inputs are pinned identically so
-    the donated state's layout always matches the output it aliases."""
-    rep = NamedSharding(mesh, P())
-    return jax.jit(fn, donate_argnums=(0,),
-                   in_shardings=(rep, rep), out_shardings=(rep, rep))
-
-
-def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
-                          **kw) -> jnp.ndarray:
-    """Convenience wrapper taking a KerasLSTM param dict
-    ({kernel, recurrent_kernel, bias}) and placing ``x`` window-sharded
-    on the mesh before the pipelined scan."""
-    axis = kw.get("axis_name", "sp")
-    sharding = NamedSharding(mesh, P(None, axis, None))
-    x = jax.device_put(x, sharding)
-    return sp_lstm(params["kernel"], params["recurrent_kernel"], params["bias"],
-                   x, mesh, **kw)
-
-
 def _sp_ln(p: dict, v: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """LayerNorm between the pipelined recurrences — the same
-    :class:`~hfrep_tpu.ops.layers.KerasLayerNorm` module the
-    single-device generator runs, so the two paths cannot drift.
-    Deliberately NOT jitted: it executes inside the fused pipeline's
-    `shard_map` body (a Manual-mesh context), where an inner jit's
-    sharding plumbing raises a mesh-consistency error under `lax.scan`
-    tracing; as plain traced ops it inlines and partitions per-timestep
-    with zero communication."""
+    """LayerNorm via the same :class:`~hfrep_tpu.ops.layers.KerasLayerNorm`
+    module the single-device generator runs.  Deliberately NOT jitted:
+    it also executes inside the layer pipeline's shard_map body (a
+    Manual-mesh context where an inner jit trips the mesh-consistency
+    check); as plain traced ops it inlines everywhere."""
     from hfrep_tpu.ops.layers import KerasLayerNorm
 
     return KerasLayerNorm(epsilon=eps).apply({"params": p}, v)
 
 
-def _sp_head_impl(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> jnp.ndarray:
-    """LeakyReLU → LN → Dense tail of the generator — every op is
-    per-timestep, so it applies identically to a full sequence (GSPMD
-    path) or to one device's window chunk (manual dp×sp path, where an
-    inner jit would trip the manual-mesh consistency check — see
-    `_sp_ln`)."""
+def _sp_head_impl(g_params: dict, v: jnp.ndarray, slope: float,
+                  eps: float) -> jnp.ndarray:
+    """LeakyReLU → LN → Dense tail of the generator — per-timestep ops,
+    identical on a full sequence or a pipeline stage's chunk."""
     from hfrep_tpu.ops.layers import KerasDense, KerasLayerNorm, leaky_relu
 
     v = leaky_relu(v, slope)
@@ -723,136 +73,219 @@ def _sp_head_impl(g_params: dict, v: jnp.ndarray, slope: float, eps: float) -> j
     return KerasDense(features).apply({"params": g_params["KerasDense_0"]}, v)
 
 
-_sp_head = jax.jit(_sp_head_impl, static_argnames=("slope", "eps"))
+def _jit_replicated_out(fn, mesh: Mesh):
+    """jit with (state, metrics) pinned REPLICATED over the mesh — the
+    layer pipeline's launch wrapper (and historically every manual
+    path's).  Now a one-liner over :func:`~hfrep_tpu.parallel.rules.
+    mesh_launch`."""
+    from hfrep_tpu.parallel.rules import mesh_launch
+
+    return mesh_launch(fn, mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+                       donate_argnums=(0,))
 
 
-def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
-              axis_name: Optional[str] = None,
-              microbatches: Optional[int] = None,
-              backend: str = "xla",
-              manual: bool = False,
-              tp_axis: Optional[str] = None,
-              remat: bool = False) -> jnp.ndarray:
-    """The MTSS-WGAN-GP critic (LSTM → LSTM → Flatten → Dense(1),
-    :class:`hfrep_tpu.models.discriminators.LSTMFlatCritic`) with the
-    window axis sharded — (B, W, F) → (B, 1) scores.
+# --------------------------------------------------- plain stack forwards
+def _lstm_layer(params: dict, x: jnp.ndarray, activation: str,
+                recurrent_activation: str = "sigmoid",
+                backend: Optional[str] = None) -> jnp.ndarray:
+    """One Keras-semantics LSTM layer on (B, W, Fin) → (B, W, H): the
+    input projection hoisted as one MXU matmul, the recurrence the
+    shared fused cell — the arithmetic every parallel mode launches.
 
-    Both recurrences run in ONE fused pipeline pass (:func:`sp_lstm2` —
-    layer 1's chunk consumes layer 0's chunk in the same superstep, both
-    carry pairs ppermute together); the flattened (W·H → 1) head is a
-    window-sharded contraction: each device dots its local (B, Wl, H)
-    chunk with its Wl·H slice of the Dense kernel and a single `psum`
-    over ``axis_name`` completes the reduction — the only collective
-    beyond the carry handoffs.  Differentiable end to end
-    (ppermute/psum transposes), which is what sequence-parallel WGAN-GP
-    *training* needs; exactness and gradient tests in
-    tests/test_sequence.py.
+    An explicit ``backend`` (the chip tools' ``backend="pallas"``)
+    routes through :class:`~hfrep_tpu.ops.lstm.KerasLSTM`'s dispatch so
+    the pallas-vs-xla oracles really compare the kernels; the default
+    keeps the hand-hoisted scan (jaxpr-pinned by the identity tests)."""
+    if backend not in (None, "xla"):
+        from hfrep_tpu.ops.lstm import KerasLSTM
+        return KerasLSTM(features=int(params["recurrent_kernel"].shape[0]),
+                         activation=activation,
+                         recurrent_activation=recurrent_activation).apply(
+            {"params": params}, x, backend=backend)
+    k, r, b = params["kernel"], params["recurrent_kernel"], params["bias"]
+    bsz, w, f = x.shape
+    xz = (x.reshape(bsz * w, f) @ k + b).reshape(bsz, w, -1)
+    xz = jnp.swapaxes(xz, 0, 1)                       # time-major
+    h = r.shape[0]
+    init = (jnp.zeros((bsz, h), xz.dtype), jnp.zeros((bsz, h), xz.dtype))
+    _, hs = _local_chunk_scan(xz, init, r, ACTIVATIONS[activation],
+                              ACTIVATIONS[recurrent_activation])
+    return jnp.swapaxes(hs, 0, 1)                     # (B, W, H)
 
-    ``manual=True`` (the dp×sp composed step): ``x`` is the device's
-    full-window batch shard inside an enclosing shard_map; the pipeline
-    returns the local chunk and the head dots it with this device's
-    W/D-slice of the flatten-Dense kernel before the same psum.
-    ``tp_axis`` additionally shards the recurrences' hidden units over
-    that axis (the pipeline's chunks come back full-H tp-invariant, so
-    the head below is unchanged — dp×sp×tp composition,
-    :mod:`hfrep_tpu.parallel.dp_sp_tp`).
-    """
-    axis_name = _resolve_axis(mesh, axis_name)
-    # both recurrences in ONE fused pipeline pass (see sp_lstm2)
-    h2 = sp_lstm2(d_params["KerasLSTM_0"], d_params["KerasLSTM_1"], x, mesh,
-                  axis_name=axis_name, microbatches=microbatches,
-                  backend=backend, manual=manual, tp_axis=tp_axis,
-                  remat=remat)
 
+def generator_forward(g_params: dict, z: jnp.ndarray, *,
+                      slope: float = 0.2, activation: str = "sigmoid",
+                      ln_eps: float = 1e-3,
+                      backend: Optional[str] = None) -> jnp.ndarray:
+    """The full MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
+    Dense) from a raw param tree — matches ``generator.apply`` to f32
+    round-off (the layout-agnostic body :func:`sp_generate` and
+    :func:`~hfrep_tpu.parallel.tensor.tp_generate` launch)."""
+    x = _lstm_layer(g_params["KerasLSTM_0"], z, activation, backend=backend)
+    x = _sp_ln(g_params["KerasLayerNorm_0"], x, ln_eps)
+    x = _lstm_layer(g_params["KerasLSTM_1"], x, activation, backend=backend)
+    return _sp_head_impl(g_params, x, slope, ln_eps)
+
+
+def critic_forward(d_params: dict, x: jnp.ndarray,
+                   backend: Optional[str] = None) -> jnp.ndarray:
+    """The flagship critic (LSTM → LSTM → Flatten → Dense(1)) from a raw
+    param tree: (B, W, F) → (B, 1) scores."""
+    h = _lstm_layer(d_params["KerasLSTM_0"], x, "tanh", backend=backend)
+    h = _lstm_layer(d_params["KerasLSTM_1"], h, "tanh", backend=backend)
     dense = d_params["KerasDense_0"]["Dense_0"]
-    w = x.shape[1]
-    h = h2.shape[-1]
-    kernel_w = dense["kernel"].reshape(w, h, -1)     # (W, H, 1): shardable by W
+    s = h.reshape(h.shape[0], -1) @ dense["kernel"]
+    return s + dense["bias"] if "bias" in dense else s
 
-    def local_head(h_local, k_local):
-        bb, wl, hh = h_local.shape
-        part = h_local.reshape(bb, wl * hh) @ k_local.reshape(wl * hh, -1)
-        return lax.psum(part, axis_name)
 
-    if manual:
-        wl = w // mesh.shape[axis_name]
-        k_local = lax.dynamic_slice_in_dim(
-            kernel_w, lax.axis_index(axis_name) * wl, wl, axis=0)
-        scores = local_head(h2, k_local)
-    else:
-        scores = shard_map(
-            local_head, mesh=mesh,
-            in_specs=(P(None, axis_name, None), P(axis_name, None, None)),
-            out_specs=P())(h2, kernel_w)
-    if "bias" in dense:
-        scores = scores + dense["bias"]
-    return scores
+# ----------------------------------------------------- sp public surface
+def _window_spec(mesh: Mesh, axis_name: Optional[str]) -> str:
+    if axis_name is None:
+        axis_name = "sp" if "sp" in mesh.axis_names else mesh.axis_names[0]
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"axis {axis_name!r} not in mesh {mesh.axis_names}")
+    return axis_name
+
+
+def _check_backend(mesh: Mesh, backend: Optional[str]) -> Optional[str]:
+    """An explicit non-xla ``backend`` (the chip tools' pallas oracles
+    run on 1-device meshes) must not be silently ignored — and GSPMD
+    cannot partition an opaque pallas call over a >1-device mesh, so
+    refuse loudly there instead of tracing something wrong."""
+    if backend in (None, "xla"):
+        return backend
+    if mesh.devices.size > 1:
+        raise ValueError(
+            f"backend={backend!r} (a pallas kernel path) cannot be "
+            f"GSPMD-partitioned over the {mesh.devices.size}-device mesh; "
+            "multi-device sp launches use the xla scan (backend=None)")
+    return backend
 
 
 def sp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 axis_name: Optional[str] = None, slope: float = 0.2,
-                activation: str = "sigmoid",
-                ln_eps: float = 1e-3,
-                microbatches: Optional[int] = None,
-                backend: str = "xla",
-                manual: bool = False,
-                tp_axis: Optional[str] = None,
-                remat: bool = False) -> jnp.ndarray:
-    """The FULL MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
-    Dense, :class:`hfrep_tpu.models.generators.LSTMGenerator`) with the
-    window axis sharded over ``axis_name`` — long-window synthesis
-    (W ≫ 168) on a mesh.
+                activation: str = "sigmoid", ln_eps: float = 1e-3,
+                backend: Optional[str] = None,
+                microbatches=None, manual=None, tp_axis=None,
+                remat=None, check_vma=None) -> jnp.ndarray:
+    """Window-sharded generator synthesis: the plain forward launched
+    with ``z`` (and the output) sharded (B, W@sp, F) over the mesh.
+    Long-window memory still divides across devices — the layout is
+    GSPMD's, not a hand schedule.  ``backend="pallas"`` runs the fused
+    kernels (1-device meshes — the chip oracles); the NAMED knobs of
+    the retired manual pipeline (microbatches/manual/tp_axis/remat/
+    check_vma) are accepted and ignored — anything else is a TypeError,
+    so a typo'd live kwarg fails instead of silently defaulting."""
+    del microbatches, manual, tp_axis, remat, check_vma
+    from hfrep_tpu.parallel.rules import mesh_launch
 
-    Both recurrences AND the inter-layer LayerNorm run in ONE fused
-    pipeline pass (:func:`sp_lstm2`): the LN executes chunk-wise inside
-    the shard_map body, with its params threaded through as a real
-    operand (see `_sp_ln`'s no-inner-jit note); only the head layers
-    after the second LSTM run outside under GSPMD.  The (h, c) ppermutes
-    of the two LSTMs are the only ICI traffic.  ``g_params`` is the
-    LSTMGenerator tree (``KerasLSTM_0/1``, ``KerasLayerNorm_0/1``,
-    ``KerasDense_0``); output matches the single-device
-    ``generator.apply`` to f32 round-off (tests/test_sequence.py).
+    backend = _check_backend(mesh, backend)
+    axis = _window_spec(mesh, axis_name)
+    spec = P(None, axis, None)
+    z = jax.device_put(z, NamedSharding(mesh, spec))
+    fn = mesh_launch(
+        lambda p, zz: generator_forward(p, zz, slope=slope,
+                                        activation=activation, ln_eps=ln_eps,
+                                        backend=backend),
+        mesh, in_specs=(P(), spec), out_specs=spec)
+    return fn(g_params, z)
 
-    ``manual=True`` (the dp×sp composed step, inside an enclosing
-    shard_map): the head runs un-jitted on the local chunk (its ops are
-    all per-timestep), then the full (B, W, F) windows are reassembled
-    by a masked ``psum`` — each device scatters its chunk into a zeros
-    buffer at its offset and the sum concatenates the disjoint chunks.
-    Deliberately NOT ``all_gather``: the vma type system types a
-    gather's output *varying* over ``axis_name`` even though the values
-    agree, which would (a) leak spurious sp-variance into every
-    downstream loss/carry type and (b) hide from AD that the critic's
-    later chunk-slice needs its transpose-psum — the masked psum's
-    output is typed *invariant*, making both exact automatically (the
-    gradient-penalty note in :func:`hfrep_tpu.train.steps.gradient_penalty`).
-    Costs ~2× a gather's ICI bytes on a (B, W, F) buffer — noise next to
-    the pipeline's compute.
-    """
-    axis_name = _resolve_axis(mesh, axis_name)
-    if manual:
-        x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
-                     inter=(lambda p, v: _sp_ln(p, v, ln_eps),
-                            g_params["KerasLayerNorm_0"]),
-                     axis_name=axis_name, microbatches=microbatches,
-                     activation=activation,
-                     backend=backend, manual=True, tp_axis=tp_axis,
-                     remat=remat)
-        y = _sp_head_impl(g_params, x, slope, ln_eps)   # chunk-wise head
-        wl = y.shape[1]
-        buf = jnp.zeros((y.shape[0], wl * mesh.shape[axis_name], y.shape[2]),
-                        y.dtype)
-        buf = lax.dynamic_update_slice_in_dim(
-            match_vma(buf, y), y, lax.axis_index(axis_name) * wl, axis=1)
-        return lax.psum(buf, axis_name)
-    sharding = NamedSharding(mesh, P(None, axis_name, None))
-    z = jax.device_put(z, sharding)
 
-    # both recurrences + the inter-layer LayerNorm in ONE fused pipeline
-    # pass: LN is per-timestep, so applying it chunk-wise inside the
-    # pipeline computes exactly the full-sequence result (see sp_lstm2)
-    x = sp_lstm2(g_params["KerasLSTM_0"], g_params["KerasLSTM_1"], z, mesh,
-                 inter=(lambda p, v: _sp_ln(p, v, ln_eps),
-                        g_params["KerasLayerNorm_0"]),
-                 axis_name=axis_name, microbatches=microbatches,
-                 activation=activation, backend=backend, remat=remat)
-    return _sp_head(g_params, x, slope, ln_eps)
+def sp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
+              axis_name: Optional[str] = None,
+              backend: Optional[str] = None,
+              microbatches=None, manual=None, tp_axis=None,
+              remat=None, check_vma=None) -> jnp.ndarray:
+    """Window-sharded critic scores: (B, W@sp, F) → replicated (B, 1).
+    Retired-knob handling as :func:`sp_generate`."""
+    del microbatches, manual, tp_axis, remat, check_vma
+    from hfrep_tpu.parallel.rules import mesh_launch
+
+    backend = _check_backend(mesh, backend)
+    axis = _window_spec(mesh, axis_name)
+    spec = P(None, axis, None)
+    x = jax.device_put(x, NamedSharding(mesh, spec))
+    fn = mesh_launch(lambda p, xx: critic_forward(p, xx, backend=backend),
+                     mesh, in_specs=(P(), spec), out_specs=P())
+    return fn(d_params, x)
+
+
+def sp_lstm(kernel: jnp.ndarray, recurrent: jnp.ndarray, bias: jnp.ndarray,
+            x: jnp.ndarray, mesh: Mesh, *, axis_name: Optional[str] = None,
+            activation: str = "tanh",
+            recurrent_activation: str = "sigmoid",
+            backend: Optional[str] = None,
+            microbatches=None, manual=None, tp_axis=None,
+            remat=None, check_vma=None, chunk=None) -> jnp.ndarray:
+    """One LSTM layer over (B, W@sp, F) → (B, W@sp, H).  Retired-knob
+    handling as :func:`sp_generate` (``chunk`` was the manual
+    pipeline's per-device time-block width)."""
+    del microbatches, manual, tp_axis, remat, check_vma, chunk
+    from hfrep_tpu.parallel.rules import mesh_launch
+
+    backend = _check_backend(mesh, backend)
+    axis = _window_spec(mesh, axis_name)
+    spec = P(None, axis, None)
+    params = {"kernel": kernel, "recurrent_kernel": recurrent, "bias": bias}
+    fn = mesh_launch(
+        lambda p, xx: _lstm_layer(p, xx, activation, recurrent_activation,
+                                  backend=backend),
+        mesh, in_specs=(P(), spec), out_specs=spec)
+    return fn(params, jax.device_put(x, NamedSharding(mesh, spec)))
+
+
+def sp_lstm_sharded_input(params: dict, x: jnp.ndarray, mesh: Mesh,
+                          **kw) -> jnp.ndarray:
+    """Convenience wrapper taking a KerasLSTM param dict and placing
+    ``x`` window-sharded before the launch."""
+    return sp_lstm(params["kernel"], params["recurrent_kernel"],
+                   params["bias"], x, mesh, **kw)
+
+
+def make_sp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: Optional[str] = None,
+                       microbatches: Optional[int] = None, jit: bool = True):
+    """Window-sharded MTSS-WGAN-GP training — the unified mesh launch on
+    an ``('sp',)`` mesh.  ``microbatches`` is accepted for source
+    compatibility and ignored (no pipeline schedule exists to tune)."""
+    del axis_name, microbatches
+    from hfrep_tpu.parallel.rules import make_gan_train_step
+    return make_gan_train_step(pair, tcfg, dataset, mesh, jit=jit)
+
+
+def make_sp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
+                       axis_name: Optional[str] = None,
+                       microbatches: Optional[int] = None, jit: bool = True):
+    """``tcfg.steps_per_call`` window-sharded epochs as ONE program."""
+    del axis_name, microbatches
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh, jit=jit)
+
+
+def sp_microbatch_plan(batch: int, n_dev: int, window: int = 168,
+                       hidden: int = 100,
+                       step_latency_s: float = 2e-6,
+                       mxu_flops: float = 1e14) -> dict:
+    """Analytic model of the retired pipeline's microbatch trade — kept
+    because its conclusions (latency-bound at shipped shapes, the
+    crossover at Bm* ≈ 1500 rows for Hp=128) remain the published
+    explanation of WHY the manual sp pipeline never beat the plain step
+    at these shapes, and ``tools/bench_sp_microbatch.py`` still anchors
+    the chip-measured t_step it rests on (RESULTS.md round 4)."""
+    from hfrep_tpu.ops.pallas_lstm import LANE
+
+    hp = ((hidden + LANE - 1) // LANE) * LANE
+    plans = []
+    for m in range(1, batch + 1):
+        if batch % m:
+            continue
+        bm = batch // m
+        t_step = max(step_latency_s, 8.0 * bm * hp * hp / mxu_flops)
+        t_single = window * max(step_latency_s,
+                                8.0 * batch * hp * hp / mxu_flops)
+        rel = (m + n_dev - 1) * (window / n_dev) * t_step / t_single
+        plans.append({"microbatches": m, "rows": bm,
+                      "supersteps": m + n_dev - 1,
+                      "relative_time": rel})
+    best = min(plans, key=lambda p: p["relative_time"])
+    return {"plans": plans, "recommended": best["microbatches"]}

@@ -1,89 +1,27 @@
-"""Tensor (hidden-unit) parallelism: the LSTM stack sharded over a ``tp`` axis.
+"""Tensor (hidden-unit) parallelism — GSPMD edition.
 
-The fourth and last classic mesh axis, completing the framework's
-parallel story: **dp** shards the batch (`data_parallel.py`), **sp** the
-window (`sequence.py`), **seed** the ensemble members (`multi_seed.py`)
-— **tp** shards the *model width*.  Each of the T devices on the ``tp``
-axis owns H/T of every LSTM layer's hidden units: its slice of the gate
-columns of ``kernel`` (F, 4H), ``recurrent_kernel`` (H, 4H) and ``bias``
-(4H,), and the matching (B, Hl) slice of the (h, c) state.  Per
-timestep a device computes
-
-    z_loc = xz_loc[t] + all_gather(h_loc) @ R[:, gates, own units]
-
-— the full-H contraction against its own 4·Hl gate columns — and
-updates its (h, c) slice elementwise.  The single ``all_gather`` of the
-(B, Hl) hidden slices is the only per-step communication; between
-layers the full hidden sequence is reassembled ONCE by the same
-masked-psum idiom as :func:`~hfrep_tpu.parallel.sequence.sp_generate`
-(typed tp-*invariant* — an all_gather's varying output type would leak
-spurious tp-variance into every downstream loss; see that docstring).
-
-When tp pays: the per-device recurrent matmul is 8·B·H·Hl flops against
-~4·B·(H−Hl) gathered bytes, i.e. ~2·Hl flops/byte — at the production
-width (H=100) the gather dominates and tp=1 is the right call, but in
-the wide-model regime this framework measured in round 4 (H ≥ 384,
-where the fused kernels hit their 16 MB VMEM ceiling and f32/H=512
-OOM'd before the width-aware dispatch) tp divides both the recurrent
-FLOPs and the resident gate matrices by T.  tp is to *width* what sp is
-to *window length*: a capacity axis, proven trajectory-exact here and
-advisory until the model outgrows one chip.
-
-Parameters and optimizer state stay REPLICATED over ``tp`` (the
-framework-wide invariant `shard_map(check_vma=True)` proves at trace
-time): each device *slices* its gate columns inside the region, and the
-transpose of that invariant→varying slice is automatically a psum, so
-`jax.grad` hands every device the full, already-reduced parameter
-gradient — no collective code in the step, same machinery as the dp
-gradient story (`train/steps.py::_psum_if`, here with nothing left to
-normalize because no axis shards the batch).
-
-Reference anchor: the models being widened are the flagship stack
-``GAN/MTSS_WGAN_GP.py:221-252`` (two LSTM(100) layers); the reference
-has no tensor parallelism to port (SURVEY §5.8 — single device
-throughout).
-
-Backend note: the tp recurrence runs the XLA scan only.  The pallas
-kernels (`ops/pallas_lstm.py`) are whole-H single-device programs whose
-speed comes from keeping the gate matrices VMEM-resident across the
-whole traversal; a per-timestep cross-chip all_gather in the middle of
-the kernel body is exactly what they cannot express.  At tp-worthy
-widths the per-device matmuls are large enough that the XLA scan is
-MXU-bound anyway (the kernels' edge is latency at small H, RESULTS.md).
+The hand-sliced gate-column layout (``_slice_gate_params`` /
+``tp_chunk_scan`` / per-timestep all_gathers inside shard_map — dead on
+runtimes without ``jax.shard_map``) is now a PARTITION RULE: the mesh
+launch shards every LSTM layer's ``kernel``/``recurrent_kernel`` gate
+columns and ``bias`` over ``tp``
+(:data:`hfrep_tpu.parallel.rules.GAN_PARTITION_RULES`) and GSPMD lowers
+the recurrence to the same per-step hidden-state all_gather the manual
+code wrote — including through the gradient penalty's second-order
+path.  When tp pays is unchanged (a capacity axis for the wide-model
+regime; see RESULTS.md round 4) — what changed is that it is now a
+layout declaration, not 450 lines of schedule.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hfrep_tpu.parallel._compat import axis_size, shard_map
-from hfrep_tpu.ops.layers import ACTIVATIONS
-from hfrep_tpu.utils.vma import match_vma
-
-
-def _resolve_tp_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
-    """The tp axis: the axis literally named ``"tp"``, else whatever the
-    caller names explicitly.  A bare single-axis mesh named e.g.
-    ``('dp',)`` is refused rather than silently width-sharded — handing
-    the wrong mesh to a tp builder is a mix-up, not a request
-    (consistent with the trainer's name-based dispatch,
-    ``train/trainer.py:48-51``)."""
-    if axis_name is not None:
-        if axis_name not in mesh.axis_names:
-            raise ValueError(
-                f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
-        return axis_name
-    if "tp" in mesh.axis_names:
-        return "tp"
-    raise ValueError(
-        f"mesh {mesh.axis_names} has no 'tp' axis; pass axis_name "
-        f"explicitly to shard hidden units over a differently-named axis")
+from hfrep_tpu.parallel.sequence import critic_forward, generator_forward
 
 
 def _check_width(h: int, n_dev: int) -> int:
@@ -93,356 +31,111 @@ def _check_width(h: int, n_dev: int) -> int:
     return h // n_dev
 
 
-def _slice_gate_params(params: dict, t_idx, hl: int) -> dict:
-    """This tp rank's Hl unit columns of a Keras LSTM param dict, in the
-    flat gate-blocked layout ({kernel: (Fin, 4·Hl), recurrent_kernel:
-    (H, 4·Hl), bias: (4·Hl,)}).
-
-    Gate blocks stay Keras-ordered [i|f|c|o] within the sliced 4·Hl —
-    slicing each block's own-unit columns commutes with every
-    contraction.  axis_index-dependent slices type the results
-    tp-varying, which is what makes AD psum the parameter cotangents
-    back to the replicated trees at the boundary.  Shared by the tp
-    layer forward here and the sp pipeline's tp-sliced chunks
-    (:mod:`hfrep_tpu.parallel.sequence`), so the two layouts cannot
-    drift."""
-    f_in = params["kernel"].shape[0]
-    h = params["recurrent_kernel"].shape[0]
-    k = lax.dynamic_slice_in_dim(
-        params["kernel"].reshape(f_in, 4, h), t_idx * hl, hl, axis=2)
-    r = lax.dynamic_slice_in_dim(
-        params["recurrent_kernel"].reshape(h, 4, h), t_idx * hl, hl, axis=2)
-    bb = lax.dynamic_slice_in_dim(
-        params["bias"].reshape(4, h), t_idx * hl, hl, axis=1)
-    return {"kernel": k.reshape(f_in, 4 * hl),
-            "recurrent_kernel": r.reshape(h, 4 * hl),
-            "bias": bb.reshape(4 * hl)}
+def _tp_axis(mesh: Mesh, axis_name: Optional[str]) -> str:
+    if axis_name is None:
+        if "tp" not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {mesh.axis_names} has no 'tp' axis; pass axis_name "
+                f"explicitly to shard hidden units over another name")
+        return "tp"
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"axis {axis_name!r} not in mesh axes "
+                         f"{mesh.axis_names}")
+    return axis_name
 
 
-def tp_chunk_scan(xz_chunk: jnp.ndarray, carry, r_loc: jnp.ndarray,
-                  act, rec_act, tp_axis: str):
-    """Scan a (W, B, 4·Hl) pre-projected gate-slice chunk from the given
-    (B, Hl) carry slices — the tp recurrence kernel shared by the plain
-    tp layer and the sp pipeline's tp-sliced chunks.
-
-    Each timestep all_gathers the T hidden slices into the full (B, H)
-    state in unit order (device t owns columns [t·Hl, (t+1)·Hl) — tiled
-    concat order matches :func:`_slice_gate_params`'s column slicing;
-    the ONLY per-step tp communication) and contracts it against the
-    local (H, 4·Hl) recurrent columns; gate math updates the owned
-    slice elementwise, arithmetic identical to the single-device cell
-    (`ops/lstm.py::lstm_cell_step`) on those units."""
-
-    def cell(c, xz_t):
-        h_loc, c_loc = c
-        h_full = lax.all_gather(h_loc, tp_axis, axis=1, tiled=True)
-        z = xz_t + h_full @ r_loc
-        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
-        i = rec_act(zi)
-        fgt = rec_act(zf)
-        cc = fgt * c_loc + i * act(zc)
-        o = rec_act(zo)
-        h_t = o * act(cc)
-        return (h_t, cc), h_t
-
-    return lax.scan(cell, carry, xz_chunk)
-
-
-def _tp_lstm_local(params: dict, x: jnp.ndarray, axis_name: str, *,
-                   activation: Optional[str],
-                   recurrent_activation: str = "sigmoid") -> jnp.ndarray:
-    """One Keras-semantics LSTM layer, hidden units sharded over
-    ``axis_name``; runs inside a shard_map region.
-
-    ``x`` is the full (B, W, Fin) input (tp-invariant — either the raw
-    noise/window or a previous layer's reassembled sequence); returns
-    this device's LOCAL (B, W, Hl) hidden-sequence slice (tp-varying).
-    The input projection for the whole window is hoisted out of the
-    recurrence as one MXU matmul, same as the single-device path; the
-    recurrence is :func:`tp_chunk_scan` from the zero carry.
-    """
-    h = params["recurrent_kernel"].shape[0]
-    hl = _check_width(h, axis_size(axis_name))
-    act = ACTIVATIONS[activation]
-    rec_act = ACTIVATIONS[recurrent_activation]
-
-    b, w, f = x.shape
-    loc = _slice_gate_params(params, lax.axis_index(axis_name), hl)
-    # Hoisted input projection for all timesteps: (B·W, Fin) @ (Fin, 4·Hl).
-    xz = (x.reshape(b * w, f) @ loc["kernel"] + loc["bias"]).reshape(b, w, 4 * hl)
-    xz = jnp.swapaxes(xz, 0, 1)                       # time-major (W, B, 4·Hl)
-
-    # Carry slices vary over every axis the projected input does (tp
-    # always; dp too under the composed dp×tp step).
-    init = match_vma((jnp.zeros((b, hl), xz.dtype),
-                      jnp.zeros((b, hl), xz.dtype)), xz)
-    _, hs = tp_chunk_scan(xz, init, loc["recurrent_kernel"], act, rec_act,
-                          axis_name)                  # (W, B, Hl)
-    return jnp.swapaxes(hs, 0, 1)                     # (B, W, Hl)
-
-
-def _tp_assemble(y_loc: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Local (B, W, Hl) unit slices → full (B, W, H), typed tp-INVARIANT.
-
-    Masked psum, not all_gather, for the same two reasons as
-    :func:`~hfrep_tpu.parallel.sequence.sp_generate`: a gather's output
-    is typed varying even though the values agree (poisoning every
-    downstream loss type), and the psum's invariant output is what lets
-    AD see that the next layer's slice needs its transpose-psum."""
-    n_dev = axis_size(axis_name)
-    hl = y_loc.shape[-1]
-    buf = jnp.zeros(y_loc.shape[:-1] + (hl * n_dev,), y_loc.dtype)
-    buf = lax.dynamic_update_slice_in_dim(
-        match_vma(buf, y_loc), y_loc, lax.axis_index(axis_name) * hl,
-        axis=y_loc.ndim - 1)
-    return lax.psum(buf, axis_name)
-
-
-def _tp_generate_local(g_params: dict, z: jnp.ndarray, axis_name: str,
-                       slope: float, activation: str,
-                       ln_eps: float) -> jnp.ndarray:
-    """The full MTSS generator (LSTM → LN → LSTM → LeakyReLU → LN →
-    Dense) with both recurrences unit-sharded; body of
-    :func:`tp_generate` and of the tp train steps' g_apply."""
-    from hfrep_tpu.parallel.sequence import _sp_ln, _sp_head_impl
-
-    h0 = _tp_assemble(
-        _tp_lstm_local(g_params["KerasLSTM_0"], z, axis_name,
-                       activation=activation), axis_name)
-    h0 = _sp_ln(g_params["KerasLayerNorm_0"], h0, ln_eps)
-    h1 = _tp_assemble(
-        _tp_lstm_local(g_params["KerasLSTM_1"], h0, axis_name,
-                       activation=activation), axis_name)
-    # LeakyReLU → LN → Dense tail: the same head impl the sp pipeline
-    # runs (per-timestep ops on a tp-invariant sequence; un-jitted —
-    # inner jits trip the manual-mesh consistency check, see _sp_ln).
-    return _sp_head_impl(g_params, h1, slope, ln_eps)
-
-
-def _tp_critic_local(d_params: dict, x: jnp.ndarray,
-                     axis_name: str) -> jnp.ndarray:
-    """The flagship critic (LSTM → LSTM → Flatten → Dense(1)) with both
-    recurrences unit-sharded: (B, W, F) → (B, 1) tp-invariant scores.
-
-    The flattened (W·H → 1) head needs no reassembly of the second
-    layer: each device dots its (B, W, Hl) slice with its own
-    (W, Hl)-rows of the Dense kernel (flatten order is w-major, so the
-    unit slice of each timestep's block) and one psum over ``tp``
-    completes the contraction — the tp twin of
-    :func:`~hfrep_tpu.parallel.sequence.sp_critic`'s window-sliced head.
-    """
-    h0 = _tp_assemble(
-        _tp_lstm_local(d_params["KerasLSTM_0"], x, axis_name,
-                       activation="tanh"), axis_name)
-    h1_loc = _tp_lstm_local(d_params["KerasLSTM_1"], h0, axis_name,
-                            activation="tanh")
-
-    dense = d_params["KerasDense_0"]["Dense_0"]
-    bb, w, hl = h1_loc.shape
-    h = hl * axis_size(axis_name)
-    k_loc = lax.dynamic_slice_in_dim(
-        dense["kernel"].reshape(w, h, -1),
-        lax.axis_index(axis_name) * hl, hl, axis=1)       # (W, Hl, 1)
-    part = h1_loc.reshape(bb, w * hl) @ k_loc.reshape(w * hl, -1)
-    scores = lax.psum(part, axis_name)
-    if "bias" in dense:
-        scores = scores + dense["bias"]
-    return scores
+def _param_specs(params: dict, mesh: Mesh, axis: str):
+    """The canonical :data:`~hfrep_tpu.parallel.rules.
+    GAN_PARTITION_RULES` resolved over ``params`` — with the ``tp``
+    axis renamed when the caller shards over another mesh axis, so
+    extending the one rule set extends this forward too (no inline
+    copy to drift)."""
+    from hfrep_tpu.parallel.rules import (GAN_PARTITION_RULES,
+                                          match_partition_rules)
+    rules = GAN_PARTITION_RULES if axis == "tp" else tuple(
+        (pat, P(*(axis if e == "tp" else e for e in spec)))
+        for pat, spec in GAN_PARTITION_RULES)
+    return match_partition_rules(rules, params, mesh)
 
 
 def tp_generate(g_params: dict, z: jnp.ndarray, mesh: Mesh, *,
                 axis_name: Optional[str] = None, slope: float = 0.2,
                 activation: str = "sigmoid", ln_eps: float = 1e-3,
-                manual: bool = False) -> jnp.ndarray:
-    """MTSS generator forward with hidden units sharded over the tp axis
-    — output matches the single-device ``generator.apply`` to f32
-    round-off (tests/test_tensor_parallel.py).
+                manual=None, check_vma=None, chunk=None) -> jnp.ndarray:
+    """MTSS generator forward with the LSTM gate columns sharded over
+    ``tp`` — output matches the single-device apply to f32 round-off.
+    The NAMED retired manual-path knobs are accepted and ignored;
+    anything else is a TypeError (a typo'd live kwarg must not
+    silently default)."""
+    del manual, check_vma, chunk
+    from hfrep_tpu.parallel.rules import mesh_launch, shard_put
 
-    ``g_params`` is the LSTMGenerator tree (``KerasLSTM_0/1``,
-    ``KerasLayerNorm_0/1``, ``KerasDense_0``), replicated; ``z`` is the
-    full (B, W, F) noise.  ``manual=True`` runs inside an enclosing
-    shard_map region (the tp train steps)."""
-    axis_name = _resolve_tp_axis(mesh, axis_name)
-    if manual:
-        return _tp_generate_local(g_params, z, axis_name, slope,
-                                  activation, ln_eps)
-    return shard_map(
-        lambda p, zz: _tp_generate_local(p, zz, axis_name, slope,
-                                         activation, ln_eps),
-        mesh=mesh, in_specs=(P(), P()), out_specs=P(),
-        check_vma=True)(g_params, z)
+    axis = _tp_axis(mesh, axis_name)
+    for lay in ("KerasLSTM_0", "KerasLSTM_1"):
+        _check_width(g_params[lay]["recurrent_kernel"].shape[0],
+                     mesh.shape[axis])
+    specs = _param_specs(g_params, mesh, axis)
+    fn = mesh_launch(
+        lambda p, zz: generator_forward(p, zz, slope=slope,
+                                        activation=activation,
+                                        ln_eps=ln_eps),
+        mesh, in_specs=(specs, P()), out_specs=P())
+    return fn(shard_put(g_params, mesh, specs), z)
 
 
 def tp_critic(d_params: dict, x: jnp.ndarray, mesh: Mesh, *,
               axis_name: Optional[str] = None,
-              manual: bool = False) -> jnp.ndarray:
-    """Flagship critic forward with hidden units sharded over the tp
-    axis — (B, W, F) → (B, 1) scores matching the single-device
-    ``critic.apply`` to f32 round-off.  Differentiable end to end
-    (slice/psum transposes), including the gradient penalty's
-    second-order path — what tp WGAN-GP *training* needs."""
-    axis_name = _resolve_tp_axis(mesh, axis_name)
-    if manual:
-        return _tp_critic_local(d_params, x, axis_name)
-    return shard_map(
-        lambda p, xx: _tp_critic_local(p, xx, axis_name),
-        mesh=mesh, in_specs=(P(), P()), out_specs=P(),
-        check_vma=True)(d_params, x)
+              manual=None, check_vma=None, chunk=None) -> jnp.ndarray:
+    """Flagship critic forward with gate columns sharded over ``tp`` —
+    (B, W, F) → (B, 1) scores matching the single-device apply.
+    Retired-knob handling as :func:`tp_generate`."""
+    del manual, check_vma, chunk
+    from hfrep_tpu.parallel.rules import mesh_launch, shard_put
+
+    axis = _tp_axis(mesh, axis_name)
+    for lay in ("KerasLSTM_0", "KerasLSTM_1"):
+        _check_width(d_params[lay]["recurrent_kernel"].shape[0],
+                     mesh.shape[axis])
+    specs = _param_specs(d_params, mesh, axis)
+    fn = mesh_launch(critic_forward, mesh, in_specs=(specs, P()),
+                     out_specs=P())
+    return fn(shard_put(d_params, mesh, specs), x)
 
 
 def validate_tp_pair(pair, n_tp: int) -> None:
-    """The tp modules mirror the flagship LSTMGenerator/LSTMFlatCritic
-    param trees (same precondition family as
-    :func:`~hfrep_tpu.parallel.sequence.validate_sp_pair`) and need the
-    hidden width to split evenly across the tp devices."""
+    """Width-divisibility precondition shared with the unified builders."""
     if pair.family != "mtss_wgan_gp":
         raise ValueError(f"tensor-parallel step supports the "
                          f"mtss_wgan_gp family, got {pair.family!r}")
     _check_width(pair.generator.hidden, n_tp)
-    # the critic's width is sliced by the same Hl arithmetic — validate it
-    # here too so a mismatched pair fails at build, not at trace
     _check_width(pair.discriminator.hidden, n_tp)
-
-
-def _validate_tp_backend(tcfg) -> None:
-    """Same backend policy as the sp path's dtype gate: an EXPLICIT
-    pallas request must refuse (the per-step cross-chip all_gather is
-    what the fused kernels cannot express — module docstring), never
-    silently run the scan; ``'auto'`` quietly takes the scan (on a tp
-    mesh that IS the best available backend); invalid values get
-    `resolve_lstm_backend`'s usual ValueError."""
-    from hfrep_tpu.train.steps import resolve_lstm_backend
-
-    if tcfg.lstm_backend == "pallas":
-        raise NotImplementedError(
-            "tensor-parallel training runs the XLA scan recurrence: the "
-            "pallas kernels keep gate matrices VMEM-resident across the "
-            "whole traversal and cannot express the per-timestep "
-            "cross-chip all_gather; use lstm_backend='auto' or 'xla'")
-    resolve_lstm_backend(tcfg.lstm_backend)
-
-
-def _tp_apply_fns(pair, axis_name: str) -> Tuple:
-    slope = pair.generator.slope
-    g_apply = lambda p, z: _tp_generate_local(p, z, axis_name, slope,
-                                              "sigmoid", 1e-3)
-    d_apply = lambda p, x: _tp_critic_local(p, x, axis_name)
-    return g_apply, d_apply
-
-
-def _wrap_replicated(inner, mesh: Mesh, jit: bool):
-    """shard_map a fully-replicated step over the 1-D tp mesh: state,
-    key, metrics all P() — every device runs the identical epoch with
-    tp-sharded internals, and ``check_vma=True`` proves the outputs are
-    invariant (the psum'd activations/scores make every loss, gradient
-    and update provably identical across the axis)."""
-    fn = shard_map(inner, mesh=mesh, in_specs=(P(), P()),
-                   out_specs=(P(), P()), check_vma=True)
-    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
 
 
 def make_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                        axis_name: Optional[str] = None, jit: bool = True):
-    """Tensor-parallel MTSS-WGAN-GP training: one epoch (n_critic GP
-    critic updates + generator update) with every forward's hidden
-    units sharded over the tp axis, trajectory-exact vs the plain step.
-
-    All step semantics (sampling streams, critic loop, optimizer
-    updates) are shared verbatim with the single-device step via
-    ``make_train_step(apply_fns=...)`` — the same reuse contract as the
-    sp and dp×sp steps, so the three parallel modes cannot drift
-    arithmetically.  No gradient normalization is needed: nothing
-    shards the batch, and the slice-transpose psums already hand every
-    device the full parameter gradients (module docstring)."""
-    from hfrep_tpu.obs import instrument_launch
-    from hfrep_tpu.train.steps import make_train_step
-
-    axis_name = _resolve_tp_axis(mesh, axis_name)
-    validate_tp_pair(pair, mesh.shape[axis_name])
-    _validate_tp_backend(tcfg)
-    inner = make_train_step(pair, tcfg, dataset,
-                            apply_fns=_tp_apply_fns(pair, axis_name))
-    return instrument_launch(_wrap_replicated(inner, mesh, jit),
-                             "tp_train_step", mesh=mesh, tcfg=tcfg, jit=jit)
+    del axis_name
+    from hfrep_tpu.parallel.rules import make_gan_train_step
+    return make_gan_train_step(pair, tcfg, dataset, mesh, jit=jit)
 
 
 def make_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                        axis_name: Optional[str] = None, jit: bool = True):
-    """``tcfg.steps_per_call`` tp epochs scanned into ONE compiled
-    program — the dispatch-amortized launch shape (same argument as
-    :func:`~hfrep_tpu.train.steps.make_multi_step`)."""
-    from hfrep_tpu.obs import instrument_launch
-    from hfrep_tpu.train.steps import make_multi_step, make_train_step
-
-    axis_name = _resolve_tp_axis(mesh, axis_name)
-    validate_tp_pair(pair, mesh.shape[axis_name])
-    _validate_tp_backend(tcfg)
-    step = make_train_step(pair, tcfg, dataset,
-                           apply_fns=_tp_apply_fns(pair, axis_name))
-    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return instrument_launch(_wrap_replicated(inner, mesh, jit),
-                             "tp_multi_step", mesh=mesh, tcfg=tcfg, jit=jit)
-
-
-def _split_dp_tp(mesh: Mesh) -> Tuple[str, str]:
-    if tuple(mesh.axis_names) != ("dp", "tp"):
-        raise ValueError(
-            f"dp×tp composition wants a ('dp', 'tp') mesh, got {mesh.axis_names}")
-    return "dp", "tp"
-
-
-def _make_dp_tp_inner(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh,
-                      controlled_sampling: bool):
-    """Per-device epoch step for the composed dp×tp mesh: batch sharded
-    over ``dp`` (gradients dp-normalized by the existing `_psum_if` vma
-    machinery), hidden units sharded over ``tp`` — the width twin of
-    :mod:`hfrep_tpu.parallel.dp_sp`."""
-    from hfrep_tpu.train.steps import make_train_step
-
-    dp_axis, tp_axis = _split_dp_tp(mesh)
-    validate_tp_pair(pair, mesh.shape[tp_axis])
-    _validate_tp_backend(tcfg)
-    n_dp = mesh.shape[dp_axis]
-    if tcfg.batch_size % n_dp:
-        raise ValueError(
-            f"global batch {tcfg.batch_size} not divisible by dp={n_dp}")
-    local_tcfg = dataclasses.replace(tcfg,
-                                     batch_size=tcfg.batch_size // n_dp)
-    return make_train_step(
-        pair, local_tcfg, dataset, axis_name=dp_axis,
-        sample_batch=tcfg.batch_size if controlled_sampling else None,
-        apply_fns=_tp_apply_fns(pair, tp_axis))
+    del axis_name
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh, jit=jit)
 
 
 def make_dp_tp_train_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                           controlled_sampling: bool = False,
                           jit: bool = True):
-    """One dp×tp epoch on a 2-D ``('dp', 'tp')`` mesh: batch sharded
-    over dp, hidden units sharded over tp, state replicated over both
-    (proven by check_vma).  ``controlled_sampling=True`` follows the
-    single-device sample stream at the same global batch — the
-    trajectory-test mode (tests/test_tensor_parallel.py)."""
-    from hfrep_tpu.obs import instrument_launch
-    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
-
-    inner = _make_dp_tp_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    return instrument_launch(
-        wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit),
-        "dp_tp_train_step", mesh=mesh, tcfg=tcfg, jit=jit)
+    del controlled_sampling
+    from hfrep_tpu.parallel.rules import make_gan_train_step
+    return make_gan_train_step(pair, tcfg, dataset, mesh, jit=jit)
 
 
 def make_dp_tp_multi_step(pair, tcfg, dataset: jnp.ndarray, mesh: Mesh, *,
                           controlled_sampling: bool = False,
                           jit: bool = True):
-    """``tcfg.steps_per_call`` dp×tp epochs scanned into ONE compiled
-    program — the launch shape for real runs (the trainer dispatches
-    this from its ordinary block loop)."""
-    from hfrep_tpu.obs import instrument_launch
-    from hfrep_tpu.parallel.data_parallel import wrap_batch_parallel
-    from hfrep_tpu.train.steps import make_multi_step
-
-    step = _make_dp_tp_inner(pair, tcfg, dataset, mesh, controlled_sampling)
-    inner = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
-    return instrument_launch(
-        wrap_batch_parallel(inner, mesh, "dp", controlled_sampling, jit),
-        "dp_tp_multi_step", mesh=mesh, tcfg=tcfg, jit=jit)
+    del controlled_sampling
+    from hfrep_tpu.parallel.rules import make_gan_multi_step
+    return make_gan_multi_step(pair, tcfg, dataset, mesh, jit=jit)

@@ -258,27 +258,53 @@ _PROGRAM_CACHE: dict = {}
 _PROFILED_PROGRAMS: set = set()
 
 
-def _cached_program(cfg: AEConfig, kind: str, build):
+def _cached_program(cfg: AEConfig, kind: str, build, mesh=None):
     # the health flag changes the traced program's OUTPUT arity (extra
     # grad-norm/nonfinite traces), so it must key the cache: a test that
     # toggles health between drives must not replay the other mode's
-    # compiled program
+    # compiled program.  The mesh keys it too (jax.sharding.Mesh is
+    # hashable): a dp-sharded chunk program and the single-device one
+    # are different executables even though they trace the same jaxpr.
     key = (dataclasses.astuple(cfg), kind,
-           bool(health_mod.active()))
+           bool(health_mod.active()), mesh)
     fn = _PROGRAM_CACHE.get(key)
     if fn is None:
         fn = _PROGRAM_CACHE[key] = build()
     return fn
 
 
-def _chunk_fn(cfg: AEConfig, kind: str):
+def _lane_specs(kind: str):
+    """PartitionSpec layout of one chunk/init dispatch, per drive kind:
+    ``(lane_prefix, keys, xs, masks, rows_info)``.  The lane grid's
+    leading axis — L latent lanes (``lanes``) or D datasets (``multi``)
+    — shards over ``dp``; the grid is embarrassingly parallel (each lane
+    is an independent training), so GSPMD splits the vmap with ZERO
+    collectives and the sharded run is BIT-identical to the single
+    device's (pinned).  ``single`` has no lane axis: replicated.  The
+    lane layout itself is the one declaration
+    :data:`~hfrep_tpu.parallel.rules.AE_LANE_SPEC` (whose rule form is
+    pinned against the real engine carry in tests/test_mesh_rules.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from hfrep_tpu.parallel.rules import AE_LANE_SPEC as lane
+    if kind == "lanes":
+        return lane, lane, P(), lane, P()
+    if kind == "multi":
+        return lane, lane, lane, P(), lane
+    return P(), P(), P(), P(), P()
+
+
+def _chunk_fn(cfg: AEConfig, kind: str, mesh=None):
     """The jitted ``chunk_epochs``-long scan program for one drive kind:
     ``single`` (one lane), ``lanes`` (L vmapped latent lanes over one —
     dense or padded — dataset), ``multi`` (D×L lanes over stacked padded
     datasets).  Signature is uniform — ``fn(carry, keys, xs, masks,
     rows_info)``, with ``masks``/``rows_info`` None on the paths that
     lack them — so :func:`_drive_chunks` stays one host loop for all
-    three."""
+    three.  With ``mesh`` the program dispatches through
+    :func:`~hfrep_tpu.parallel.rules.mesh_launch` with the lane grid
+    sharded over ``dp`` (ROADMAP item 1's multi-chip sweep fabric);
+    without, the plain jit — identical jaxpr either way."""
     def build():
         if kind == "single":
             def run(carry, keys, xs, masks, rows_info):
@@ -302,15 +328,23 @@ def _chunk_fn(cfg: AEConfig, kind: str):
                 return jax.vmap(dataset)(carry, keys, xs, rows_info)
         else:
             raise ValueError(f"unknown chunk program kind {kind!r}")
+        if mesh is not None:
+            from hfrep_tpu.parallel.rules import mesh_launch
+            lane, keys_s, xs_s, masks_s, rows_s = _lane_specs(kind)
+            return mesh_launch(run, mesh,
+                               in_specs=(lane, keys_s, xs_s, masks_s, rows_s),
+                               out_specs=lane,
+                               donate_argnums=_donate_argnums())
         return jax.jit(run, donate_argnums=_donate_argnums())
-    return _cached_program(cfg, f"chunk:{kind}", build)
+    return _cached_program(cfg, f"chunk:{kind}", build, mesh=mesh)
 
 
-def _init_program(cfg: AEConfig, kind: str, n_lanes: int = 0):
+def _init_program(cfg: AEConfig, kind: str, n_lanes: int = 0, mesh=None):
     """The jitted initial-carry program matching :func:`_chunk_fn`'s
     kind: ``fn(keys, xs)`` with ``keys`` one PRNG key per lane (single:
     one key; multi: one per dataset, split into ``n_lanes`` latent lanes
-    inside)."""
+    inside).  With ``mesh`` the returned carry comes back already
+    lane-sharded, so the first chunk dispatch moves nothing."""
     def build():
         if kind == "single":
             def run(keys, xs):
@@ -326,8 +360,13 @@ def _init_program(cfg: AEConfig, kind: str, n_lanes: int = 0):
                 return jax.vmap(dataset)(keys, xs)
         else:
             raise ValueError(f"unknown init program kind {kind!r}")
+        if mesh is not None:
+            from hfrep_tpu.parallel.rules import mesh_launch
+            lane, keys_s, xs_s, _, _ = _lane_specs(kind)
+            return mesh_launch(run, mesh, in_specs=(keys_s, xs_s),
+                               out_specs=lane)
         return jax.jit(run)
-    return _cached_program(cfg, f"init:{kind}:{n_lanes}", build)
+    return _cached_program(cfg, f"init:{kind}:{n_lanes}", build, mesh=mesh)
 
 
 def _rows_info(cfg: AEConfig, n_rows) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -344,7 +383,7 @@ def _rows_info(cfg: AEConfig, n_rows) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
                  lanes: int, n_lanes_init: int = 0,
-                 resume_dir: Optional[str] = None,
+                 resume_dir: Optional[str] = None, mesh=None,
                  ) -> Tuple[AEResult, ChunkStats]:
     """The shared drive tail of every chunked public entry point: init
     carry, dispatch chunks until ``all(stopped)``, assemble the
@@ -361,7 +400,38 @@ def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
     bit-identical final results (the snapshot fingerprint refuses
     foreign state).  The per-chunk snapshot costs one carry
     ``device_get`` + atomic write per boundary, so it is opt-in.
+
+    ``mesh`` (a ``('dp',)`` mesh, :func:`~hfrep_tpu.parallel.rules.
+    build_mesh`/``lane_mesh``) dispatches every chunk through the
+    unified pjit launch with the lane grid's leading axis sharded over
+    ``dp``: operands are placed once via the shard fns, results are
+    BIT-identical to the meshless drive (independent lanes — no
+    cross-lane reduction exists to reorder; pinned), and snapshots/
+    resume work unchanged (carries restored host-side reshard on the
+    next dispatch).  The snapshot fingerprint deliberately excludes the
+    mesh — a drive may resume on a different device count.
     """
+    if mesh is not None:
+        if "dp" not in mesh.axis_names:
+            raise ValueError(f"chunked drive wants a mesh with a 'dp' "
+                             f"axis, got {mesh.axis_names}")
+        n_dp = int(mesh.shape["dp"])
+        lane_rows = {"lanes": lanes, "multi": int(xs.shape[0]),
+                     "single": 1}[kind]
+        if kind != "single" and lane_rows % n_dp:
+            raise ValueError(
+                f"lane axis of size {lane_rows} not divisible by the "
+                f"dp={n_dp} mesh (pick a divisor — "
+                f"hfrep_tpu.parallel.rules.lane_mesh does)")
+        from hfrep_tpu.parallel.rules import make_shard_and_gather_fns
+        _, keys_s, xs_s, masks_s, rows_s = _lane_specs(kind)
+        shard_keys, _ = make_shard_and_gather_fns(mesh, keys_s)
+        shard_xs, _ = make_shard_and_gather_fns(mesh, xs_s)
+        shard_masks, _ = make_shard_and_gather_fns(mesh, masks_s)
+        shard_rows, _ = make_shard_and_gather_fns(mesh, rows_s)
+        keys, xs = shard_keys(keys), shard_xs(xs)
+        masks = shard_masks(masks) if masks is not None else None
+        rows_info = shard_rows(rows_info) if rows_info is not None else None
     snap = None
     if resume_dir is not None:
         from hfrep_tpu.resilience.snapshot import ChunkSnapshot, digest_arrays
@@ -372,13 +442,14 @@ def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
             # resume must not adopt a health-off snapshot (or vice versa)
             "health": bool(health_mod.active()),
             "operands": digest_arrays(keys, xs, masks, rows_info)})
-    carry, epoch_keys = _init_program(cfg, kind, n_lanes_init)(keys, xs)
-    fn = _chunk_fn(cfg, kind)
+    carry, epoch_keys = _init_program(cfg, kind, n_lanes_init,
+                                      mesh=mesh)(keys, xs)
+    fn = _chunk_fn(cfg, kind, mesh=mesh)
     from hfrep_tpu.obs import attrib as attrib_mod
     from hfrep_tpu.obs import get_obs
     obs = get_obs()
     profile_key = (((dataclasses.astuple(cfg), kind,
-                     bool(health_mod.active())), str(obs.run_dir))
+                     bool(health_mod.active()), mesh), str(obs.run_dir))
                    if obs.enabled else None)
     if obs.enabled and profile_key not in _PROFILED_PROGRAMS:
         # fingerprint the chunk program against the first dispatch's
@@ -655,6 +726,7 @@ def sweep_autoencoders(key: jax.Array, x_train_scaled: jnp.ndarray, cfg: AEConfi
 def sweep_autoencoders_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
                                cfg: AEConfig, latent_dims: Sequence[int],
                                resume_dir: Optional[str] = None,
+                               mesh=None,
                                ) -> Tuple[AEResult, ChunkStats]:
     """:func:`sweep_autoencoders` as a chunked early-exit drive.
 
@@ -671,7 +743,8 @@ def sweep_autoencoders_chunked(key: jax.Array, x_train_scaled: jnp.ndarray,
     masks = jnp.stack([latent_mask(d, max_latent) for d in latent_dims])
     lane_keys = jax.random.split(key, len(latent_dims))
     return _run_chunked(cfg, "lanes", lane_keys, x_train_scaled, masks, None,
-                        lanes=len(latent_dims), resume_dir=resume_dir)
+                        lanes=len(latent_dims), resume_dir=resume_dir,
+                        mesh=mesh)
 
 
 # ------------------------------------------- padded multi-dataset sweep
@@ -695,6 +768,7 @@ def sweep_autoencoders_padded(key: jax.Array, x_pad: jnp.ndarray,
                               n_rows, cfg: AEConfig,
                               latent_dims: Sequence[int],
                               resume_dir: Optional[str] = None,
+                              mesh=None,
                               ) -> Tuple[AEResult, ChunkStats]:
     """One padded dataset's latent sweep — the serial unit
     :func:`sweep_autoencoders_multi` batches across datasets.  ``x_pad``
@@ -708,13 +782,14 @@ def sweep_autoencoders_padded(key: jax.Array, x_pad: jnp.ndarray,
     lane_keys = jax.random.split(key, len(latent_dims))
     return _run_chunked(cfg, "lanes", lane_keys, x_pad, masks,
                         _rows_info(cfg, n_rows), lanes=len(latent_dims),
-                        resume_dir=resume_dir)
+                        resume_dir=resume_dir, mesh=mesh)
 
 
 def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
                              n_rows: jnp.ndarray, cfg: AEConfig,
                              latent_dims: Sequence[int],
                              resume_dir: Optional[str] = None,
+                             mesh=None,
                              ) -> Tuple[AEResult, ChunkStats]:
     """The cross-dataset sweep fabric: every (dataset, latent) pair as one
     vmapped chunked program.
@@ -724,10 +799,11 @@ def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
     ``n_rows`` their true row counts; the result's arrays lead with a
     ``(D, L)`` lane grid.  Replaces K+1 serial sweeps with ONE program —
     and the chunked early exit only keeps dispatching while *some* lane
-    anywhere in the grid is still training.  Shard the leading dataset
-    axis over ``dp`` by ``jax.device_put``-ing ``x_stack``/``n_rows``
-    with a NamedSharding before calling (the jitted chunk program follows
-    its operand shardings).
+    anywhere in the grid is still training.  ``mesh`` (a ``('dp',)``
+    mesh; :func:`hfrep_tpu.parallel.rules.lane_mesh` picks a divisor
+    size) shards the leading dataset axis over ``dp`` through the
+    unified pjit launch — the multi-chip dp mode of the sweep fabric,
+    bit-identical to the meshless drive (pinned).
     """
     max_latent = max(latent_dims)
     cfg = dataclasses.replace(cfg, latent_dim=max_latent)
@@ -737,7 +813,8 @@ def sweep_autoencoders_multi(key: jax.Array, x_stack: jnp.ndarray,
     return _run_chunked(cfg, "multi", dkeys, x_stack, masks,
                         _rows_info(cfg, n_rows),
                         lanes=int(x_stack.shape[0]) * n_lanes,
-                        n_lanes_init=n_lanes, resume_dir=resume_dir)
+                        n_lanes_init=n_lanes, resume_dir=resume_dir,
+                        mesh=mesh)
 
 
 def sweep_item_arrays(key: jax.Array, panel, cfg: AEConfig,

@@ -170,6 +170,37 @@ def _run_ae_multi(out: Path, fixture_seed: int, resume: bool) -> dict:
     return {"chunks": int(stats.chunks_dispatched)}
 
 
+@_register("ae_mesh", timeout=75.0,
+           hint_sites=("chunk", "snapshot_save", "snapshot", "result_save",
+                       "obs_append"))
+def _run_ae_mesh(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The padded multi-dataset fabric dispatched through the unified
+    partition-rule mesh launch (ISSUE 15) on a 1×1 ``('dp',)`` mesh —
+    the pjit dispatch path under the same kill→resume / exit-contract /
+    atomic-artifact oracles as the plain drive.  A 1×1 mesh runs the
+    identical program (pinned), so the oracle reference stays the
+    meshless undisturbed run."""
+    import jax
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.parallel.rules import MeshSpec, build_mesh
+    from hfrep_tpu.replication.engine import (
+        stack_padded,
+        sweep_autoencoders_multi,
+    )
+
+    a = _panel(36, 4, fixture_seed, salt=2)
+    stack, rows = stack_padded([a, a[:28]])
+    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
+                   patience=2, seed=fixture_seed, chunk_epochs=2)
+    res, stats = sweep_autoencoders_multi(
+        jax.random.PRNGKey(fixture_seed + 1), stack, rows, cfg, [1, 2],
+        resume_dir=str(out / "scratch" / "resume"),
+        mesh=build_mesh(MeshSpec(dp=1), devices=jax.devices()[:1]))
+    _write_npz_artifact(out, "multi", _result_arrays(res))
+    return {"chunks": int(stats.chunks_dispatched)}
+
+
 @_register("gan_ckpt", timeout=120.0,
            hint_sites=("block", "ckpt_save", "ckpt", "obs_append",
                        "manifest", "result_save"))
@@ -401,31 +432,45 @@ def subject_main(name: str, out_dir: str, fixture_seed: int,
     # (corpus entry; the drives' own graceful_drain entries nest).
     with resilience.graceful_drain():
         code = 0
-        with obs_pkg.session(out / "obs", command=f"chaos:{name}",
-                             chaos={"subject": name,
-                                    "fixture_seed": fixture_seed,
-                                    "resume": resume}):
-            try:
-                with resilience.watchdog(subject.timeout,
-                                         f"chaos subject {name}"):
-                    invariants = subject.run(out, fixture_seed, resume)
-            except resilience.Preempted as e:
-                from hfrep_tpu.obs.crash import bundle_if_enabled
-                bundle_if_enabled(e)   # drain forensics, like every CLI
-                print(f"chaos subject {name}: {e}", file=sys.stderr)
-                code = 75
-            except OSError as e:
-                # persistent storage failure: an I/O error that
-                # outlasted the bounded retry policy at a REQUIRED
-                # write (artifacts, checkpoints a drive cannot proceed
-                # without).  Typed exit 74 (EX_IOERR) — never a
-                # traceback; the oracle accepts it only on attempts
-                # whose own schedule armed io_fail
-                from hfrep_tpu.obs.crash import bundle_if_enabled
-                bundle_if_enabled(e)
-                print(f"chaos subject {name}: storage failed "
-                      f"persistently: {e}", file=sys.stderr)
-                code = EXIT_IO
+        try:
+            with obs_pkg.session(out / "obs", command=f"chaos:{name}",
+                                 chaos={"subject": name,
+                                        "fixture_seed": fixture_seed,
+                                        "resume": resume}):
+                try:
+                    with resilience.watchdog(subject.timeout,
+                                             f"chaos subject {name}"):
+                        invariants = subject.run(out, fixture_seed, resume)
+                except resilience.Preempted as e:
+                    from hfrep_tpu.obs.crash import bundle_if_enabled
+                    bundle_if_enabled(e)   # drain forensics, like every CLI
+                    print(f"chaos subject {name}: {e}", file=sys.stderr)
+                    code = 75
+                except OSError as e:
+                    # persistent storage failure: an I/O error that
+                    # outlasted the bounded retry policy at a REQUIRED
+                    # write (artifacts, checkpoints a drive cannot proceed
+                    # without).  Typed exit 74 (EX_IOERR) — never a
+                    # traceback; the oracle accepts it only on attempts
+                    # whose own schedule armed io_fail
+                    from hfrep_tpu.obs.crash import bundle_if_enabled
+                    bundle_if_enabled(e)
+                    print(f"chaos subject {name}: storage failed "
+                          f"persistently: {e}", file=sys.stderr)
+                    code = EXIT_IO
+        except OSError as e:
+            # the SESSION boundary itself died of storage: enable()'s
+            # initial write_manifest raised through the bounded retry
+            # (an EIO burst at the manifest site before the drive even
+            # started), or the close-path flush did.  Same contract as
+            # a required-write failure in the body — typed 74, never a
+            # traceback.  Found by the seeded soak (corpus entry 007):
+            # the body-level handler above cannot see it because the
+            # `with session` line sits outside its try
+            print(f"chaos subject {name}: telemetry storage failed "
+                  f"persistently at the session boundary: {e}",
+                  file=sys.stderr)
+            code = EXIT_IO
         if code:
             return code
     from hfrep_tpu.utils.checkpoint import atomic_text

@@ -110,7 +110,7 @@ def _fingerprint(spec: WalkForwardSpec, cfg: AEConfig,
 
 def _train_grid(key, x, spec: WalkForwardSpec, cfg: AEConfig,
                 latent_dims: Sequence[int],
-                resume_dir: Optional[str] = None):
+                resume_dir: Optional[str] = None, mesh=None):
     """Train every (window, latent) lane as ONE padded program.
 
     Expanding prefixes are MinMax-scaled each with their OWN train-set
@@ -140,7 +140,7 @@ def _train_grid(key, x, spec: WalkForwardSpec, cfg: AEConfig,
     x_stack, n_rows = stack_padded(prefixes)
     res, stats = sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
                                           list(latent_dims),
-                                          resume_dir=resume_dir)
+                                          resume_dir=resume_dir, mesh=mesh)
     return res, stats, n_rows
 
 
@@ -221,7 +221,7 @@ def _make_window_eval(cfg: AEConfig):
 def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
                     latent_dims: Sequence[int], out_dir,
                     resume: bool = False,
-                    key=None) -> dict:
+                    key=None, mesh=None) -> dict:
     """The full drive: batched padded training → per-window scoring →
     surface assembly.  Returns ``{"surface_post", "surface_ante",
     "manifest", "stats"}``; raises
@@ -273,7 +273,7 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
         resume_root.mkdir(parents=True, exist_ok=True)
         grid, stats, _ = _train_grid(
             key, x, spec, cfg, latent_dims,
-            resume_dir=str(resume_root / "chunks"))
+            resume_dir=str(resume_root / "chunks"), mesh=mesh)
         try:
             _save_grid(resume_root / TRAINED_GRID, grid, fingerprint)
         except OSError as e:

@@ -87,30 +87,29 @@ def make_seed_sharded_step(pair, tcfg, dataset: jnp.ndarray, mesh, jit: bool = T
 
 
 def _seed_shard(step, mesh, jit: bool = True):
-    """shard_map a per-member ``step(state, key)`` over the ``('seed',)``
-    mesh — the member axis is purely spatial (no collectives), so the
-    wrapper is the same for a multi-epoch block and a single epoch (the
-    trainer's remainder path must shard the RAW step, not a
-    steps_per_call=1 block: the block scan folds the key per epoch,
-    a different stream than the standalone remainder epoch consumes).
+    """Launch a per-member ``step(state, key)`` with the stacked member
+    axis sharded over the ``('seed',)`` mesh — the member axis is purely
+    spatial (no collectives), so the wrapper is the same for a
+    multi-epoch block and a single epoch (the trainer's remainder path
+    must shard the RAW step, not a steps_per_call=1 block: the block
+    scan folds the key per epoch, a different stream than the standalone
+    remainder epoch consumes).
 
-    ``shard_map`` comes through the one guarded gate
-    (:mod:`hfrep_tpu.parallel._compat`): runtimes without it (this
-    image's jax) can still use the vmap path and the checkpoint/resume
-    machinery — only seed-sharded execution needs it, and it fails
-    typed (:class:`~hfrep_tpu.parallel._compat.ShardMapUnavailable`)
-    right here instead of an ImportError.
-    """
-    from hfrep_tpu.parallel._compat import shard_map
+    Since the mesh refactor (ROADMAP item 1) this is the unified pjit
+    launch — ``vmap`` over members with the leading axis
+    sharding-pinned, GSPMD placing K/n members per device — and it runs
+    on every JAX version (the old ``shard_map`` region was dead on this
+    image's jax)."""
+    from hfrep_tpu.parallel.rules import mesh_launch
     (axis,) = mesh.axis_names
 
-    def per_device(states, keys):
-        return jax.vmap(step)(states, keys)
-
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)),
-                   check_vma=True)
-    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
+    fn = jax.vmap(step)
+    if not jit:
+        return fn
+    return mesh_launch(fn, mesh,
+                       in_specs=(P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis)),
+                       donate_argnums=(0,))
 
 
 class MultiSeedTrainer:

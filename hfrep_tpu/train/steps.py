@@ -36,8 +36,12 @@ Loss semantics, derived from (not translated from) the reference graphs:
   (B, 1, 1), fixing the reference's hard-coded batch-32 α shape
   (``GAN/MTSS_WGAN_GP.py:198``).
 
-All steps optionally `lax.psum` gradients over a named mesh axis for
-data parallelism (see :mod:`hfrep_tpu.parallel`).
+Parallel execution is layout, not semantics: the mesh launch path
+(:mod:`hfrep_tpu.parallel.rules`) runs this very step as a GLOBAL
+program under ``pjit`` — the optional ``shard_data`` hook annotates the
+sampled batch/noise/α tensors with sharding constraints and GSPMD
+derives every collective.  With the hook absent (the default) the
+traced program is the literal single-device graph.
 """
 
 from __future__ import annotations
@@ -49,12 +53,10 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from hfrep_tpu.utils.jax_compat import axis_size
 from hfrep_tpu.config import TrainConfig
 from hfrep_tpu.models.registry import GanPair
 from hfrep_tpu.obs import health as health_mod
 from hfrep_tpu.train.states import GanState, make_optimizers
-from hfrep_tpu.utils.vma import match_vma
 
 Metrics = dict
 
@@ -90,56 +92,6 @@ def _health_metrics(state0: GanState, state1: GanState, g_grads,
     }
 
 
-def _psum_if(axis_name: Optional[str], grads, loss):
-    """Per-shard gradients → global-batch-mean gradients.
-
-    Under `shard_map(check_vma=True)`'s type system the backward pass may
-    have *already* cross-device-summed a gradient leaf: replicated params
-    are implicitly pcast into the varying batch at every mixing op, and
-    the transpose of that broadcast is a psum — `jax.grad` of a shard-mean
-    loss w.r.t. replicated params then returns Σ_d ∂(shard-mean), typed
-    *invariant*.  Custom-vjp paths (the pallas LSTM kernels) return their
-    hand-computed per-device cotangents instead, typed *varying*.  Each
-    leaf's vma says exactly which case it is: varying leaves need the
-    explicit pmean, invariant leaves only the axis-size division.  (A
-    blanket pmean would be an identity on already-invariant leaves and
-    leave those gradients n_dev× too large — masked by Adam/RMSprop's
-    scale invariance except through eps, but wrong; the dp-vs-single
-    trajectory test pins both cases.)
-
-    ``loss`` is the per-device scalar the gradients came from; it is
-    consulted only as a canary: it depends on per-device data, so under
-    the required ``check_vma=True`` typing it is always *varying*.  If
-    its vma is empty the step is being traced in an SPMD context without
-    vma typing (``check_vma=False`` shard_map, pmap), where the
-    invariant-leaf division would silently shrink unsummed gradients by
-    n_dev — refuse loudly instead.
-    """
-    if axis_name is None:
-        return grads
-    from hfrep_tpu.utils.vma import vma_of
-    n = axis_size(axis_name)
-    if n > 1 and axis_name not in vma_of(loss):
-        # On a >1 mesh the loss always varies under check_vma=True typing
-        # (it depends on per-device data); an empty vma means the typing
-        # is absent and the division below would mis-scale.  n == 1 is
-        # exempt: g/1 is the identity, and a dp=1 controlled-sampling
-        # trace legitimately has an invariant loss (_shard is the
-        # identity there).
-        raise ValueError(
-            f"axis {axis_name!r} (size {n}) carries no vma on the loss: "
-            "the train step's gradient normalization requires "
-            "shard_map(check_vma=True); running it under pmap or "
-            "check_vma=False would silently mis-scale gradients")
-
-    def norm(g):
-        if axis_name in vma_of(g):
-            return lax.pmean(g, axis_name)      # per-device grad → mean
-        return g / n                            # AD already psum'd
-
-    return jax.tree_util.tree_map(norm, grads)
-
-
 def _bce_logits(logits: jnp.ndarray, label: float) -> jnp.ndarray:
     """Binary cross-entropy from logits against a constant broadcast label."""
     return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, jnp.full_like(logits, label)))
@@ -165,14 +117,11 @@ def gradient_penalty(d_apply: Callable, d_params, interp: jnp.ndarray) -> jnp.nd
     — on a bf16 policy they are what keeps the penalty's second-order
     signal out of bf16's 8-bit mantissa.
 
-    Works unchanged inside the manual dp×sp region
-    (:mod:`hfrep_tpu.parallel.dp_sp`): there ``d_apply`` slices its own
-    window chunk from the sp-invariant interpolates, and the transpose
-    of that implicit invariant→varying cast is a psum over ``sp`` — so
-    this `jax.grad` already returns the FULL-window input gradient on
-    every device, provided the inputs are honestly typed sp-invariant
-    (why the manual generator reassembles windows via masked psum, not
-    all_gather: see :func:`hfrep_tpu.parallel.sequence.sp_generate`).
+    Under the mesh launch path the interpolates inherit the sampled
+    tensors' dp/sp sharding constraints and GSPMD transposes the
+    partitioned second-order path automatically — no manual collective
+    reasoning survives here (it used to; see the git history of the
+    shard_map-era dp×sp region).
     """
     grads = jax.grad(
         lambda x: jnp.sum(d_apply(d_params, x).astype(jnp.float32)))(interp)
@@ -192,27 +141,24 @@ def resolve_lstm_backend(choice: str) -> str:
 
 
 def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                    axis_name: Optional[str] = None,
-                    sample_batch: Optional[int] = None,
-                    apply_fns: Optional[Tuple[Callable, Callable]] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
+                    apply_fns: Optional[Tuple[Callable, Callable]] = None,
+                    shard_data: Optional[Callable] = None) -> Callable[[GanState, jax.Array], Tuple[GanState, Metrics]]:
     """Build ``step(state, key) -> (state, metrics)`` for one epoch.
 
     ``apply_fns=(g_apply, d_apply)`` overrides how the generator/critic
     are evaluated while keeping every other step semantic (sampling
-    streams, critic loop, GP, optimizer updates) — how the
-    sequence-parallel long-window step reuses this machinery with
-    window-sharded forward passes
-    (:func:`hfrep_tpu.parallel.sequence.make_sp_train_step`).
+    streams, critic loop, GP, optimizer updates) — how the layer
+    pipeline reuses this machinery with depth-split forward passes
+    (:func:`hfrep_tpu.parallel.layer_pipeline.make_pp_train_step`).
 
-    ``sample_batch`` (> ``tcfg.batch_size``, dp only) switches to
-    *controlled global sampling*: every device draws the identical
-    ``sample_batch``-row batch/noise/α with the shared key and then takes
-    its own ``batch``-row shard by mesh position.  With pmean'd gradients
-    this makes a dp=N run consume exactly the same sample stream as a
-    single-device run at ``batch_size=sample_batch`` — the basis of the
-    dp-vs-single-device trajectory equivalence test.  Default (None) is
-    i.i.d. per-device sampling: same semantics at global-batch
-    granularity, no duplicated sampling work.
+    ``shard_data`` (:func:`hfrep_tpu.parallel.rules.data_constraint`) is
+    the mesh launch path's LAYOUT hook: ``shard_data(x, batch_axis)``
+    annotates each sampled batch/noise/α tensor with a sharding
+    constraint so GSPMD splits the batch over ``dp`` (and the window
+    over ``sp``) — values are untouched, every epoch still consumes the
+    exact single-device sample stream, which is why a mesh run follows
+    the single-device trajectory at the same global batch and key.
+    ``None`` (the default) traces the literal single-device program.
     """
     g_tx, d_tx = make_optimizers(pair, tcfg)
     # Flight-recorder health (hfrep_tpu/obs/health.py): decided at BUILD
@@ -240,42 +186,28 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         g_apply = lambda p, z, backend=be: pair.generator.apply({"params": p}, z, backend=backend)
         d_apply = lambda p, x, backend=be: pair.discriminator.apply({"params": p}, x, backend=backend)
     batch = tcfg.batch_size
-    sample_b = sample_batch if sample_batch is not None else batch
-    if sample_b != batch and axis_name is None:
-        raise ValueError("sample_batch != batch_size requires a mesh axis")
     window, features = dataset.shape[1], dataset.shape[2]
-    noise_shape = (sample_b, window, features)
+    noise_shape = (batch, window, features)
 
-    def _shard(x):
-        """Global (sample_b, …) tensor → this device's (batch, …) rows."""
-        if sample_b == batch:
-            return x
-        n = axis_size(axis_name)    # static at trace time
-        if sample_b != batch * n:
-            raise ValueError(
-                f"sample_batch={sample_b} must equal batch_size={batch} × "
-                f"axis_size={n}; dynamic_slice would silently clamp "
-                "out-of-range shards onto duplicated rows")
-        i = lax.axis_index(axis_name)
-        return lax.dynamic_slice_in_dim(x, i * batch, batch, axis=0)
+    def _hint(x, batch_axis: int = 0):
+        """The mesh layout hook — the literal identity when no mesh is
+        launching this step (shard_data None), so the default jaxpr is
+        the exact single-device program (pinned)."""
+        return x if shard_data is None else shard_data(x, batch_axis)
 
     def _real(key):
-        return _shard(_sample_real(key, dataset, sample_b))
+        return _hint(_sample_real(key, dataset, batch))
 
     def _noise(key):
-        return _shard(jax.random.normal(key, noise_shape))
+        return _hint(jax.random.normal(key, noise_shape))
 
     def _alpha(key):
-        return _shard(jax.random.uniform(key, (sample_b, 1, 1)))
+        return _hint(jax.random.uniform(key, (batch, 1, 1)))
 
     def _loop_init(key):
-        """Initial d_loss carry for the critic fori_loops, cast to the
-        per-device variance the loop body will produce: the body's loss
-        varies over the mesh through the folded key (i.i.d. mode) or
-        through the axis_index batch shard (controlled mode), so the plain
-        zeros init must be pre-cast for `shard_map(check_vma=True)`."""
-        probe = match_vma(_shard(jnp.zeros((sample_b,))), key)
-        return match_vma(jnp.zeros(()), probe)
+        """Initial d_loss carry for the critic fori_loops."""
+        del key
+        return jnp.zeros(())
 
     def d_update(d_params, d_opt, loss_fn):
         """Returns ``(params, opt, loss, aux, grads)`` — the gradient
@@ -283,13 +215,11 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         health is off nothing consumes it and XLA's DCE sees the exact
         pre-health graph (the grads already exist for the update)."""
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(d_params)
-        grads = _psum_if(axis_name, grads, loss)
         updates, d_opt = d_tx.update(grads, d_opt, d_params)
         return optax.apply_updates(d_params, updates), d_opt, loss, aux, grads
 
     def g_update(state: GanState, loss_fn):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.g_params)
-        grads = _psum_if(axis_name, grads, loss)
         updates, g_opt = g_tx.update(grads, state.g_opt, state.g_params)
         return state.replace(g_params=optax.apply_updates(state.g_params, updates),
                              g_opt=g_opt, step=state.step + 1), loss, grads
@@ -349,12 +279,14 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         ks = [jax.random.split(jax.random.fold_in(key, i), 3 if with_alpha else 2)
               for i in range(tcfg.n_critic)]
         k_idx = jnp.stack([k[0] for k in ks])
-        noises = jnp.stack([_noise(k[1]) for k in ks])   # (n_critic, B, W, F)
+        noises = _hint(jnp.stack([_noise(k[1]) for k in ks]),
+                       batch_axis=1)                     # (n_critic, B, W, F)
         n, b = noises.shape[0], noises.shape[1]
         fakes = lax.stop_gradient(
-            g_apply(g_params, noises.reshape(n * b, window, features))
+            g_apply(g_params, _hint(noises.reshape(n * b, window, features)))
         ).reshape(noises.shape)
-        alphas = jnp.stack([_alpha(k[2]) for k in ks]) if with_alpha else None
+        alphas = (_hint(jnp.stack([_alpha(k[2]) for k in ks]), batch_axis=1)
+                  if with_alpha else None)
         return k_idx, noises, fakes, alphas
 
     # A size-1 critic "loop" lowers to an XLA while op — a scheduling
@@ -372,8 +304,7 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         straight-line fused form when n_critic allows, the fori_loop
         otherwise.  ``critic_iter(i, (d_params, d_opt, d_loss))`` is the
         unchanged per-iteration body; with health on the carry grows a
-        4th element — the iteration's critic grad sq-norm (vma-matched
-        like the loss, since it derives from the same varying data)."""
+        4th element — the iteration's critic grad sq-norm."""
         init = (state.d_params, state.d_opt, _loop_init(key))
         if hcfg:
             init = init + (_loop_init(key),)
@@ -434,7 +365,15 @@ def make_train_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
         # interp into the batch too would widen the *second-order* path
         # (outer grad through the GP input-grad) to 3B and measures
         # slower on the chip than the scan it saves.
-        scores = acc(d_apply(d_params, jnp.concatenate([real, fake], axis=0)))
+        #
+        # The _hint on the concatenated batch is LOAD-BEARING under a
+        # mesh with a free (tp) axis on this runtime: XLA's SPMD
+        # partitioner computes WRONG critic scores for a concat of two
+        # dp-constrained operands unless the concat's own layout is
+        # re-pinned (measured 0.24 absolute score error, every row —
+        # pinned by tests/test_mesh_rules.py; identity when meshless).
+        scores = acc(d_apply(d_params,
+                             _hint(jnp.concatenate([real, fake], axis=0))))
         gp = gradient_penalty(d_apply, d_params, interp)
         w_loss = jnp.mean(-scores[:b]) + jnp.mean(scores[b:])
         return w_loss + gp_w * gp, (w_loss, gp)
@@ -624,18 +563,17 @@ def make_conditional_step(pair: GanPair, tcfg: TrainConfig,
 
 
 def make_multi_step(pair: GanPair, tcfg: TrainConfig, dataset: jnp.ndarray,
-                    axis_name: Optional[str] = None, jit: bool = True,
-                    sample_batch: Optional[int] = None,
-                    step: Optional[Callable] = None):
+                    jit: bool = True, step: Optional[Callable] = None):
     """Scan ``steps_per_call`` epochs into one compiled program.
 
     Returns ``fn(state, key) -> (state, stacked_metrics)``; metrics carry
     one entry per inner epoch so per-epoch logging survives the batching.
-    ``step`` overrides the epoch step (e.g. a prebuilt sequence-parallel
-    step) while keeping the scan/key-folding harness in one place.
+    ``step`` overrides the epoch step (e.g. a prebuilt mesh-constrained
+    or layer-pipelined step) while keeping the scan/key-folding harness
+    in one place.
     """
     if step is None:
-        step = make_train_step(pair, tcfg, dataset, axis_name, sample_batch)
+        step = make_train_step(pair, tcfg, dataset)
     n = tcfg.steps_per_call
 
     def multi(state: GanState, key: jax.Array):

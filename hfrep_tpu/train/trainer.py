@@ -40,55 +40,43 @@ class GanTrainer:
         key = jax.random.PRNGKey(cfg.train.seed)
         self.key, init_key = jax.random.split(key)
         self.state = init_gan_state(init_key, cfg.model, cfg.train, self.pair)
+        self._launch_specs = None        # set on the mesh path below
         if mesh is not None:
-            # The mesh's axis names declare the partitioning (local
-            # imports: parallel depends on train.states, avoid a cycle):
-            #   ('dp',)       batch sharding       (data_parallel.py)
-            #   ('sp',)       window sharding      (sequence.py) — the
-            #                 long-window path, now with the trainer's
-            #                 full checkpoint/resume/nan-guard/logging
-            #   ('tp',)       hidden-unit sharding (tensor.py) — the
-            #                 wide-model path
-            #   ('dp', 'sp')  batch + window, one 2-D mesh (dp_sp.py)
-            #   ('dp', 'tp')  batch + width, one 2-D mesh  (tensor.py)
-            #   ('dp', 'sp', 'tp')  all three, one 3-D mesh (dp_sp_tp.py)
+            # The mesh's axis names declare the partitioning; every
+            # combination launches through the ONE partition-rule-driven
+            # builder (hfrep_tpu/parallel/rules.py): batch sharded over
+            # dp, window over sp (sampled-tensor constraints), LSTM gate
+            # columns over tp (param partition rules) — one pjit'd
+            # global program, GSPMD derives the collectives.
             names = tuple(mesh.axis_names)
             if names not in (("dp",), ("sp",), ("tp",), ("dp", "sp"),
                              ("dp", "tp"), ("dp", "sp", "tp")):
-                # validate BEFORE any hfrep_tpu.parallel import: the
-                # rejection must not depend on whether a runtime without
-                # jax.shard_map can finish importing the parallel package
-                # (it raised ImportError or ValueError by sys.modules
-                # residue — the order-dependent test_train failure)
+                # validate BEFORE any hfrep_tpu.parallel import (the
+                # rejection must never depend on import-order residue —
+                # the order-dependent test_train failure of round 6)
                 raise ValueError(
                     f"mesh axis names {names} not recognized; use ('dp',), "
                     "('sp',), ('tp',), ('dp', 'sp'), ('dp', 'tp'), or "
                     "('dp', 'sp', 'tp')")
-            from hfrep_tpu.parallel.mesh import replicate_to_global, spans_processes
-            if names == ("dp",):
-                from hfrep_tpu.parallel.data_parallel import make_dp_multi_step
-                self._multi = make_dp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            elif names == ("sp",):
-                # sp_microbatches reaches the pipeline via cfg.train
-                # (the step builders resolve it from their tcfg)
-                from hfrep_tpu.parallel.sequence import make_sp_multi_step
-                self._multi = make_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            elif names == ("tp",):
-                from hfrep_tpu.parallel.tensor import make_tp_multi_step
-                self._multi = make_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            elif names == ("dp", "sp"):
-                from hfrep_tpu.parallel.dp_sp import make_dp_sp_multi_step
-                self._multi = make_dp_sp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            elif names == ("dp", "tp"):
-                from hfrep_tpu.parallel.tensor import make_dp_tp_multi_step
-                self._multi = make_dp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
-            else:
-                from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_multi_step
-                self._multi = make_dp_sp_tp_multi_step(self.pair, cfg.train, self.windows, mesh)
+            from hfrep_tpu.parallel.mesh import (replicate_to_global,
+                                                 shard_to_global,
+                                                 spans_processes)
+            from hfrep_tpu.parallel.rules import (gan_launch_specs,
+                                                  make_gan_multi_step)
+            self._multi = make_gan_multi_step(self.pair, cfg.train,
+                                              self.windows, mesh)
+            #: the launch's state layout — P() on dp/sp meshes, the
+            #: rule-resolved per-leaf pytree on tp meshes; multi-host
+            #: promotion and checkpointing must agree with it (pjit
+            #: refuses committed args whose sharding mismatches)
+            self._launch_specs = gan_launch_specs(self.pair, cfg.train,
+                                                  self.windows, mesh)
             if spans_processes(mesh):
                 # multi-host: promote the (identically-seeded) state and
-                # key to replicated global arrays for the pod-wide jit
-                self.state = replicate_to_global(self.state, mesh)
+                # key to global arrays laid out exactly as the pod-wide
+                # jit expects (replicated on dp/sp, tp-sharded on tp)
+                self.state = shard_to_global(self.state, mesh,
+                                             self._launch_specs)
                 self.key = replicate_to_global(self.key, mesh)
         else:
             # single-device path joins the same build-time hook the
@@ -320,30 +308,13 @@ class GanTrainer:
         """Cached 1-epoch step for schedule remainders, matching the mesh
         partitioning (a window-sharded run must not fall back to a
         full-window single-device step — on a real pod that shape may not
-        even fit one device).  The 1-D dp remainder keeps the plain step:
-        state is replicated and the computation is identical at global
-        batch."""
+        even fit one device).  The mesh remainder launches the SAME
+        rule-driven single-epoch builder every axis combination shares;
+        the meshless remainder keeps the plain donated jit."""
         if self._single_step is None:
-            names = tuple(self.mesh.axis_names) if self.mesh is not None else ()
-            if names == ("sp",):
-                from hfrep_tpu.parallel.sequence import make_sp_train_step
-                self._single_step = make_sp_train_step(
-                    self.pair, self.cfg.train, self.windows, self.mesh)
-            elif names == ("tp",):
-                from hfrep_tpu.parallel.tensor import make_tp_train_step
-                self._single_step = make_tp_train_step(
-                    self.pair, self.cfg.train, self.windows, self.mesh)
-            elif names == ("dp", "sp"):
-                from hfrep_tpu.parallel.dp_sp import make_dp_sp_train_step
-                self._single_step = make_dp_sp_train_step(
-                    self.pair, self.cfg.train, self.windows, self.mesh)
-            elif names == ("dp", "tp"):
-                from hfrep_tpu.parallel.tensor import make_dp_tp_train_step
-                self._single_step = make_dp_tp_train_step(
-                    self.pair, self.cfg.train, self.windows, self.mesh)
-            elif names == ("dp", "sp", "tp"):
-                from hfrep_tpu.parallel.dp_sp_tp import make_dp_sp_tp_train_step
-                self._single_step = make_dp_sp_tp_train_step(
+            if self.mesh is not None:
+                from hfrep_tpu.parallel.rules import make_gan_train_step
+                self._single_step = make_gan_train_step(
                     self.pair, self.cfg.train, self.windows, self.mesh)
             else:
                 from hfrep_tpu.train.steps import make_train_step
@@ -432,17 +403,30 @@ class GanTrainer:
 
     def save_checkpoint(self, path: Optional[str] = None) -> str:
         path = path or f"{self.cfg.train.checkpoint_dir}/ckpt_{self.epoch}"
-        # Multi-host: state is replicated, so the leader's copy is the
-        # whole checkpoint — every other process writing the same path
-        # concurrently would race on shared storage.  The leader writes
-        # the coordination-free format: orbax's saver runs its own
-        # cross-process barrier, which a single-process save never exits.
+        # Multi-host: on dp/sp meshes the state is replicated, so the
+        # leader's copy is the whole checkpoint — every other process
+        # writing the same path concurrently would race on shared
+        # storage.  On a tp pod the params live SHARDED across
+        # processes, so every process first joins one all-gather (a
+        # pjit identity to the replicated layout — a collective, hence
+        # BEFORE the leader-only return) and the leader then holds the
+        # whole tree.  The leader writes the coordination-free format:
+        # orbax's saver runs its own cross-process barrier, which a
+        # single-process save never exits.
         multihost = self._multihost()
+        tree = self._ckpt_tree()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if multihost and not isinstance(self._launch_specs, P):
+            # only the state is sharded; epoch/scaler are host-local
+            # leaves a cross-process jit would reject
+            tree = dict(tree, state=jax.jit(
+                lambda s: s,
+                out_shardings=NamedSharding(self.mesh, P()))(tree["state"]))
         if multihost and jax.process_index() != 0:
             return path
         obs = get_obs()
         with obs.span("checkpoint", epoch=self.epoch, path=str(path)):
-            ckpt.save(path, self._ckpt_tree(),
+            ckpt.save(path, tree,
                       metadata={"family": self.cfg.model.family, "epoch": self.epoch},
                       coordination_free=multihost,
                       keep=self.cfg.train.checkpoint_keep)
@@ -489,10 +473,13 @@ class GanTrainer:
         self.key = jnp.asarray(restored["key"])
         self.epoch = int(restored["epoch"])
         if self._multihost():
-            # re-apply the global-array promotion __init__ performed: the
-            # cross-process jit rejects the host-local arrays restore built
-            from hfrep_tpu.parallel.mesh import replicate_to_global
-            self.state = replicate_to_global(self.state, self.mesh)
+            # re-apply the global-array promotion __init__ performed
+            # (same per-leaf launch layout — the cross-process jit
+            # rejects both host-local arrays and mismatched shardings)
+            from hfrep_tpu.parallel.mesh import (replicate_to_global,
+                                                 shard_to_global)
+            self.state = shard_to_global(self.state, self.mesh,
+                                         self._launch_specs)
             self.key = replicate_to_global(self.key, self.mesh)
         return str(path)
 

@@ -7,8 +7,9 @@ has neither ``jax.shard_map`` nor ``check_vma``; the experimental
 ``jax.experimental.shard_map`` that *does* exist carries the older
 ``check_rep`` semantics (no vma types, no ``lax.pcast``) and is NOT a
 drop-in — silently substituting it would change what the type checker
-proves.  Until the partition-rule mesh refactor (ROADMAP item 1)
-replaces these paths, the contract is:
+proves.  Since the partition-rule mesh refactor (ISSUE 15) the only
+launch that still needs the gate is the manual layer pipeline
+(``parallel/layer_pipeline.py``); the contract stays:
 
 * every version-gated reference lives behind THE one guarded import in
   this module (rule HF005 flags any direct ``jax.shard_map`` /
@@ -50,6 +51,30 @@ if not HAS_SHARD_MAP:
             f"(jax {jax.__version__}); this shard_map launch path is dead "
             "here — see hfrep_tpu/analysis/HF005_KILL_LIST.md and ROADMAP "
             "item 1 (partition-rule mesh refactor)")
+
+
+def _version_tuple(v: str):
+    parts = []
+    for p in v.split("."):
+        digits = "".join(c for c in p if c.isdigit())
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+def _has_cpu_multiprocess() -> bool:
+    import jax
+    return _version_tuple(jax.__version__) >= (0, 5)
+
+
+#: jax 0.4.x's CPU client cannot EXECUTE a cross-process SPMD program —
+#: a multi-host pjit dispatch dies with "Multiprocess computations
+#: aren't implemented on the CPU backend" (the Gloo-backed cross-host
+#: CPU collectives landed in later jax).  The two-process CPU tests
+#: (tests/test_distributed.py) gate on this and skip on the pinned
+#: runtime; real pods (TPU/GPU backends) are unaffected.
+HAS_CPU_MULTIPROCESS_SPMD = _has_cpu_multiprocess()
 
 
 try:

@@ -1386,6 +1386,100 @@ class TestExitCodeContract:
         assert fs == []
 
 
+# ------------------------------------------------------------------ HF008
+class TestMeshLaunchDiscipline:
+    def test_positive_imported_shard_map_call(self):
+        fs = run_hf("""
+            from jax.experimental.shard_map import shard_map
+            def launch(f, mesh):
+                return shard_map(f, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert codes(fs) == ["HF008"]
+        assert "mesh_launch" in fs[0].message
+
+    def test_positive_dotted_pmap_call(self):
+        fs = run_hf("""
+            import jax
+            def launch(f):
+                return jax.pmap(f, axis_name="dp")
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert codes(fs) == ["HF008"]
+
+    def test_positive_module_qualified_forms(self):
+        # the module-alias spellings construct the same launch: the
+        # module imported as a name, an import-as alias, the compat
+        # MODULE (not its member) imported from the package
+        fs = run_hf("""
+            from jax.experimental import shard_map
+            def launch(f, mesh):
+                return shard_map.shard_map(f, mesh=mesh, in_specs=None,
+                                           out_specs=None)
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert codes(fs) == ["HF008"]
+        fs = run_hf("""
+            import jax.experimental.shard_map as sm
+            def launch(f, mesh):
+                return sm.shard_map(f, mesh=mesh, in_specs=None,
+                                    out_specs=None)
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert codes(fs) == ["HF008"]
+        fs = run_hf("""
+            from hfrep_tpu.parallel import _compat
+            def launch(f, mesh):
+                return _compat.shard_map(f, mesh=mesh, in_specs=None,
+                                         out_specs=None)
+            """, "HF008", relpath="hfrep_tpu/serve/worker.py")
+        assert codes(fs) == ["HF008"]
+
+    def test_positive_compat_gate_alias(self):
+        # routing through the version gate does not sanctify the launch:
+        # the gated constructor is still a manual shard_map region
+        fs = run_hf("""
+            from hfrep_tpu.utils.jax_compat import shard_map as sm
+            def launch(f, mesh):
+                return sm(f, mesh=mesh, in_specs=None, out_specs=None)
+            """, "HF008", relpath="hfrep_tpu/serve/worker.py")
+        assert codes(fs) == ["HF008"]
+
+    def test_negative_parallel_package_sanctioned(self):
+        src = """
+            from hfrep_tpu.utils.jax_compat import shard_map
+            def pp(f, mesh):
+                return shard_map(f, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+            """
+        assert run_hf(src, "HF008",
+                      relpath="hfrep_tpu/parallel/layer_pipeline.py") == []
+        assert run_hf(src, "HF008",
+                      relpath="hfrep_tpu/utils/jax_compat.py") == []
+
+    def test_negative_reference_without_call(self):
+        # HAS_SHARD_MAP probes and registry strings are not launches
+        fs = run_hf("""
+            from hfrep_tpu.utils.jax_compat import HAS_SHARD_MAP
+            ABSENT = ["jax.shard_map"]
+            def supported():
+                return HAS_SHARD_MAP
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert fs == []
+
+    def test_tests_exempt_and_noqa(self):
+        src = """
+            import jax
+            def launch(f):
+                return jax.pmap(f)
+            """
+        assert run_hf(src, "HF008",
+                      relpath="tests/test_x_fixture.py") == []
+        fs = run_hf("""
+            import jax
+            def launch(f):
+                return jax.pmap(f)  # noqa: HF008
+            """, "HF008", relpath="hfrep_tpu/train/custom.py")
+        assert fs == []
+
+
 # -------------------------------------------- review-hardening regressions
 class TestReviewHardening:
     def test_hf005_not_hasattr_polarity(self):

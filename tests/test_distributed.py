@@ -27,6 +27,23 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from hfrep_tpu.utils.jax_compat import HAS_CPU_MULTIPROCESS_SPMD
+
+# Every test here spawns 2 OS processes × 4 virtual CPU devices joined
+# over Gloo/TCP into one pod-wide mesh.  jax 0.4.x's CPU client cannot
+# EXECUTE a cross-process SPMD program ("Multiprocess computations
+# aren't implemented on the CPU backend"), so on the pinned runtime the
+# children die at the first pjit dispatch regardless of what the launch
+# layer does — at pre-migration HEAD the same children died at the
+# shard_map gate instead (ShardMapUnavailable).  Skip with the pointer;
+# a jax bump (or a real pod backend, where multi-host pjit is the
+# standard path) re-arms the suite unchanged.
+pytestmark = pytest.mark.skipif(
+    not HAS_CPU_MULTIPROCESS_SPMD,
+    reason="cross-process SPMD unimplemented on this jax's CPU client "
+           "(see hfrep_tpu/utils/jax_compat.py "
+           "HAS_CPU_MULTIPROCESS_SPMD and the HF005 kill list)")
+
 CHILD = textwrap.dedent("""
     import json, os, sys
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -360,7 +377,7 @@ def test_two_process_sp_matches_single_device(tmp_path):
     processes (2×4 virtual devices over Gloo/TCP): the multi-host carry
     handoff — the last untested claim of the sp story — must land on the
     single-device trajectory exactly like the single-process sp mesh
-    does (tests/test_sequence.py)."""
+    does (tests/test_mesh_rules.py)."""
     script = tmp_path / "sp_child.py"
     script.write_text(SP_CHILD)
     port = _free_port()
@@ -502,7 +519,13 @@ TP_CHILD = textwrap.dedent("""
     tcfg = TrainConfig(batch_size=8, n_critic=2, steps_per_call=3)
     pair = build_gan(mcfg)
     state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
-    state = replicate_to_global(state, mesh)
+    # a tp launch's state is genuinely SHARDED across the pod since the
+    # mesh refactor — promote to the launch's own per-leaf layout
+    # (pjit refuses committed args under a mismatched sharding)
+    from hfrep_tpu.parallel.mesh import shard_to_global
+    from hfrep_tpu.parallel.rules import gan_launch_specs
+    state = shard_to_global(state, mesh,
+                            gan_launch_specs(pair, tcfg, dataset, mesh))
     key = replicate_to_global(jax.random.PRNGKey(1), mesh)
 
     state, metrics = make_tp_multi_step(pair, tcfg, dataset, mesh)(state, key)
@@ -601,7 +624,7 @@ def test_two_process_tp_matches_single_device(tmp_path):
     real processes (2×4 virtual devices over Gloo/TCP): the multi-host
     per-timestep hidden-slice all_gather must land on the single-device
     trajectory exactly like the single-process tp mesh does
-    (tests/test_tensor_parallel.py), and the trainer's
+    (tests/test_mesh_rules.py), and the trainer's
     checkpoint/resume leg must work on the pod mesh."""
     script = tmp_path / "tp_child.py"
     script.write_text(TP_CHILD)

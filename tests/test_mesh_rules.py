@@ -1,0 +1,440 @@
+"""The partition-rule-driven mesh launch (ISSUE 15 / ROADMAP item 1).
+
+Pins, in order of load-bearing-ness:
+
+* rule→PartitionSpec resolution over the REAL GAN and AE pytrees —
+  every leaf matched, scalars replicated, unmatched params a hard error
+  naming the offending path;
+* the 1×1-mesh program is jaxpr-identical AND bit-identical to the
+  single-device path (the migration's by-construction guarantee);
+* 1-D mesh trajectories (dp / sp / tp) land on the single-device
+  trajectory to f32 round-off; the dp×sp composition is exact since the
+  double-constraint RNG pin (the regression test below); data+tp
+  compositions carry one RMSprop-amplified reassociation step;
+* the sampled random stream is INVARIANT to the sharding constraints —
+  the real bug this suite exists to keep dead: on jax 0.4.37
+  (threefry_partitionable=False) a sharded-layout constraint that
+  propagates back into ``jax.random.normal`` partitions the threefry
+  computation and CHANGES the values (measured O(1) drift);
+* the AE chunk programs' mesh dispatch is BIT-identical to the meshless
+  drive (independent lanes — nothing to reorder), with divisibility
+  refusals naming the axis;
+* shard/gather fns round-trip and refuse indivisible leaves by name.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hfrep_tpu.config import AEConfig, ModelConfig, TrainConfig
+from hfrep_tpu.models.registry import build_gan
+from hfrep_tpu.parallel.rules import (
+    MeshSpec,
+    build_mesh,
+    data_constraint,
+    gan_state_specs,
+    lane_mesh,
+    make_gan_multi_step,
+    make_gan_train_step,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    mesh_spec,
+    shard_put,
+)
+from hfrep_tpu.train.states import init_gan_state
+from hfrep_tpu.train.steps import make_multi_step
+
+MCFG = ModelConfig(family="mtss_wgan_gp", features=5, window=8, hidden=8)
+TCFG = TrainConfig(batch_size=16, n_critic=2, steps_per_call=2)
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(7)
+    return jnp.asarray(g.uniform(0, 1, (64, 8, 5)).astype(np.float32))
+
+
+@pytest.fixture(scope="module")
+def pair():
+    return build_gan(MCFG)
+
+
+@pytest.fixture(scope="module")
+def plain_traj(pair, dataset):
+    """The single-device reference trajectory, compiled ONCE for the
+    module — every identity/trajectory pin diffs against these bytes
+    (recompiling the reference per test doubled the suite's wall
+    clock)."""
+    s_p, m_p = make_multi_step(pair, TCFG, dataset)(
+        init_gan_state(jax.random.PRNGKey(0), MCFG, TCFG, pair),
+        jax.random.PRNGKey(1))
+    jax.block_until_ready(m_p)
+    return s_p, m_p
+
+
+def _state(pair):
+    return init_gan_state(jax.random.PRNGKey(0), MCFG, TCFG, pair)
+
+
+def _leaves(state):
+    return (jax.tree_util.tree_leaves(state.g_params)
+            + jax.tree_util.tree_leaves(state.d_params))
+
+
+# ------------------------------------------------------------ rule matching
+class TestPartitionRules:
+    def test_gan_state_every_leaf_matched(self, pair):
+        mesh = build_mesh(MeshSpec(tp=2), devices=jax.devices()[:2])
+        specs = gan_state_specs(_state(pair), mesh)
+        flat_state = jax.tree_util.tree_leaves(_state(pair))
+        flat_specs = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))
+        assert len(flat_state) == len(flat_specs)
+        assert all(isinstance(s, P) for s in flat_specs)
+
+    def test_tp_rules_hit_lstm_gate_columns(self, pair):
+        mesh = build_mesh(MeshSpec(tp=2), devices=jax.devices()[:2])
+        state = _state(pair)
+        specs = gan_state_specs(state, mesh)
+        # params AND their optimizer-state mirrors shard the gate axis
+        assert specs.g_params["KerasLSTM_0"]["kernel"] == P(None, "tp")
+        assert specs.g_params["KerasLSTM_0"]["bias"] == P("tp")
+        opt_specs = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(
+                lambda s: s, specs.g_opt, is_leaf=lambda s: isinstance(s, P)))
+        assert P(None, "tp") in opt_specs
+        # heads / LayerNorms replicate
+        assert specs.g_params["KerasDense_0"]["Dense_0"]["kernel"] == P()
+
+    def test_scalars_always_replicate(self, pair):
+        mesh = build_mesh(MeshSpec(tp=2), devices=jax.devices()[:2])
+        rules = ((r".*", P("tp")),)          # would shard everything rank>=1
+        specs = match_partition_rules(rules, _state(pair), mesh)
+        assert specs.step == P()             # scalar guard wins
+
+    def test_unmatched_param_raises_with_path(self):
+        rules = ((r"only/this", P()),)
+        tree = {"g_params": {"KerasLSTM_0": {"kernel": jnp.zeros((3, 4))}}}
+        with pytest.raises(ValueError,
+                           match=r"g_params/KerasLSTM_0/kernel"):
+            match_partition_rules(rules, tree)
+
+    def test_absent_axes_strip_to_replicated(self, pair):
+        mesh = build_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        specs = gan_state_specs(_state(pair), mesh)
+        flat = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))
+        assert all(s == P() for s in flat)   # tp names stripped
+
+    def test_ae_carry_rules_over_real_multi_carry(self):
+        from hfrep_tpu.parallel.rules import AE_LANE_RULES
+        from hfrep_tpu.replication.engine import _init_program
+        cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
+                       patience=2, seed=0, chunk_epochs=2)
+        xs = jnp.asarray(np.random.default_rng(0)
+                         .uniform(0, 1, (2, 24, 4)).astype(np.float32))
+        keys = jax.random.split(jax.random.PRNGKey(0), 2)
+        carry, _ = _init_program(cfg, "multi", 2)(keys, xs)
+        mesh = build_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        specs = match_partition_rules(AE_LANE_RULES, carry, mesh)
+        flat_c = jax.tree_util.tree_leaves(carry)
+        flat_s = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))
+        assert len(flat_c) == len(flat_s)
+        for leaf, spec in zip(flat_c, flat_s):
+            if leaf.ndim == 0 or leaf.size <= 1:
+                assert spec == P()
+            else:
+                assert spec == P("dp")
+                assert leaf.shape[0] == 2    # every vector leaf leads (D,)
+
+
+# ----------------------------------------------------------- mesh building
+class TestMeshSpec:
+    def test_axis_names_and_sizes(self):
+        assert MeshSpec().axis_names == ("dp",)
+        assert MeshSpec(dp=2, sp=4).axis_names == ("dp", "sp")
+        assert MeshSpec(dp=2, sp=4).axis_sizes == (2, 4)
+        with pytest.raises(ValueError, match=">= 1"):
+            MeshSpec(dp=0)
+
+    def test_build_and_inverse(self):
+        mesh = build_mesh(MeshSpec(dp=2, tp=2), devices=jax.devices()[:4])
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh_spec(mesh) == MeshSpec(dp=2, tp=2)
+        with pytest.raises(ValueError, match="not in"):
+            mesh_spec(Mesh(np.asarray(jax.devices()[:2]), ("model",)))
+
+    def test_build_refuses_oversize(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh(MeshSpec(dp=3), devices=jax.devices()[:2])
+
+    def test_lane_mesh_picks_divisor(self):
+        assert lane_mesh(21, devices=jax.devices()[:8]).devices.size == 7
+        assert lane_mesh(8, devices=jax.devices()[:8]).devices.size == 8
+        assert lane_mesh(13, devices=jax.devices()[:8]).devices.size == 1
+
+    def test_describe_is_json_safe_config_section(self):
+        import json
+        d = MeshSpec(dp=4).describe()
+        assert json.loads(json.dumps(d)) == d and d["unified"] is True
+
+
+# ------------------------------------------------------- shard/gather fns
+class TestShardGather:
+    def test_roundtrip(self):
+        mesh = build_mesh(MeshSpec(dp=4), devices=jax.devices()[:4])
+        tree = {"a": jnp.arange(8.0), "b": jnp.ones((4, 3))}
+        shard_fn, gather_fn = make_shard_and_gather_fns(mesh, P("dp"))
+        placed = shard_fn(tree)
+        assert placed["a"].sharding.spec == P("dp")
+        back = gather_fn(placed)
+        np.testing.assert_array_equal(back["a"], np.arange(8.0))
+
+    def test_divisibility_error_names_leaf(self):
+        mesh = build_mesh(MeshSpec(dp=4), devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match=r"bad.*not divisible"):
+            shard_put({"ok": jnp.zeros((8,)), "bad": jnp.zeros((6,))},
+                      mesh, P("dp"))
+
+
+# ------------------------------------------------- 1x1 identity + RNG pin
+class TestIdentity:
+    def test_1x1_mesh_jaxpr_identical(self, pair, dataset):
+        mesh1 = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+        assert data_constraint(mesh1) is None
+        raw = make_multi_step(pair, TCFG, dataset, jit=False)
+        launched = make_gan_multi_step(pair, TCFG, dataset, mesh1, jit=False)
+        s0 = _state(pair)
+        k = jax.random.PRNGKey(1)
+        assert str(jax.make_jaxpr(launched)(s0, k)) \
+            == str(jax.make_jaxpr(raw)(s0, k))
+
+    def test_1x1_mesh_trajectory_bitwise(self, pair, dataset, plain_traj):
+        mesh1 = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+        s_m, m_m = make_gan_multi_step(pair, TCFG, dataset, mesh1)(
+            _state(pair), jax.random.PRNGKey(1))
+        s_p, m_p = plain_traj
+        for a, b in zip(_leaves(s_m), _leaves(s_p)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for k in m_p:
+            np.testing.assert_array_equal(np.asarray(m_m[k]),
+                                          np.asarray(m_p[k]))
+
+    @needs_8
+    def test_constraint_leaves_random_stream_alone(self):
+        """THE regression this suite pins: on this runtime
+        (threefry_partitionable=False) a sharded-layout constraint that
+        reaches back into jax.random PARTITIONS the threefry computation
+        and changes the drawn values.  data_constraint's double-pin
+        (replicated first, layout second) must keep the sampled stream
+        the literal single-device stream."""
+        mesh = build_mesh(MeshSpec(dp=2, sp=4), devices=jax.devices()[:8])
+        hint = data_constraint(mesh)
+        assert hint is not None
+        draw = lambda k: jax.random.normal(k, (16, 8, 6))
+        a = jax.jit(lambda k: hint(draw(k)))(jax.random.PRNGKey(3))
+        b = jax.jit(draw)(jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------- trajectory parity
+class TestMeshTrajectories:
+    def _run(self, pair, dataset, fn):
+        s, m = fn(_state(pair), jax.random.PRNGKey(1))
+        jax.block_until_ready(m)
+        return s, m
+
+    @needs_8
+    @pytest.mark.parametrize("spec", [
+        # fast tier carries ONE composed smoke (dp×sp exercises both
+        # data axes through one compile); the per-axis and remaining
+        # composed shapes are slow-tier (dryrun_multichip drives them
+        # all at flagship shapes too) — the tier-1 wall-clock budget
+        # is real
+        MeshSpec(dp=2, sp=4),
+        pytest.param(MeshSpec(dp=8), marks=pytest.mark.slow),
+        pytest.param(MeshSpec(sp=8), marks=pytest.mark.slow),
+        pytest.param(MeshSpec(tp=8), marks=pytest.mark.slow),
+        pytest.param(MeshSpec(dp=2, tp=4), marks=pytest.mark.slow),
+        pytest.param(MeshSpec(dp=2, sp=2, tp=2), marks=pytest.mark.slow),
+    ])
+    def test_mesh_follows_single_device_trajectory(self, spec, pair, dataset,
+                                                   plain_traj):
+        """EVERY mesh shape — 1-D and composed — lands on the plain
+        single-device trajectory to f32 round-off (observed ≤3e-8 after
+        2 epochs; 1e-5 pinned).  This tightness rests on the two runtime
+        pins regression-tested below (RNG double-constraint, concat
+        re-pin)."""
+        mesh = build_mesh(spec, devices=jax.devices()[:8])
+        s_m, m_m = self._run(pair, dataset,
+                             make_gan_multi_step(pair, TCFG, dataset, mesh))
+        s_p, m_p = plain_traj
+        for a, b in zip(_leaves(s_m), _leaves(s_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+        for k in m_p:
+            np.testing.assert_allclose(np.asarray(m_m[k]),
+                                       np.asarray(m_p[k]), atol=1e-5)
+        assert int(s_m.step) == int(s_p.step) == TCFG.steps_per_call
+
+    @needs_8
+    @pytest.mark.slow
+    def test_concat_of_constrained_operands_scores_exactly(self, pair):
+        """Regression pin for the second runtime trap: on this jax,
+        XLA's SPMD partitioner computes WRONG critic scores for a
+        ``concat`` of two dp-constrained operands on a mesh with a free
+        axis (measured 0.24 absolute, every row) unless the concat's own
+        layout is re-pinned — which ``steps.gp_critic_loss`` now does
+        via its ``_hint``.  This exercises the fixed path end to end:
+        the wgan_gp d_loss (the loss whose score batch IS that concat)
+        must match the plain step exactly-ish on the dp×tp mesh."""
+        mesh = build_mesh(MeshSpec(dp=2, tp=4), devices=jax.devices()[:8])
+        g = np.random.default_rng(11)
+        data = jnp.asarray(g.uniform(0, 1, (64, 8, 5)).astype(np.float32))
+        tcfg1 = dataclasses.replace(TCFG, steps_per_call=1, n_critic=1)
+        _, m_m = make_gan_multi_step(pair, tcfg1, data, mesh)(
+            _state(pair), jax.random.PRNGKey(5))
+        _, m_p = make_multi_step(pair, tcfg1, data)(
+            _state(pair), jax.random.PRNGKey(5))
+        np.testing.assert_allclose(np.asarray(m_m["d_loss"]),
+                                   np.asarray(m_p["d_loss"]), atol=1e-5)
+
+    @needs_8
+    @pytest.mark.slow
+    def test_param_leaves_actually_sharded_on_tp(self, pair, dataset):
+        mesh = build_mesh(MeshSpec(tp=8), devices=jax.devices()[:8])
+        s_m, _ = self._run(pair, dataset,
+                           make_gan_multi_step(pair, TCFG, dataset, mesh))
+        k = s_m.g_params["KerasLSTM_0"]["kernel"]
+        assert k.sharding.spec == P(None, "tp")
+
+    @needs_8
+    @pytest.mark.slow
+    def test_single_epoch_builder_matches(self, pair, dataset):
+        mesh = build_mesh(MeshSpec(dp=8), devices=jax.devices()[:8])
+        tcfg1 = dataclasses.replace(TCFG, steps_per_call=1)
+        s_m, _ = make_gan_train_step(pair, tcfg1, dataset, mesh)(
+            _state(pair), jax.random.PRNGKey(2))
+        from hfrep_tpu.train.steps import make_train_step
+        s_p, _ = jax.jit(make_train_step(pair, tcfg1, dataset))(
+            _state(pair), jax.random.PRNGKey(2))
+        for a, b in zip(_leaves(s_m), _leaves(s_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_validation_errors(self, pair, dataset):
+        devs = jax.devices()
+        if len(devs) >= 8:
+            mesh = build_mesh(MeshSpec(dp=8), devices=devs[:8])
+            with pytest.raises(ValueError, match="not divisible"):
+                make_gan_multi_step(
+                    pair, dataclasses.replace(TCFG, batch_size=9),
+                    dataset, mesh)
+            with pytest.raises(ValueError, match="window"):
+                make_gan_multi_step(pair, TCFG, dataset,
+                                    build_mesh(MeshSpec(sp=3), devices=devs))
+            with pytest.raises(ValueError, match="hidden"):
+                make_gan_multi_step(pair, TCFG, dataset,
+                                    build_mesh(MeshSpec(tp=3), devices=devs))
+        with pytest.raises(ValueError, match="pp is the layer_pipeline"):
+            make_gan_multi_step(
+                pair, TCFG, dataset,
+                Mesh(np.asarray(devs[:2]), ("pp",)))
+        bce = build_gan(dataclasses.replace(MCFG, family="gan"))
+        with pytest.raises(ValueError, match="mtss_wgan_gp"):
+            make_gan_multi_step(
+                bce, TCFG, dataset,
+                Mesh(np.asarray(devs[:2]), ("tp",)))
+        # explicit pallas on a >1-device mesh refuses (GSPMD cannot
+        # partition the opaque kernel call; 'auto' degrades to xla)
+        with pytest.raises(ValueError, match="GSPMD-partitioned"):
+            make_gan_multi_step(
+                pair, dataclasses.replace(TCFG, lstm_backend="pallas"),
+                dataset, build_mesh(MeshSpec(dp=2), devices=devs[:2]))
+
+
+# --------------------------------------------------- engine mesh dispatch
+class TestEngineMesh:
+    CFG = AEConfig(n_factors=4, latent_dim=3, epochs=6, batch_size=16,
+                   patience=2, seed=0, chunk_epochs=3)
+
+    def _bit_equal(self, a, b):
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+            for x, y in zip(jax.tree_util.tree_leaves(a._asdict()),
+                            jax.tree_util.tree_leaves(b._asdict())))
+
+    @pytest.mark.slow
+    def test_multi_mesh_bit_identical(self):
+        from hfrep_tpu.replication.engine import (stack_padded,
+                                                  sweep_autoencoders_multi)
+        g = np.random.default_rng(3)
+        a = jnp.asarray(g.uniform(0, 1, (36, 4)).astype(np.float32))
+        stack, rows = stack_padded([a, a[:28]])
+        key = jax.random.PRNGKey(5)
+        r0, s0 = sweep_autoencoders_multi(key, stack, rows, self.CFG, [1, 2])
+        mesh = lane_mesh(int(stack.shape[0]))
+        r1, s1 = sweep_autoencoders_multi(key, stack, rows, self.CFG, [1, 2],
+                                          mesh=mesh)
+        assert self._bit_equal(r0, r1)
+        assert s0.chunks_dispatched == s1.chunks_dispatched
+
+    def test_lanes_mesh_bit_identical(self):
+        from hfrep_tpu.replication.engine import sweep_autoencoders_padded
+        g = np.random.default_rng(4)
+        a = jnp.asarray(g.uniform(0, 1, (36, 4)).astype(np.float32))
+        key = jax.random.PRNGKey(6)
+        r0, _ = sweep_autoencoders_padded(key, a, 36, self.CFG, [1, 2, 3])
+        r1, _ = sweep_autoencoders_padded(key, a, 36, self.CFG, [1, 2, 3],
+                                          mesh=lane_mesh(3))
+        assert self._bit_equal(r0, r1)
+
+    @pytest.mark.slow   # the chaos corpus (entry 006) drives this same
+    # oracle through a real subprocess in every check.sh run
+    def test_mesh_resume_bit_identical(self, tmp_path):
+        """Kill→resume THROUGH the mesh dispatch path: drive two chunks,
+        'crash', re-drive with the same args — final results bitwise
+        equal to the uninterrupted mesh run (the chaos subject's oracle,
+        pinned in-process)."""
+        from hfrep_tpu.replication.engine import (stack_padded,
+                                                  sweep_autoencoders_multi)
+        from hfrep_tpu import resilience
+        g = np.random.default_rng(9)
+        a = jnp.asarray(g.uniform(0, 1, (36, 4)).astype(np.float32))
+        stack, rows = stack_padded([a, a[:30]])
+        key = jax.random.PRNGKey(11)
+        mesh = lane_mesh(int(stack.shape[0]))
+        ref, _ = sweep_autoencoders_multi(key, stack, rows, self.CFG, [1, 2],
+                                          mesh=mesh)
+        rd = str(tmp_path / "resume")
+        from hfrep_tpu.resilience.faults import FaultPlan
+        resilience.install_plan(FaultPlan.parse("preempt@chunk=1"))
+        try:
+            with pytest.raises(resilience.Preempted):
+                sweep_autoencoders_multi(key, stack, rows, self.CFG, [1, 2],
+                                         resume_dir=rd, mesh=mesh)
+        finally:
+            resilience.clear_plan()
+        res, _ = sweep_autoencoders_multi(key, stack, rows, self.CFG, [1, 2],
+                                          resume_dir=rd, mesh=mesh)
+        assert self._bit_equal(ref, res)
+
+    def test_mesh_divisibility_refusal(self):
+        from hfrep_tpu.replication.engine import (stack_padded,
+                                                  sweep_autoencoders_multi)
+        if len(jax.devices()) < 3:
+            pytest.skip("needs 3 devices")
+        g = np.random.default_rng(5)
+        a = jnp.asarray(g.uniform(0, 1, (30, 4)).astype(np.float32))
+        stack, rows = stack_padded([a, a[:24]])
+        with pytest.raises(ValueError, match="lane axis"):
+            sweep_autoencoders_multi(
+                jax.random.PRNGKey(0), stack, rows, self.CFG, [1, 2],
+                mesh=build_mesh(MeshSpec(dp=3), devices=jax.devices()[:3]))

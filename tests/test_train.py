@@ -174,7 +174,7 @@ class TestMeshTrainer:
     def test_sp_trainer_matches_plain_trajectory(self, dataset):
         """GanTrainer on a ('sp',) mesh follows the plain trainer's
         trajectory (same seed/key schedule — the sp step is
-        trajectory-exact, tests/test_sequence.py), with history, timer
+        trajectory-exact, tests/test_mesh_rules.py), with history, timer
         and epoch bookkeeping all live."""
         cfg = self._cfg()
         tr_sp = GanTrainer(cfg, dataset, mesh=self._mesh("sp"))

@@ -186,25 +186,24 @@ def cmd_spcpu(w: int, microbatches: int = 8) -> int:
     """Phase C: real sp training steps at W on the 8-virtual-device mesh —
     every window buffer genuinely sharded W/8 per device.
 
-    Default M=8 (not the planner's chip recommendation of M=1): XLA's
-    CPU in-process collectives carry a hard 40 s rendezvous watchdog,
-    and on a 1-core host the 8 device threads timeshare the core — at
-    M=1 a big-W chunk scan between consecutive ppermutes blows the
-    watchdog (measured: W=24192 M=1 aborts in CollectivePermute
-    rendezvous).  More microbatches shorten each inter-collective
-    interval ~M×; the schedule stays trajectory-exact (M-independence is
-    pinned in tests/test_sequence.py)."""
+    ``microbatches`` is a retired knob of the manual pipeline (its M=8
+    CPU-watchdog workaround and M-independence pins went with it —
+    git history).  Since the mesh refactor (ISSUE 15) the launch is the
+    unified pjit path: GSPMD lays out the window-sharded step itself,
+    there is no superstep schedule to tune, and the knob is accepted
+    and ignored by ``make_sp_train_step`` for source compatibility."""
     from jax.sharding import Mesh
 
     from hfrep_tpu.parallel.sequence import make_sp_train_step
 
     assert len(jax.devices()) == 8, "run with xla_force_host_platform_device_count=8"
     mcfg, tcfg, dataset, pair, state = _build(w)
-    # sp_remat: the xla-scan backend's plain residuals are ~5.4 GB per
-    # 1000 window timesteps for this step (two OOM-kills at W=24192/37632
-    # on the 125 GB host, recorded in RESULTS.md); superstep
-    # rematerialization brings the footprint to the same recompute
-    # strategy the chip kernels use.
+    # sp_remat is RETIRED with the manual pipeline (ISSUE 15) — the
+    # unified launch ignores it, so the big-W phases re-measure the
+    # PLAIN scan's residual footprint (~5.4 GB per 1000 window
+    # timesteps measured pre-migration; the W=24192/37632 OOM kills in
+    # RESULTS.md were the unrematerialized numbers too).  Kept set so a
+    # future GSPMD-era remat re-arms this probe unchanged.
     import dataclasses
     tcfg = dataclasses.replace(tcfg, sp_remat=True)
     mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("sp",))
