@@ -237,6 +237,20 @@ def _self_hash() -> str:
     return h.hexdigest()
 
 
+def jax_version() -> str:
+    """The installed jax version WITHOUT importing jax (the analysis
+    package stays jax-import-free; warm cache paths must not pay the
+    import).  Cache documents are keyed on this: registry verdicts that
+    read the absent-API table — and every phase-3 traced jaxpr — are
+    facts about a specific jax, and an upgrade must cold-start them
+    rather than silently replaying the old runtime's answers."""
+    try:
+        from importlib.metadata import version
+        return version("jax")
+    except Exception:
+        return "unknown"
+
+
 def load_cache(path) -> dict:
     try:
         data = json.loads(Path(path).read_text(encoding="utf-8"))
@@ -244,6 +258,8 @@ def load_cache(path) -> dict:
         return {}
     if not isinstance(data, dict) or data.get("version") != CACHE_VERSION:
         return {}
+    if data.get("jax") != jax_version():
+        return {}                  # jax upgrade: every cached verdict cold
     entries = data.get("entries")
     if not isinstance(entries, dict):
         return {}
@@ -262,7 +278,8 @@ def save_cache(path, entries: dict) -> None:
     tmp = p.parent / f".{p.name}.tmp-{os.getpid()}"
     try:
         tmp.write_text(
-            json.dumps({"version": CACHE_VERSION, "entries": entries}),
+            json.dumps({"version": CACHE_VERSION, "jax": jax_version(),
+                        "entries": entries}),
             encoding="utf-8")
         os.replace(tmp, p)
     except OSError:

@@ -1,0 +1,678 @@
+"""Phase 3 (ISSUE 16): the program auditor — jaxpr/IR-level static
+analysis over every compile boundary the repo owns.
+
+The AST phases judge SOURCE; this phase judges the TRACED PROGRAM.  A
+declarative registry (:data:`PROGRAM_BOUNDARIES`) names each ownable
+compile boundary — the GAN multi/single/conditional steps per family ×
+dtype policy, the AE chunk/init programs (dense, laned, padded, multi-
+dataset), the serve AOT heads, the mesh-launched variant through
+``parallel/rules.py`` — with a factory that builds it at tiny fixture
+shapes.  The engine traces each factory's program to a ClosedJaxpr
+(``jax.make_jaxpr``) and lowers it to StableHLO text (through the
+version-gated ``utils/jax_compat.py`` stage helpers), then runs the
+JPX program rules (``rules/jpx_*.py``) over both:
+
+==========  ============================================================
+JPX001      donation completeness — state pytree in AND out, not donated
+JPX002      precision-policy conformance — f32 dots in a bf16 program
+JPX003      host callback/sync inside a scan/while body
+JPX004      recompile hazards — weak-typed interface, captured scalars
+JPX005      sharding-constraint loss — declared layout, unannotated HLO
+JPX006      scan-carry bloat past the boundary's declared byte budget
+==========  ============================================================
+
+Findings anchor at the boundary's registry row HERE (``label=...``
+line), flow through the shared machinery — ``# noqa: JPXnnn`` on that
+row, the audit baseline (``audit_baseline.json``), SARIF with a
+``properties.boundary`` join key the perf microscope's ``obs explain``
+reads — and are cached per boundary in ``.analysis-programs-cache.json``
+keyed by (defining-module shas, analyzer self-hash, installed jax
+version), so a warm audit never imports jax at all.
+
+Tracing is per-boundary fault-isolated: a runtime that cannot build or
+lower one boundary records a *skip note* for it and keeps auditing the
+rest — graceful degradation, never a crash.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from hfrep_tpu.analysis.engine import (REPO_ROOT, FileContext, Finding,
+                                       _self_hash, jax_version)
+from hfrep_tpu.analysis.rules.jpx_base import PROGRAMS_PATH
+
+#: per-boundary finding cache (gitignored; safe to delete any time).
+#: Separate from ``.analysis-cache.json``: the file cache prunes entries
+#: by on-disk path existence, and boundary labels are not paths.
+DEFAULT_AUDIT_CACHE = REPO_ROOT / ".analysis-programs-cache.json"
+AUDIT_CACHE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Boundary:
+    """One registered compile boundary.
+
+    ``label`` — unique registry id, ``<runtime vocabulary>[@variant]``;
+    the part before ``@`` is the perf-microscope label the same program
+    is fingerprinted under at runtime (the ``obs explain`` join key).
+    ``modules`` — repo-relative files whose content defines the traced
+    program (the cache/``--changed`` scope).  ``factory`` — zero-arg
+    callable returning ``(fn, args)`` ready to trace; it (not this
+    module) imports jax and the subject modules, so registry
+    introspection stays import-free.  ``donate`` — the argnums the
+    PRODUCTION launch donates (declared, because the CPU backend the
+    audit runs on does not implement donation: ``replication/
+    engine.py::_donate_argnums``).  ``policy`` — "fp32" | "bf16", the
+    compute-dtype promise JPX002 holds the trace to, with
+    ``f32_dot_allow`` exemptions for deliberate fp32 stages.
+    ``carry_budget_bytes`` — JPX006 ceiling at these fixture shapes.
+    ``expect_sharding`` — JPX005 contract (False on this 1-device
+    runtime: ``normalize_spec`` strips the axes, no annotation can
+    appear).  ``site`` — the RUNTIME_SITES row this boundary audits.
+    """
+
+    label: str
+    kind: str
+    modules: Tuple[str, ...]
+    site: str
+    factory: Optional[Callable[[], Tuple[Callable, tuple]]] = None
+    donate: Tuple[int, ...] = ()
+    policy: str = "fp32"
+    f32_dot_allow: int = 0
+    carry_budget_bytes: Optional[int] = None
+    expect_sharding: bool = False
+    notes: str = ""
+
+    @property
+    def runtime_label(self) -> str:
+        return self.label.split("@", 1)[0]
+
+
+# ------------------------------------------------------------- factories
+# Tiny fixture shapes throughout: window=6, features=4, hidden=8,
+# batch=4, 8 training windows, steps_per_call=2, n_critic=2 (keeps the
+# critic fori_loop — the production program shape); AE n_factors=4,
+# latent_dim=3, epochs=chunk_epochs=2.  Small enough that a cold audit
+# of every boundary traces in seconds on one CPU, big enough that the
+# state trees clear the JPX001 state-likeness thresholds.
+
+def _gan_fixture(family: str, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu.config import ModelConfig, TrainConfig
+    from hfrep_tpu.models.registry import build_gan
+    from hfrep_tpu.train.states import init_gan_state
+
+    mcfg = ModelConfig(family=family, hidden=8, features=4, window=6,
+                       dtype=dtype)
+    tcfg = TrainConfig(epochs=2, batch_size=4, n_critic=2, steps_per_call=2)
+    pair = build_gan(mcfg)
+    dataset = jnp.zeros((8, 6, 4), jnp.float32)
+    state = init_gan_state(jax.random.PRNGKey(0), mcfg, tcfg, pair)
+    return mcfg, tcfg, pair, dataset, state
+
+
+def _gan_multi_factory(family: str, dtype: str = "float32"):
+    def build():
+        import jax
+
+        from hfrep_tpu.train.steps import make_multi_step
+        _, tcfg, pair, dataset, state = _gan_fixture(family, dtype)
+        fn = make_multi_step(pair, tcfg, dataset, jit=False)
+        return fn, (state, jax.random.PRNGKey(1))
+    return build
+
+
+def _gan_train_step_factory(family: str, dtype: str = "float32"):
+    def build():
+        import jax
+
+        from hfrep_tpu.train.steps import make_train_step
+        _, tcfg, pair, dataset, state = _gan_fixture(family, dtype)
+        fn = make_train_step(pair, tcfg, dataset)
+        return fn, (state, jax.random.PRNGKey(1))
+    return build
+
+
+def _conditional_factory():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from hfrep_tpu.config import ModelConfig, TrainConfig
+        from hfrep_tpu.models.registry import build_conditional_gan
+        from hfrep_tpu.train.states import init_conditional_state
+        from hfrep_tpu.train.steps import make_conditional_step, make_multi_step
+
+        mcfg = ModelConfig(family="gan", hidden=8, features=4, window=6)
+        tcfg = TrainConfig(epochs=2, batch_size=4, n_critic=2,
+                           steps_per_call=2)
+        pair = build_conditional_gan(mcfg, cond_dim=3)
+        dataset = jnp.zeros((8, 6, 4), jnp.float32)
+        conds = jnp.zeros((8, 3), jnp.float32)
+        step = make_conditional_step(pair, tcfg, dataset, conds)
+        state = init_conditional_state(jax.random.PRNGKey(0), mcfg, tcfg,
+                                       pair, 3)
+        fn = make_multi_step(pair, tcfg, dataset, jit=False, step=step)
+        return fn, (state, jax.random.PRNGKey(1))
+    return build
+
+
+def _mesh_multi_factory():
+    def build():
+        import jax
+
+        from hfrep_tpu.parallel.rules import (MeshSpec, build_mesh,
+                                              make_gan_multi_step)
+        _, tcfg, pair, dataset, state = _gan_fixture("gan", "float32")
+        mesh = build_mesh(MeshSpec(dp=1))
+        fn = make_gan_multi_step(pair, tcfg, dataset, mesh, jit=False)
+        return fn, (state, jax.random.PRNGKey(1))
+    return build
+
+
+def _ae_cfg():
+    from hfrep_tpu.config import AEConfig
+    return AEConfig(n_factors=4, latent_dim=3, epochs=2, chunk_epochs=2,
+                    batch_size=4, patience=1)
+
+
+def _ae_chunk_factory(kind: str, padded: bool = False, n_lanes: int = 2,
+                      n_datasets: int = 2):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from hfrep_tpu.replication import engine as rep
+
+        cfg = _ae_cfg()
+        x = jnp.zeros((10, 4), jnp.float32)
+        fn = rep._chunk_fn(cfg, kind)
+        if kind == "single":
+            carry, keys = rep._init_program(cfg, "single")(
+                jax.random.PRNGKey(0), x)
+            rows = ((jnp.asarray(8, jnp.int32), jnp.asarray(6, jnp.int32))
+                    if padded else None)
+            return fn, (carry, keys, x, None, rows)
+        if kind == "lanes":
+            lane_keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
+            carry, keys = rep._init_program(cfg, "lanes")(lane_keys, x)
+            masks = jnp.ones((n_lanes, cfg.latent_dim), jnp.float32)
+            rows = ((jnp.asarray(8, jnp.int32), jnp.asarray(6, jnp.int32))
+                    if padded else None)
+            return fn, (carry, keys, x, masks, rows)
+        # multi: D stacked padded datasets × L latent lanes
+        xs = jnp.zeros((n_datasets, 10, 4), jnp.float32)
+        dkeys = jax.random.split(jax.random.PRNGKey(0), n_datasets)
+        carry, keys = rep._init_program(cfg, "multi", n_lanes=n_lanes)(
+            dkeys, xs)
+        masks = jnp.ones((n_lanes, cfg.latent_dim), jnp.float32)
+        rows = (jnp.full((n_datasets,), 8, jnp.int32),
+                jnp.full((n_datasets,), 6, jnp.int32))
+        return fn, (carry, keys, xs, masks, rows)
+    return build
+
+
+def _ae_init_factory():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from hfrep_tpu.replication import engine as rep
+
+        cfg = _ae_cfg()
+        x = jnp.zeros((10, 4), jnp.float32)
+        fn = rep._init_program(cfg, "single")
+        return fn, (jax.random.PRNGKey(0), x)
+    return build
+
+
+def _serve_replicate_factory(dtype: str = "float32"):
+    def build():
+        import dataclasses as _dc
+
+        import jax
+        import jax.numpy as jnp
+
+        from hfrep_tpu.serve.aot import AEServeModel, ae_batch_fn, full_mask
+
+        cfg = _dc.replace(_ae_cfg(), dtype=dtype)
+        params = {"encoder_kernel": jnp.zeros((4, 3), jnp.float32),
+                  "decoder_kernel": jnp.zeros((3, 4), jnp.float32)}
+        model = AEServeModel.create(cfg, params)
+        fn = ae_batch_fn(model)
+        x = jnp.zeros((2, 32, 4), jnp.float32)
+        n_rows = jnp.full((2,), 32, jnp.int32)
+        return fn, (model.params, x, n_rows, full_mask(cfg))
+    return build
+
+
+def _serve_sample_factory():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from hfrep_tpu.config import ModelConfig
+        from hfrep_tpu.models.registry import build_gan
+        from hfrep_tpu.serve.aot import GenServeModel, gen_batch_fn
+
+        mcfg = ModelConfig(family="gan", hidden=8, features=4, window=6)
+        pair = build_gan(mcfg)
+        params = pair.generator.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 6, 4), jnp.float32))["params"]
+        model = GenServeModel.create(mcfg, params)
+        fn = gen_batch_fn(model)
+        noise = jnp.zeros((2, 6, 4), jnp.float32)
+        return fn, (model.params, noise)
+    return build
+
+
+# -------------------------------------------------------------- registry
+_TRAIN_MODULES = ("hfrep_tpu/train/steps.py", "hfrep_tpu/train/states.py",
+                  "hfrep_tpu/models/registry.py", "hfrep_tpu/config.py")
+_AE_MODULES = ("hfrep_tpu/replication/engine.py",
+               "hfrep_tpu/models/autoencoder.py", "hfrep_tpu/config.py")
+_SERVE_MODULES = ("hfrep_tpu/serve/aot.py",
+                  "hfrep_tpu/models/autoencoder.py",
+                  "hfrep_tpu/models/registry.py", "hfrep_tpu/config.py")
+
+#: Carry budgets (JPX006) are measured-at-fixture-shapes × ~1.5 — see
+#: the burn-down table in the PR that landed this phase; a budget is a
+#: per-scan ceiling, and vmapped lane grids multiply the leaf sizes.
+PROGRAM_BOUNDARIES: Tuple[Boundary, ...] = (
+    Boundary(label="compile:multi_step@gan", kind="gan_multi",
+             modules=_TRAIN_MODULES, site="trainer_multi_step",
+             factory=_gan_multi_factory("gan"), donate=(0,),
+             carry_budget_bytes=5_500),
+    Boundary(label="compile:multi_step@wgan", kind="gan_multi",
+             modules=_TRAIN_MODULES, site="trainer_multi_step",
+             factory=_gan_multi_factory("wgan"), donate=(0,),
+             carry_budget_bytes=5_500),
+    Boundary(label="compile:multi_step@wgan_gp", kind="gan_multi",
+             modules=_TRAIN_MODULES, site="trainer_multi_step",
+             factory=_gan_multi_factory("wgan_gp"), donate=(0,),
+             carry_budget_bytes=5_500),
+    Boundary(label="compile:multi_step@wgan_gp_bf16", kind="gan_multi",
+             modules=_TRAIN_MODULES, site="trainer_multi_step",
+             factory=_gan_multi_factory("wgan_gp", "bfloat16"),
+             donate=(0,), policy="bf16", carry_budget_bytes=5_500),
+    Boundary(label="compile:multi_step@mtss_bf16", kind="gan_multi",
+             modules=_TRAIN_MODULES, site="trainer_multi_step",
+             factory=_gan_multi_factory("mtss_wgan_gp", "bfloat16"),
+             donate=(0,), policy="bf16", carry_budget_bytes=25_000),
+    Boundary(label="compile:train_step@gan", kind="gan_step",
+             modules=_TRAIN_MODULES, site="trainer_single_step",
+             factory=_gan_train_step_factory("gan"), donate=(0,),
+             carry_budget_bytes=5_500),
+    Boundary(label="compile:conditional_step@gan", kind="gan_multi",
+             modules=_TRAIN_MODULES + ("hfrep_tpu/scenario/conditional.py",),
+             site="conditional_multi_step",
+             factory=_conditional_factory(), donate=(0,),
+             carry_budget_bytes=6_500),
+    Boundary(label="compile:dp_multi_step@gan", kind="gan_mesh",
+             modules=_TRAIN_MODULES + ("hfrep_tpu/parallel/rules.py",),
+             site="mesh_launch",
+             factory=_mesh_multi_factory(), donate=(0,),
+             carry_budget_bytes=5_500,
+             notes="1-device dp mesh on this runtime: axes stripped, "
+                   "expect_sharding False by design"),
+    Boundary(label="ae_chunk:single", kind="ae_chunk",
+             modules=_AE_MODULES, site="ae_chunk",
+             factory=_ae_chunk_factory("single"), donate=(0,),
+             carry_budget_bytes=512),
+    Boundary(label="ae_chunk:lanes", kind="ae_chunk",
+             modules=_AE_MODULES, site="ae_chunk",
+             factory=_ae_chunk_factory("lanes"), donate=(0,),
+             carry_budget_bytes=1_024),
+    Boundary(label="ae_chunk:lanes@padded", kind="ae_chunk",
+             modules=_AE_MODULES, site="ae_chunk",
+             factory=_ae_chunk_factory("lanes", padded=True), donate=(0,),
+             carry_budget_bytes=1_024),
+    Boundary(label="ae_chunk:multi", kind="ae_chunk",
+             modules=_AE_MODULES, site="ae_chunk",
+             factory=_ae_chunk_factory("multi"), donate=(0,),
+             carry_budget_bytes=2_048),
+    Boundary(label="ae_chunk:init", kind="ae_init",
+             modules=_AE_MODULES, site="ae_chunk",
+             factory=_ae_init_factory(),
+             notes="(keys, xs) -> carry: nothing recurs, nothing to "
+                   "donate — the JPX001 negative shape"),
+    Boundary(label="serve:replicate", kind="serve",
+             modules=_SERVE_MODULES, site="serve_replicate",
+             factory=_serve_replicate_factory(),
+             notes="params stay device-resident across requests: "
+                   "donation would free the registered weights"),
+    Boundary(label="serve:replicate@bf16", kind="serve",
+             modules=_SERVE_MODULES, site="serve_replicate",
+             factory=_serve_replicate_factory("bfloat16"), policy="bf16"),
+    Boundary(label="serve:sample", kind="serve",
+             modules=_SERVE_MODULES, site="serve_sample",
+             factory=_serve_sample_factory()),
+)
+
+BOUNDARIES_BY_LABEL = {b.label: b for b in PROGRAM_BOUNDARIES}
+
+
+# ---------------------------------------------------- runtime-site table
+#: Every place the RUNTIME fingerprints a compiled program (the perf
+#: microscope's label vocabulary) or dispatches an owned compile
+#: boundary.  ``token`` must appear verbatim in ``file`` — the
+#: registry-completeness test greps the live source, so a refactor that
+#: moves or renames a boundary breaks THIS table loudly instead of
+#: silently dropping audit coverage.  ``audited=True`` rows must be
+#: covered by >= 1 PROGRAM_BOUNDARIES entry (matched on ``site``);
+#: ``audited=False`` rows carry the reason no static row exists.
+RUNTIME_SITES: Dict[str, Dict[str, Any]] = {
+    "trainer_multi_step": {
+        "file": "hfrep_tpu/train/trainer.py",
+        "token": 'instrument_step(',
+        "audited": True},
+    "trainer_single_step": {
+        "file": "hfrep_tpu/train/trainer.py",
+        "token": "donate_argnums=(0,)",
+        "audited": True},
+    "conditional_multi_step": {
+        "file": "hfrep_tpu/scenario/conditional.py",
+        "token": "make_multi_step(",
+        "audited": True},
+    "mesh_launch": {
+        "file": "hfrep_tpu/parallel/rules.py",
+        "token": "_launch_name(mesh, kind)",
+        "audited": True},
+    "ae_chunk": {
+        "file": "hfrep_tpu/replication/engine.py",
+        "token": 'f"ae_chunk:{kind}"',
+        "audited": True},
+    "serve_replicate": {
+        "file": "hfrep_tpu/serve/server.py",
+        "token": 'f"serve:replicate:b',
+        "audited": True},
+    "serve_sample": {
+        "file": "hfrep_tpu/serve/server.py",
+        "token": 'f"serve:sample:b',
+        "audited": True},
+    "pp_train_step": {
+        "file": "hfrep_tpu/parallel/layer_pipeline.py",
+        "token": '"pp_train_step"',
+        "audited": False,
+        "why": "manual shard_map layer pipeline — dead on the pinned "
+               "runtime (HAS_SHARD_MAP gate, HF005_KILL_LIST.md); "
+               "cannot be traced here"},
+    "bench_multi_step": {
+        "file": "bench.py",
+        "token": 'f"bench:{label}"',
+        "audited": False,
+        "why": "profiles the SAME make_multi_step program the "
+               "trainer_multi_step rows audit, at bench shapes"},
+    "perf_probe": {
+        "file": "tools/perf_probe.py",
+        "token": '"perf_probe:',
+        "audited": False,
+        "why": "ad-hoc calibration probes, not production dispatch "
+               "paths; each wraps a program another site owns"},
+}
+
+
+def discover_label_calls(
+        paths: Optional[Sequence[Path]] = None) -> List[Tuple[str, str, str]]:
+    """AST-scan the runtime tree for compile-boundary *creation* sites:
+    calls to ``instrument_step`` / ``instrument_launch`` /
+    ``profile_jitted`` / ``profile_stage`` / ``aot_compile``.  Returns
+    ``(repo-relative file, callee, label-prefix)`` triples, where the
+    label prefix is the leading literal text of the label argument (""
+    when fully dynamic).  The completeness test asserts every triple is
+    accounted for by a RUNTIME_SITES row in the same file — a NEW
+    runtime boundary added without registry coverage fails tier-1.
+    """
+    callees = {"instrument_step", "instrument_launch", "profile_jitted",
+               "profile_stage", "aot_compile"}
+    # the defining/forwarding modules: calls there are the mechanism,
+    # not a boundary of their own
+    skip = {"hfrep_tpu/obs/__init__.py", "hfrep_tpu/obs/attrib.py",
+            "hfrep_tpu/serve/aot.py"}
+    if paths is None:
+        paths = ([*sorted((REPO_ROOT / "hfrep_tpu").rglob("*.py")),
+                  *sorted((REPO_ROOT / "tools").glob("*.py")),
+                  *sorted(REPO_ROOT.glob("bench*.py"))])
+    out: List[Tuple[str, str, str]] = []
+    for f in paths:
+        rel = f.resolve().relative_to(REPO_ROOT).as_posix()
+        if rel in skip or rel.startswith("hfrep_tpu/analysis/"):
+            continue
+        try:
+            tree = ast.parse(f.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name not in callees:
+                continue
+            out.append((rel, name, _label_prefix(node, name)))
+    return out
+
+
+def _label_prefix(call: ast.Call, callee: str) -> str:
+    """Leading literal text of the call's label argument."""
+    label: Optional[ast.AST] = None
+    for kw in call.keywords:
+        if kw.arg in ("label", "name"):
+            label = kw.value
+    if label is None:
+        pos = {"instrument_step": 1, "instrument_launch": 1,
+               "profile_jitted": 1, "profile_stage": 0}.get(callee)
+        if pos is not None and len(call.args) > pos:
+            label = call.args[pos]
+    if isinstance(label, ast.Constant) and isinstance(label.value, str):
+        return label.value
+    if isinstance(label, ast.JoinedStr) and label.values:
+        first = label.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return ""
+
+
+# --------------------------------------------------------------- tracing
+def registry_lines() -> Dict[str, int]:
+    """label -> 1-based line of its ``label="..."`` registry row here —
+    the anchor (and ``# noqa``) line for that boundary's findings."""
+    out: Dict[str, int] = {}
+    src = Path(__file__).read_text(encoding="utf-8")
+    for i, line in enumerate(src.splitlines(), 1):
+        for label in BOUNDARIES_BY_LABEL:
+            if f'label="{label}"' in line:
+                out.setdefault(label, i)
+    return out
+
+
+def _leaf_avals(tree) -> Tuple[Any, ...]:
+    import jax
+
+    def aval(x):
+        get = getattr(x, "aval", None)
+        if get is not None:
+            return get
+        return jax.api_util.shaped_abstractify(x)
+    return tuple(aval(leaf) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def trace_boundary(boundary: Boundary, line: int = 1):
+    """Build + trace one boundary; returns a ``ProgramContext`` or
+    raises — the caller owns the graceful-skip policy."""
+    import jax
+
+    from hfrep_tpu.analysis.rules.jpx_base import ProgramContext
+    from hfrep_tpu.utils import jax_compat
+
+    fn, args = boundary.factory()
+    # prefer the plain python function for make_jaxpr so rules see the
+    # real eqns, not one opaque outer pjit (jax.jit exposes __wrapped__)
+    plain = getattr(fn, "__wrapped__", fn)
+    closed = jax.make_jaxpr(plain)(*args)
+    arg_avals = tuple(_leaf_avals(a) for a in args)
+    out_avals = tuple(closed.out_avals)
+    # lowering can legitimately fail where tracing succeeded (backend-
+    # specific ops); HLO-level rules degrade, jaxpr-level rules still run
+    lowered = (jax_compat.lower_jitted(fn, *args)
+               if hasattr(fn, "lower")
+               else jax_compat.lower_jitted(jax.jit(plain), *args))
+    hlo = jax_compat.stage_hlo_text(lowered) if lowered is not None else None
+    return ProgramContext(boundary, jaxpr=closed, hlo=hlo,
+                          arg_avals=arg_avals, out_avals=out_avals,
+                          line=line)
+
+
+@dataclasses.dataclass
+class AuditResult:
+    findings: List[Finding]
+    traced: List[str]                       # labels actually traced/cached
+    skipped: Dict[str, str]                 # label -> reason
+
+    @property
+    def boundary_of(self) -> Dict[str, str]:
+        """finding fingerprint -> runtime label (the SARIF/obs join);
+        snippets lead with the registry label by construction."""
+        return {f.fingerprint: f.snippet.split(" ", 1)[0].split("@", 1)[0]
+                for f in self.findings}
+
+
+# ---------------------------------------------------------------- caching
+def _boundary_key(boundary: Boundary) -> str:
+    h = hashlib.sha256()
+    for rel in boundary.modules:
+        p = REPO_ROOT / rel
+        h.update(rel.encode())
+        try:
+            h.update(hashlib.sha256(p.read_bytes()).hexdigest().encode())
+        except OSError:
+            h.update(b"<missing>")
+    return h.hexdigest()
+
+
+def load_audit_cache(path) -> dict:
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+    if (not isinstance(data, dict)
+            or data.get("version") != AUDIT_CACHE_VERSION
+            or data.get("self") != _self_hash()
+            or data.get("jax") != jax_version()):
+        return {}
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return {}
+    return {k: e for k, e in entries.items() if isinstance(e, dict)}
+
+
+def save_audit_cache(path, entries: dict) -> None:
+    p = Path(path)
+    tmp = p.parent / f".{p.name}.tmp-{os.getpid()}"
+    try:
+        tmp.write_text(json.dumps({
+            "version": AUDIT_CACHE_VERSION, "self": _self_hash(),
+            "jax": jax_version(), "entries": entries}), encoding="utf-8")
+        os.replace(tmp, p)
+    except OSError:
+        tmp.unlink(missing_ok=True)
+
+
+# ------------------------------------------------------------- the audit
+def audit_boundaries(boundaries: Optional[Sequence[Boundary]] = None,
+                     rules: Optional[Sequence] = None,
+                     cache_path=None, use_cache: bool = True,
+                     restrict_to: Optional[Set[str]] = None) -> AuditResult:
+    """Trace + rule-check every registered boundary.
+
+    ``restrict_to`` (the ``--changed`` scope): repo-relative paths —
+    only boundaries whose ``modules`` intersect it are audited.  Per-
+    boundary results are cached keyed on the defining modules' shas
+    (plus, at the document level, the analyzer self-hash and the
+    installed jax version); an all-warm audit therefore never imports
+    jax.  ``# noqa: JPXnnn`` on a registry row filters that row's
+    findings here, at report time, through the ordinary FileContext.
+    """
+    from hfrep_tpu.analysis.rules import PROGRAM_RULES
+
+    boundaries = (list(boundaries) if boundaries is not None
+                  else list(PROGRAM_BOUNDARIES))
+    rules = list(rules) if rules is not None else list(PROGRAM_RULES)
+    cache_file = Path(cache_path) if cache_path else DEFAULT_AUDIT_CACHE
+    cache = load_audit_cache(cache_file) if use_cache else {}
+    lines = registry_lines()
+
+    findings: List[Finding] = []
+    traced: List[str] = []
+    skipped: Dict[str, str] = {}
+    rule_ids = ",".join(r.id for r in rules)
+
+    for b in boundaries:
+        if restrict_to is not None and not set(b.modules) & restrict_to:
+            continue
+        if b.factory is None:
+            skipped[b.label] = b.notes or "no factory registered"
+            continue
+        key = f"{_boundary_key(b)}:{rule_ids}"
+        # cache slot per (label, rule set): a ``--select`` run must not
+        # evict the full-rule entries check.sh's warm path relies on
+        slot = f"{b.label}::{rule_ids}"
+        entry = cache.get(slot)
+        if entry and entry.get("key") == key:
+            try:
+                cached = [Finding(**fd) for fd in entry.get("findings", [])]
+            except TypeError:
+                cached = None
+            if cached is not None:
+                if entry.get("skip"):
+                    skipped[b.label] = str(entry["skip"])
+                else:
+                    traced.append(b.label)
+                findings.extend(cached)
+                continue
+        line = lines.get(b.label, 1)
+        try:
+            pctx = trace_boundary(b, line=line)
+        except Exception as e:     # graceful per-boundary skip, by contract
+            reason = f"{type(e).__name__}: {e}"
+            skipped[b.label] = reason
+            cache[slot] = {"key": key, "findings": [], "skip": reason}
+            continue
+        b_findings: List[Finding] = []
+        for rule in rules:
+            b_findings.extend(rule.check_program(pctx))
+        traced.append(b.label)
+        cache[slot] = {"key": key, "skip": None,
+                       "findings": [dataclasses.asdict(f)
+                                    for f in b_findings]}
+        findings.extend(b_findings)
+
+    if use_cache:
+        save_audit_cache(cache_file, {
+            slot: e for slot, e in cache.items()
+            if slot.split("::", 1)[0] in BOUNDARIES_BY_LABEL})
+
+    findings = _apply_registry_noqa(findings)
+    findings.sort(key=lambda f: (f.line, f.rule, f.snippet))
+    return AuditResult(findings=findings, traced=traced, skipped=skipped)
+
+
+def _apply_registry_noqa(findings: List[Finding]) -> List[Finding]:
+    src_path = REPO_ROOT / PROGRAMS_PATH
+    try:
+        ctx = FileContext(src_path, src_path.read_text(encoding="utf-8"),
+                          relpath=PROGRAMS_PATH)
+    except (OSError, SyntaxError):
+        return findings
+    return [f for f in findings if not ctx.suppressed(f)]

@@ -18,6 +18,13 @@ from hfrep_tpu.analysis.rules.hf_version_gate import VersionGateRule
 from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
 from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
 from hfrep_tpu.analysis.rules.hf_mesh_launch import MeshLaunchRule
+from hfrep_tpu.analysis.rules.jpx_base import ProgramRule  # noqa: F401
+from hfrep_tpu.analysis.rules.jpx_donation import ProgramDonationRule
+from hfrep_tpu.analysis.rules.jpx_precision import ProgramPrecisionRule
+from hfrep_tpu.analysis.rules.jpx_hostsync import ProgramHostSyncRule
+from hfrep_tpu.analysis.rules.jpx_retrace import ProgramRetraceRule
+from hfrep_tpu.analysis.rules.jpx_sharding import ProgramShardingRule
+from hfrep_tpu.analysis.rules.jpx_carry import ProgramCarryRule
 
 ALL_RULES = (
     HostOpsInJitRule(),
@@ -39,3 +46,17 @@ ALL_RULES = (
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+#: phase-3 program-audit rules (ISSUE 16): run over TRACED programs by
+#: ``python -m hfrep_tpu.analysis audit``, never over source text —
+#: deliberately not in ALL_RULES so `check` runs stay jax-trace-free
+PROGRAM_RULES = (
+    ProgramDonationRule(),
+    ProgramPrecisionRule(),
+    ProgramHostSyncRule(),
+    ProgramRetraceRule(),
+    ProgramShardingRule(),
+    ProgramCarryRule(),
+)
+
+PROGRAM_RULES_BY_ID = {r.id: r for r in PROGRAM_RULES}

@@ -1,0 +1,154 @@
+"""Program-rule base + jaxpr walking helpers (phase 3, ISSUE 16).
+
+JPX rules audit *traced programs*, not source text: the audit engine
+(``hfrep_tpu/analysis/programs.py``) builds each registered compile
+boundary at tiny abstract shapes, traces it to a jaxpr and (where the
+runtime can) lowers it to StableHLO text, then hands both to every
+``ProgramRule.check_program``.  Crucially the RULES themselves import no
+jax: they duck-type the jaxpr object graph (``.eqns``, ``.params``,
+``.aval``) and regex the HLO string, so the registry tests, the warm
+cache path and the unit fixtures (which feed synthetic contexts) all
+run on a bare CPython — only a cold trace pays the jax import.
+
+Findings anchor at the boundary's registry row in ``programs.py`` (the
+one source line a human can edit), with a *label-stable* snippet so the
+fingerprint survives registry reshuffles, and ``# noqa: JPXnnn`` on
+that row suppresses through the ordinary :class:`FileContext` path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator, List, Optional, Tuple
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.base import Rule
+
+#: where every program finding anchors: the registry row in programs.py
+PROGRAMS_PATH = "hfrep_tpu/analysis/programs.py"
+
+#: jaxpr higher-order primitives whose sub-jaxprs are LOOP BODIES —
+#: an eqn found inside one executes per iteration, which is what makes
+#: a host callback there a per-step sync instead of a one-off
+LOOP_PRIMITIVES = frozenset({"scan", "while"})
+
+#: higher-order primitives to recurse through WITHOUT entering a loop
+#: scope (their bodies run at most once per call of the outer program)
+TRANSPARENT_PRIMITIVES = frozenset({
+    "pjit", "jit", "xla_call", "cond", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "remat", "remat2",
+    "checkpoint", "closed_call", "core_call",
+})
+
+
+class ProgramContext:
+    """Everything a JPX rule sees about one traced boundary.
+
+    ``boundary`` is the registry row (``programs.Boundary``);
+    ``jaxpr`` the ClosedJaxpr (or None when tracing failed but lowering
+    succeeded); ``hlo`` the StableHLO text (or None — jaxpr-level rules
+    still run); ``arg_avals`` one tuple of leaf avals per top-level
+    positional argument (the donation rule's unit of account);
+    ``out_avals`` the flat output avals.
+    """
+
+    def __init__(self, boundary, jaxpr=None, hlo: Optional[str] = None,
+                 arg_avals: Tuple[Tuple[Any, ...], ...] = (),
+                 out_avals: Tuple[Any, ...] = (), line: int = 1):
+        self.boundary = boundary
+        self.jaxpr = jaxpr
+        self.hlo = hlo
+        self.arg_avals = arg_avals
+        self.out_avals = out_avals
+        self.line = line
+
+    def finding(self, rule: str, message: str, token: str = "") -> Finding:
+        label = self.boundary.label
+        snippet = f"{label} {token}".strip()
+        return Finding(rule=rule, path=PROGRAMS_PATH, line=self.line,
+                       col=0, message=f"[{label}] {message}",
+                       snippet=snippet)
+
+
+class ProgramRule(Rule):
+    """A rule over traced programs.  ``check`` (the AST hook) is a no-op
+    so JPX rules can share registries/CLI plumbing with the text rules;
+    the real work happens in ``check_program``."""
+
+    def check(self, ctx) -> List[Finding]:
+        return []
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------ jaxpr walks
+def _as_open_jaxpr(obj):
+    """ClosedJaxpr -> Jaxpr; Jaxpr -> itself; None otherwise."""
+    if obj is None:
+        return None
+    inner = getattr(obj, "jaxpr", None)   # ClosedJaxpr carries .jaxpr
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return obj if hasattr(obj, "eqns") else None
+
+
+def _sub_jaxprs(params: dict) -> Iterator[Any]:
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            open_jx = _as_open_jaxpr(item)
+            if open_jx is not None:
+                yield item
+
+
+def iter_eqns(jaxpr, _in_loop: bool = False) -> Iterator[Tuple[Any, bool]]:
+    """Yield ``(eqn, in_loop)`` over the whole nested program, entering
+    scan/while/cond/pjit/custom_* sub-jaxprs; ``in_loop`` is True for
+    eqns that execute per loop iteration."""
+    open_jx = _as_open_jaxpr(jaxpr)
+    if open_jx is None:
+        return
+    for eqn in open_jx.eqns:
+        yield eqn, _in_loop
+        name = eqn.primitive.name
+        loop = _in_loop or name in LOOP_PRIMITIVES
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub, loop)
+
+
+def aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    itemsize = getattr(dtype, "itemsize", None)
+    if itemsize is None:
+        return 0
+    return int(math.prod(shape)) * int(itemsize) if shape else int(itemsize)
+
+
+def aval_sig(aval) -> Tuple[Tuple[int, ...], str]:
+    """(shape, dtype-name) signature used for carry-shape matching."""
+    return (tuple(getattr(aval, "shape", ()) or ()),
+            str(getattr(aval, "dtype", "?")))
+
+
+def eqn_in_avals(eqn) -> List[Any]:
+    out = []
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            out.append(aval)
+    return out
+
+
+def scan_carry_avals(eqn) -> List[Any]:
+    """The carry block of a ``scan`` eqn's body jaxpr (after the consts,
+    before the per-iteration xs)."""
+    body = eqn.params.get("jaxpr")
+    if body is None or not hasattr(body, "in_avals"):
+        return []
+    n_consts = int(eqn.params.get("num_consts", 0))
+    n_carry = int(eqn.params.get("num_carry", 0))
+    return list(body.in_avals[n_consts:n_consts + n_carry])

@@ -1,0 +1,58 @@
+"""JPX006 — scan-carry bloat against the boundary's declared budget.
+
+Everything that rides a ``lax.scan`` carry is live for EVERY iteration
+— XLA cannot free or overlap it — so carry bytes are the scarcest
+memory in the program.  This repo's carries are deliberately sized
+(params + opt state + a handful of scalars; the flight-recorder health
+traces ride the stacked OUTPUTS precisely to stay out of the carry),
+and each registered boundary declares a ``carry_budget_bytes`` ceiling
+at its audit fixture shapes.  A grown carry — someone threading a
+per-epoch metrics dict, a debug buffer, or an accidentally-carried
+dataset through the loop — blows the declared budget and fails the
+gate at analysis time, long before prod shapes multiply the waste by
+five orders of magnitude.
+
+The measurement walks every scan eqn in the (nested) jaxpr and sums
+the carry block of its body (``in_avals[num_consts : num_consts +
+num_carry]``); nested scans (vmapped lanes) each count separately, so
+the budget is per-scan, set ~1.5x the audited carry at registration
+time.  ``carry_budget_bytes=None`` (the default) skips the boundary.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import (ProgramContext, ProgramRule,
+                                               aval_bytes, iter_eqns,
+                                               scan_carry_avals)
+
+
+class ProgramCarryRule(ProgramRule):
+    id = "JPX006"
+    name = "program-carry"
+    description = ("a scan carry grew past the boundary's declared byte "
+                   "budget — carried state is live for every iteration "
+                   "and should hold params+opt state, not buffers")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        budget = pctx.boundary.carry_budget_bytes
+        if budget is None or pctx.jaxpr is None:
+            return []
+        findings: List[Finding] = []
+        for idx, (eqn, _) in enumerate(iter_eqns(pctx.jaxpr)):
+            if eqn.primitive.name != "scan":
+                continue
+            carry = scan_carry_avals(eqn)
+            total = sum(aval_bytes(a) for a in carry)
+            if total > budget:
+                findings.append(pctx.finding(
+                    self.id,
+                    f"scan #{idx} carries {total} bytes across "
+                    f"{len(carry)} leaves — over the declared budget of "
+                    f"{budget} bytes at audit shapes; move non-state "
+                    "through the stacked outputs or raise the declared "
+                    "budget with justification",
+                    token=f"scan{idx}"))
+        return findings

@@ -1,0 +1,77 @@
+"""JPX001 — donation completeness at a compile boundary.
+
+The failure mode: a jit boundary threads a large state pytree in and
+out (the ``state -> state'`` carry shape every training step here has)
+but the production launch never lists it in ``donate_argnums``.  XLA
+must then hold TWO copies of the parameters + optimizer state live
+across the dispatch — on a TPU at prod shapes that is the difference
+between fitting and OOMing, and it is invisible on CPU (the CPU backend
+does not implement donation at all, which is exactly why
+``replication/engine.py::_donate_argnums`` returns ``()`` there and why
+this must be a STATIC check on the declared production posture, not a
+runtime observation).
+
+The rule is structural: argument position ``i`` is *state-like* when
+its flattened leaves all reappear — as a (shape, dtype) multiset — in
+the program outputs, it carries at least ``MIN_STATE_LEAVES`` leaves
+(a params+opt-state tree, not a stray scalar), and its total bytes
+clear ``MIN_STATE_BYTES``.  Every state-like position must appear in
+the boundary's declared ``donate`` tuple (the registry row documents
+what production passes to ``donate_argnums`` on backends that honor
+it).  A deliberate non-donation gets ``# noqa: JPX001`` on its registry
+row with the justification in the row's ``notes``.
+
+Negative fixtures pinned in tests/test_analysis_programs.py:
+* pure programs (outputs share no leaf signature with any input);
+* small scalar carries (a step counter in, step counter out);
+* init programs (``(keys, xs) -> carry``: inputs never reappear);
+* boundaries whose state-like args ARE declared donated.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import (ProgramContext, ProgramRule,
+                                               aval_bytes, aval_sig)
+
+#: a "state tree" here is params + optimizer state — always several
+#: leaves; 3 keeps PRNG keys and (data, mask) pairs out of scope
+MIN_STATE_LEAVES = 3
+#: and it must be worth donating — tiny fixture trees still clear this,
+#: loop counters and masks do not
+MIN_STATE_BYTES = 512
+
+
+class ProgramDonationRule(ProgramRule):
+    id = "JPX001"
+    name = "program-donation"
+    description = ("jit boundary threads a large state pytree in and out "
+                   "but the production launch does not donate it — XLA "
+                   "holds two copies of params+opt state per dispatch")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        out_sigs = Counter(aval_sig(a) for a in pctx.out_avals)
+        findings: List[Finding] = []
+        for i, leaves in enumerate(pctx.arg_avals):
+            if i in pctx.boundary.donate:
+                continue
+            if len(leaves) < MIN_STATE_LEAVES:
+                continue
+            total = sum(aval_bytes(a) for a in leaves)
+            if total < MIN_STATE_BYTES:
+                continue
+            arg_sigs = Counter(aval_sig(a) for a in leaves)
+            if arg_sigs - out_sigs:       # some leaf never comes back out
+                continue
+            findings.append(pctx.finding(
+                self.id,
+                f"arg {i} is a state-like pytree ({len(leaves)} leaves, "
+                f"{total} bytes) returned by the program but absent from "
+                f"the declared donate_argnums {pctx.boundary.donate!r}; "
+                "donate it (or justify with # noqa: JPX001 on the "
+                "registry row)",
+                token=f"arg{i}"))
+        return findings

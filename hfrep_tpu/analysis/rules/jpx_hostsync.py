@@ -1,0 +1,53 @@
+"""JPX003 — host transfer/sync inside a loop body.
+
+A callback primitive under ``scan``/``while`` forces a device→host
+round-trip PER ITERATION: the multi-step scan that exists to amortize
+one ~4ms dispatch over 50 epochs silently degrades back to one sync per
+epoch, and the dispatch-vs-compute overlap the perf microscope
+measures collapses (the arxiv 2111.04628 argument, enforced at compile
+time instead of discovered in a bench round).
+
+Flagged: ``pure_callback`` / ``io_callback`` / ``debug_callback`` (and
+the infeed/outfeed pair) appearing in an eqn whose enclosing scope is a
+loop body.  The SAME primitives at top level are fine — a one-off
+host call per program dispatch is the ordinary logging/IO posture, and
+the AST rule JAX001 already polices host *Python* in jitted scopes;
+this rule sees what survived tracing, where f-string debug prints and
+`jax.debug.print` become real callback eqns.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import (ProgramContext, ProgramRule,
+                                               iter_eqns)
+
+SYNC_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call", "infeed", "outfeed",
+})
+
+
+class ProgramHostSyncRule(ProgramRule):
+    id = "JPX003"
+    name = "program-host-sync"
+    description = ("host callback/transfer primitive inside a scan/while "
+                   "body — one device→host sync per loop iteration "
+                   "defeats the multi-step dispatch amortization")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        if pctx.jaxpr is None:
+            return []
+        hits = {}
+        for eqn, in_loop in iter_eqns(pctx.jaxpr):
+            name = eqn.primitive.name
+            if in_loop and name in SYNC_PRIMITIVES:
+                hits[name] = hits.get(name, 0) + 1
+        return [pctx.finding(
+            self.id,
+            f"{n}× `{name}` inside a loop body — a host sync per "
+            "iteration; hoist it out of the scan or batch it into the "
+            "stacked per-epoch outputs",
+            token=name) for name, n in sorted(hits.items())]

@@ -1,0 +1,86 @@
+"""JPX002 — precision-policy conformance of a traced program.
+
+A boundary registered with ``policy="bf16"`` promises its matmuls run
+at the accelerator's matrix-unit rate.  The classic leak: one code path
+builds the model WITHOUT threading the compute dtype (a constructor
+default, a serving head built from a different factory than training),
+and the bf16 configuration silently traces full-f32 dots — correct
+numerics, 8x the MXU cost, and nothing crashes so nobody notices.
+
+The check counts f32-input ``dot_general``/``conv_general_dilated``
+eqns in the traced jaxpr (recursing through scan/cond/pjit bodies).
+fp32 *accumulation* is deliberate policy here — losses, optimizer
+updates and reductions lift to f32 (``core/precision.py``) — but those
+are adds/mults, not dots; the rare legitimate f32 dot under a bf16
+policy (e.g. an fp32 OLS solve stage fused into the same program) is
+declared per boundary via ``f32_dot_allow``.  Boundaries with
+``policy="fp32"`` (the default) are exempt: all-f32 programs are the
+contract there.
+
+When a jaxpr is unavailable but HLO text is, the same census runs over
+``stablehlo.dot_general``/``stablehlo.convolution`` lines with f32
+operand tensor types — the fixture tests pin both paths.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import (ProgramContext, ProgramRule,
+                                               eqn_in_avals, iter_eqns)
+
+DOT_PRIMITIVES = frozenset({"dot_general", "conv_general_dilated"})
+
+#: one StableHLO dot/conv op line; operand types trail in the signature
+_HLO_DOT_RE = re.compile(
+    r"stablehlo\.(dot_general|convolution)\b.*?:\s*\(([^)]*)\)")
+
+
+def _count_f32_dots_jaxpr(jaxpr) -> int:
+    n = 0
+    for eqn, _ in iter_eqns(jaxpr):
+        if eqn.primitive.name not in DOT_PRIMITIVES:
+            continue
+        dtypes = {str(getattr(a, "dtype", "?")) for a in eqn_in_avals(eqn)}
+        if dtypes and all(d == "float32" for d in dtypes):
+            n += 1
+    return n
+
+
+def _count_f32_dots_hlo(hlo: str) -> int:
+    n = 0
+    for m in _HLO_DOT_RE.finditer(hlo):
+        operand_types = m.group(2)
+        if "xf32>" in operand_types or "tensor<f32>" in operand_types:
+            if "bf16" not in operand_types:
+                n += 1
+    return n
+
+
+class ProgramPrecisionRule(ProgramRule):
+    id = "JPX002"
+    name = "program-precision"
+    description = ("f32 dot/conv in the compute path of a bf16-policy "
+                   "program — a dtype not threaded through one build "
+                   "path runs the matmuls off the MXU fast path")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        if pctx.boundary.policy != "bf16":
+            return []
+        if pctx.jaxpr is not None:
+            count, via = _count_f32_dots_jaxpr(pctx.jaxpr), "jaxpr"
+        elif pctx.hlo is not None:
+            count, via = _count_f32_dots_hlo(pctx.hlo), "hlo"
+        else:
+            return []
+        allow = pctx.boundary.f32_dot_allow
+        if count <= allow:
+            return []
+        return [pctx.finding(
+            self.id,
+            f"{count} f32 dot/conv op(s) in a bf16-policy program "
+            f"(allowlist {allow}, counted via {via}) — a compute dtype "
+            "was not threaded into this build path",
+            token="f32dot")]

@@ -1,0 +1,80 @@
+"""JPX004 — recompile hazards visible in the traced program interface.
+
+jax caches compiled executables by abstract signature, and weak types
+are PART of that signature: a program whose input or output avals carry
+``weak_type=True`` was traced from a bare Python scalar, and the same
+call site later fed a concrete array (or a scalar of the other flavor)
+retraces and recompiles — the "two executables for what the author
+thinks is one program" hazard the perf microscope's
+``backend_compiles`` counter catches only after it has cost a compile
+storm.  Closure-captured Python scalars show up the same way: as
+weak-typed 0-d constvars baked into the jaxpr, where a config change
+that SHOULD have been a traced operand (or a static_argnum) silently
+recompiles per value.
+
+Flagged per boundary:
+* top-level input avals with ``weak_type=True`` (the caller passes a
+  raw Python number where production passes an array — signature
+  split);
+* output avals with ``weak_type=True`` (the program bakes a promotion
+  split into downstream consumers);
+* weak-typed 0-d constvars (closure-captured Python scalars).
+
+Inner literal constants (``x * 2`` inlines a weak f32 literal into an
+eqn) are NOT flagged — they are inside one executable and cannot split
+the cache; that false-positive class is pinned as a negative fixture.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import ProgramContext, ProgramRule
+
+
+def _weak(aval) -> bool:
+    return bool(getattr(aval, "weak_type", False))
+
+
+class ProgramRetraceRule(ProgramRule):
+    id = "JPX004"
+    name = "program-retrace"
+    description = ("weak-typed program interface or closure-captured "
+                   "Python scalar — the executable cache splits on "
+                   "promotion flavor and recompiles per scalar value")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        findings: List[Finding] = []
+        weak_in = sum(1 for leaves in pctx.arg_avals for a in leaves
+                      if _weak(a))
+        if weak_in:
+            findings.append(pctx.finding(
+                self.id,
+                f"{weak_in} weak-typed input aval(s): a Python scalar "
+                "reached the boundary where production passes an array — "
+                "`jnp.asarray` it (or make it a static_argnum)",
+                token="weak-in"))
+        weak_out = sum(1 for a in pctx.out_avals if _weak(a))
+        if weak_out:
+            findings.append(pctx.finding(
+                self.id,
+                f"{weak_out} weak-typed output aval(s): the program "
+                "publishes a promotion-split value downstream consumers "
+                "will retrace on",
+                token="weak-out"))
+        jaxpr = getattr(pctx.jaxpr, "jaxpr", None)
+        if jaxpr is not None:
+            weak_consts = sum(
+                1 for v in getattr(jaxpr, "constvars", ())
+                if _weak(getattr(v, "aval", None))
+                and not getattr(getattr(v, "aval", None), "shape", ()))
+            if weak_consts:
+                findings.append(pctx.finding(
+                    self.id,
+                    f"{weak_consts} closure-captured Python scalar(s) "
+                    "baked in as weak-typed constants — a config value "
+                    "that recompiles per change; thread it as a traced "
+                    "operand or a static_argnum",
+                    token="weak-const"))
+        return findings

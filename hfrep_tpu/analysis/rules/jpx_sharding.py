@@ -1,0 +1,48 @@
+"""JPX005 — sharding-constraint loss between declaration and program.
+
+The partition-rule layer (``parallel/rules.py``) declares per-leaf
+layouts; the lowered program is where they either landed or silently
+vanished (an ``in_shardings`` dropped by a refactor, a
+``with_sharding_constraint`` dead because the mesh axis got stripped).
+A boundary registered with ``expect_sharding=True`` promises its
+lowered HLO carries sharding annotations (``mhlo.sharding`` /
+``sharding =`` attributes); their total absence means GSPMD received a
+program with no layout intent at all and will replicate everything —
+correct, and quietly paying full-copy memory + all-gather traffic.
+
+On this pinned runtime every ownable mesh is one CPU device and
+``normalize_spec`` strips the axis names (no annotations CAN appear),
+so live registry rows declare ``expect_sharding=False`` and the rule's
+behavior is pinned by synthetic pos/neg fixtures; on a real pod the dp/
+tp rows flip the flag and the audit holds the layout contract.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hfrep_tpu.analysis.engine import Finding
+from hfrep_tpu.analysis.rules.jpx_base import ProgramContext, ProgramRule
+
+#: how layout intent shows up in StableHLO/MHLO text across jax 0.4.x
+SHARDING_MARKERS = ("mhlo.sharding", "sharding =", "sdy.sharding")
+
+
+class ProgramShardingRule(ProgramRule):
+    id = "JPX005"
+    name = "program-sharding"
+    description = ("boundary declares a partitioned layout but the "
+                   "lowered HLO carries no sharding annotation — GSPMD "
+                   "will silently replicate the whole state")
+
+    def check_program(self, pctx: ProgramContext) -> List[Finding]:
+        if not pctx.boundary.expect_sharding or pctx.hlo is None:
+            return []
+        if any(marker in pctx.hlo for marker in SHARDING_MARKERS):
+            return []
+        return [pctx.finding(
+            self.id,
+            "partition rules declare a sharded layout for this boundary "
+            "but its lowered HLO has no sharding annotations — the "
+            "constraint was lost between declaration and lowering",
+            token="sharding")]

@@ -94,8 +94,13 @@ def _ae_init(cfg: AEConfig, x_train_scaled: jnp.ndarray, key: jax.Array):
     params = model.init(init_key, x_train_scaled[:1])["params"]
     tx = keras_nadam(cfg.lr, b1=0.9, b2=0.999, eps=1e-7)   # tf.keras-exact Nadam
     opt_state = tx.init(params)
-    carry = (params, opt_state, jnp.inf, jnp.zeros((), jnp.int32),
-             jnp.zeros((), bool))
+    # best-val-loss slot as a STRONGLY-typed f32 scalar: a bare
+    # ``jnp.inf`` is a weak-typed Python float, which rides the carry
+    # into every chunk program's abstract signature — one resume path
+    # feeding a concrete array where another fed the weak scalar would
+    # compile two executables for the same program (JPX004)
+    carry = (params, opt_state, jnp.asarray(jnp.inf, jnp.float32),
+             jnp.zeros((), jnp.int32), jnp.zeros((), bool))
     return carry, jax.random.split(key, cfg.epochs)
 
 

@@ -174,9 +174,17 @@ def ae_batch_fn(model: AEServeModel) -> Callable:
     scored with a row-masked reconstruction MSE.  Pure in every operand,
     so the padded program is identical for every tenant in the bucket.
     """
+    # thread the model's compute dtype exactly like the training-side
+    # builder (replication/engine.py::_ae_model): without it a bf16-
+    # policy head silently serves full-f32 matmuls — found by the
+    # JPX002 program audit (serve:replicate@bf16), regression-pinned in
+    # tests/test_analysis_programs.py
+    dt = (None if model.cfg.dtype in (None, "float32")
+          else jnp.dtype(model.cfg.dtype))
     ae = Autoencoder(n_features=model.cfg.n_factors,
                      latent_dim=model.cfg.latent_dim,
-                     slope=model.cfg.leaky_slope)
+                     slope=model.cfg.leaky_slope,
+                     dtype=dt)
 
     def one(params, x, n_rows, mask):
         t = x.shape[0]

@@ -1,0 +1,456 @@
+"""Phase-3 program auditor tests (ISSUE 16).
+
+Three layers, cheapest first:
+
+* **registry completeness** — both directions between the live runtime
+  tree and the declarative tables in ``hfrep_tpu/analysis/programs.py``:
+  every RUNTIME_SITES token greps verbatim in its file, every audited
+  site is covered by a boundary (and vice versa), and every AST-
+  discovered boundary-creation call is accounted for.  Pure stdlib, no
+  jax import — a refactor that moves a compile boundary fails HERE, not
+  by silently dropping audit coverage.
+* **rule fixtures** — one positive and one negative synthetic
+  ``ProgramContext`` per JPX rule (the rules duck-type the jaxpr object
+  graph, so the fakes below are the whole contract), plus the registry
+  ``# noqa: JPXnnn`` suppression path and SARIF/diff plumbing.
+* **traced regressions** — the two true positives the first audit of
+  this repo found, fixed at source and pinned by re-tracing the real
+  boundaries: the bf16 serve head must trace bf16 dots (serve/aot.py
+  threads the compute dtype now), and the AE chunk carry interface must
+  be strongly typed (replication/engine.py's ``_ae_init`` best-loss
+  slot).  These two tests import jax; everything above runs on bare
+  CPython.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+from collections import Counter
+from pathlib import Path
+
+from hfrep_tpu.analysis import programs
+from hfrep_tpu.analysis.rules import PROGRAM_RULES, PROGRAM_RULES_BY_ID
+from hfrep_tpu.analysis.rules.jpx_base import (ProgramContext, eqn_in_avals,
+                                               iter_eqns)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# --------------------------------------------------- registry completeness
+def test_runtime_site_tokens_exist_verbatim_in_live_source():
+    for site, row in programs.RUNTIME_SITES.items():
+        src = (REPO_ROOT / row["file"]).read_text(encoding="utf-8")
+        assert row["token"] in src, (
+            f"RUNTIME_SITES[{site!r}] token {row['token']!r} no longer "
+            f"appears in {row['file']} — the boundary moved; update the "
+            "registry (and its PROGRAM_BOUNDARIES coverage)")
+
+
+def test_every_audited_site_is_covered_by_a_boundary():
+    covered = {b.site for b in programs.PROGRAM_BOUNDARIES}
+    for site, row in programs.RUNTIME_SITES.items():
+        if row["audited"]:
+            assert site in covered, (
+                f"site {site!r} is marked audited but no "
+                "PROGRAM_BOUNDARIES row covers it")
+        else:
+            assert row.get("why"), (
+                f"unaudited site {site!r} must say why")
+
+
+def test_every_boundary_points_at_a_live_audited_site():
+    for b in programs.PROGRAM_BOUNDARIES:
+        assert b.site in programs.RUNTIME_SITES, (
+            f"{b.label}: unknown site {b.site!r}")
+        assert programs.RUNTIME_SITES[b.site]["audited"], (
+            f"{b.label}: covers a site declared unauditable")
+        for rel in b.modules:
+            assert (REPO_ROOT / rel).exists(), (
+                f"{b.label}: module {rel} missing")
+
+
+def test_discovered_boundary_calls_are_all_accounted_for():
+    """A NEW instrument_step/instrument_launch/profile_jitted/
+    profile_stage/aot_compile call site added anywhere in the runtime
+    tree without a RUNTIME_SITES row in the same file fails here."""
+    site_files = {row["file"] for row in programs.RUNTIME_SITES.values()}
+    triples = programs.discover_label_calls()
+    assert triples, "discovery found no boundary-creation sites at all"
+    for rel, callee, prefix in triples:
+        assert rel in site_files, (
+            f"{rel} calls {callee}(label~{prefix!r}) but no RUNTIME_SITES "
+            "row covers that file — register the boundary (audited or "
+            "not) in hfrep_tpu/analysis/programs.py")
+
+
+def test_registry_labels_unique_and_anchored():
+    assert len(programs.BOUNDARIES_BY_LABEL) == len(programs.PROGRAM_BOUNDARIES)
+    lines = programs.registry_lines()
+    assert set(lines) == set(programs.BOUNDARIES_BY_LABEL)
+    assert len(programs.PROGRAM_BOUNDARIES) >= 12
+
+
+# ------------------------------------------------------- synthetic fakes
+class _Dt:
+    def __init__(self, name: str, itemsize: int):
+        self.name, self.itemsize = name, itemsize
+
+    def __str__(self):
+        return self.name
+
+
+F32, BF16 = _Dt("float32", 4), _Dt("bfloat16", 2)
+
+
+class _Aval:
+    def __init__(self, shape, dtype=F32, weak=False):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.weak_type = weak
+
+
+class _Var:
+    def __init__(self, aval):
+        self.aval = aval
+
+
+class _Prim:
+    def __init__(self, name):
+        self.name = name
+
+
+class _Eqn:
+    def __init__(self, prim, invars=(), params=None):
+        self.primitive = _Prim(prim)
+        self.invars = list(invars)
+        self.params = params or {}
+
+
+class _Open:
+    def __init__(self, eqns=(), constvars=()):
+        self.eqns = list(eqns)
+        self.constvars = list(constvars)
+
+
+class _Closed:
+    def __init__(self, eqns=(), constvars=(), in_avals=()):
+        self.jaxpr = _Open(eqns, constvars)
+        self.in_avals = list(in_avals)
+
+
+def _boundary(**over):
+    base = dict(label="test:boundary", kind="test", modules=(),
+                site="trainer_multi_step")
+    base.update(over)
+    return programs.Boundary(**base)
+
+
+def _ctx(boundary, **kw):
+    return ProgramContext(boundary, **kw)
+
+
+def _state_leaves(n=4):
+    return tuple(_Aval((8, 8)) for _ in range(n))     # 256 B each
+
+
+# ------------------------------------------------------------ JPX001
+def test_jpx001_flags_undonated_state_and_spares_the_rest():
+    rule = PROGRAM_RULES_BY_ID["JPX001"]
+    leaves = _state_leaves()
+    # positive: state-like arg0 comes back out, not declared donated
+    pos = _ctx(_boundary(donate=()), arg_avals=(leaves,), out_avals=leaves)
+    found = rule.check_program(pos)
+    assert [f.rule for f in found] == ["JPX001"]
+    assert "arg 0" in found[0].message
+    # negative: same program, donation declared
+    assert rule.check_program(
+        _ctx(_boundary(donate=(0,)), arg_avals=(leaves,),
+             out_avals=leaves)) == []
+    # negative: pure program — inputs never reappear
+    assert rule.check_program(
+        _ctx(_boundary(), arg_avals=(leaves,),
+             out_avals=(_Aval((2, 2)),))) == []
+    # negative: small scalar carry (a step counter) is not state
+    tiny = (_Aval(()), _Aval(()))
+    assert rule.check_program(
+        _ctx(_boundary(), arg_avals=(tiny,), out_avals=tiny)) == []
+
+
+# ------------------------------------------------------------ JPX002
+def _dot(dtype):
+    return _Eqn("dot_general",
+                [_Var(_Aval((4, 3), dtype)), _Var(_Aval((3, 4), dtype))])
+
+
+def test_jpx002_counts_f32_dots_in_bf16_programs_only():
+    rule = PROGRAM_RULES_BY_ID["JPX002"]
+    leaky = _Closed([_dot(F32), _dot(F32)])
+    found = rule.check_program(
+        _ctx(_boundary(policy="bf16"), jaxpr=leaky))
+    assert [f.rule for f in found] == ["JPX002"]
+    assert "2 f32" in found[0].message
+    # fp32-policy programs are exempt — all-f32 is the contract there
+    assert rule.check_program(
+        _ctx(_boundary(policy="fp32"), jaxpr=leaky)) == []
+    # a properly-threaded bf16 program is clean
+    assert rule.check_program(
+        _ctx(_boundary(policy="bf16"), jaxpr=_Closed([_dot(BF16)]))) == []
+    # a declared fp32 stage (f32_dot_allow) is clean
+    assert rule.check_program(
+        _ctx(_boundary(policy="bf16", f32_dot_allow=2), jaxpr=leaky)) == []
+
+
+def test_jpx002_hlo_fallback_when_jaxpr_unavailable():
+    rule = PROGRAM_RULES_BY_ID["JPX002"]
+    hot = ('%0 = "stablehlo.dot_general"(%a, %b) : '
+           "(tensor<4x3xf32>, tensor<3x4xf32>) -> tensor<4x4xf32>")
+    cold = ('%0 = "stablehlo.dot_general"(%a, %b) : '
+            "(tensor<4x3xbf16>, tensor<3x4xbf16>) -> tensor<4x4xf32>")
+    assert rule.check_program(
+        _ctx(_boundary(policy="bf16"), hlo=hot))
+    assert rule.check_program(
+        _ctx(_boundary(policy="bf16"), hlo=cold)) == []
+
+
+# ------------------------------------------------------------ JPX003
+def _scan(body_eqns, in_avals=(), num_consts=0, num_carry=0):
+    return _Eqn("scan", params={
+        "jaxpr": _Closed(body_eqns, in_avals=in_avals),
+        "num_consts": num_consts, "num_carry": num_carry})
+
+
+def test_jpx003_flags_callbacks_inside_loops_not_at_top_level():
+    rule = PROGRAM_RULES_BY_ID["JPX003"]
+    inside = _Closed([_scan([_Eqn("pure_callback")])])
+    found = rule.check_program(_ctx(_boundary(), jaxpr=inside))
+    assert [f.rule for f in found] == ["JPX003"]
+    assert "pure_callback" in found[0].message
+    # the same primitive at top level is the ordinary one-off IO posture
+    top = _Closed([_Eqn("pure_callback"), _scan([_Eqn("add")])])
+    assert rule.check_program(_ctx(_boundary(), jaxpr=top)) == []
+
+
+# ------------------------------------------------------------ JPX004
+def test_jpx004_weak_interface_and_captured_scalars():
+    rule = PROGRAM_RULES_BY_ID["JPX004"]
+    weak_in = _ctx(_boundary(), jaxpr=_Closed(),
+                   arg_avals=((_Aval((), weak=True),),))
+    assert [f.snippet for f in rule.check_program(weak_in)] \
+        == ["test:boundary weak-in"]
+    weak_out = _ctx(_boundary(), jaxpr=_Closed(),
+                    out_avals=(_Aval((), weak=True),))
+    assert [f.snippet for f in rule.check_program(weak_out)] \
+        == ["test:boundary weak-out"]
+    weak_const = _ctx(_boundary(), jaxpr=_Closed(
+        constvars=[_Var(_Aval((), weak=True))]))
+    assert [f.snippet for f in rule.check_program(weak_const)] \
+        == ["test:boundary weak-const"]
+    # negative: strong interface, and INNER weak literals (an eqn input
+    # inlined from `x * 2`) cannot split the executable cache — pinned
+    # as the false-positive class JPX004 must not flag
+    inner = _ctx(_boundary(), jaxpr=_Closed(
+        [_Eqn("mul", [_Var(_Aval((), weak=True))])]),
+        arg_avals=((_Aval((4, 4)),),), out_avals=(_Aval((4, 4)),))
+    assert rule.check_program(inner) == []
+
+
+# ------------------------------------------------------------ JPX005
+def test_jpx005_sharding_contract_is_declared_per_boundary():
+    rule = PROGRAM_RULES_BY_ID["JPX005"]
+    bare = "module @jit_step { func.func public @main ... }"
+    annotated = bare + ' {mhlo.sharding = "{devices=[2,1]}"} '
+    sharded = _boundary(expect_sharding=True)
+    assert [f.rule for f in rule.check_program(_ctx(sharded, hlo=bare))] \
+        == ["JPX005"]
+    assert rule.check_program(_ctx(sharded, hlo=annotated)) == []
+    # this 1-device runtime strips mesh axes, so live rows declare
+    # expect_sharding=False and must stay silent on bare HLO
+    assert rule.check_program(_ctx(_boundary(), hlo=bare)) == []
+    assert not any(b.expect_sharding for b in programs.PROGRAM_BOUNDARIES)
+
+
+# ------------------------------------------------------------ JPX006
+def test_jpx006_carry_budget_per_scan():
+    rule = PROGRAM_RULES_BY_ID["JPX006"]
+    # one 400-byte carry leaf after one const
+    scan = _scan([], in_avals=[_Aval((2,)), _Aval((100,))],
+                 num_consts=1, num_carry=1)
+    over = _ctx(_boundary(carry_budget_bytes=100), jaxpr=_Closed([scan]))
+    found = rule.check_program(over)
+    assert [f.rule for f in found] == ["JPX006"]
+    assert "400 bytes" in found[0].message
+    assert rule.check_program(
+        _ctx(_boundary(carry_budget_bytes=1000), jaxpr=_Closed([scan]))) == []
+    assert rule.check_program(
+        _ctx(_boundary(), jaxpr=_Closed([scan]))) == []   # no budget → skip
+
+
+def test_every_program_rule_has_fixture_coverage():
+    """The fixture suite above must name every registered JPX rule —
+    adding JPX007 without a pos/neg pair fails here."""
+    src = Path(__file__).read_text(encoding="utf-8")
+    for rule in PROGRAM_RULES:
+        assert f'"{rule.id}"' in src, f"no fixture references {rule.id}"
+
+
+# ------------------------------------------------- noqa / SARIF plumbing
+def test_registry_noqa_suppresses_at_the_anchored_row(tmp_path, monkeypatch):
+    fake_repo = tmp_path
+    fake_programs = fake_repo / "hfrep_tpu" / "analysis" / "programs.py"
+    fake_programs.parent.mkdir(parents=True)
+    fake_programs.write_text(
+        "registry = [\n"
+        "    'row-one',\n"
+        "    'row-two',  # noqa: JPX004\n"
+        "]\n", encoding="utf-8")
+    monkeypatch.setattr(programs, "REPO_ROOT", fake_repo)
+    b = _boundary()
+    suppressed = _ctx(b, line=3).finding("JPX004", "weak", token="weak-in")
+    other_rule = _ctx(b, line=3).finding("JPX001", "state", token="arg0")
+    clean_row = _ctx(b, line=2).finding("JPX004", "weak", token="weak-in")
+    kept = programs._apply_registry_noqa([suppressed, other_rule, clean_row])
+    assert suppressed not in kept
+    assert other_rule in kept and clean_row in kept
+
+
+def test_audit_sarif_carries_boundary_properties_and_diff_roundtrip(tmp_path):
+    from hfrep_tpu.analysis import cli
+
+    b = programs.BOUNDARIES_BY_LABEL["serve:replicate@bf16"]
+    f = ProgramContext(b, line=7).finding("JPX002", "leak", token="f32dot")
+    res = programs.AuditResult(findings=[f], traced=[b.label], skipped={})
+    props = {fp: {"boundary": lbl} for fp, lbl in res.boundary_of.items()}
+
+    buf = io.StringIO()
+    cli._report_sarif([f], [], Counter(), buf,
+                      rule_set=PROGRAM_RULES, result_props=props)
+    doc = json.loads(buf.getvalue())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} \
+        == {r.id for r in PROGRAM_RULES}
+    result = run["results"][0]
+    assert result["properties"]["boundary"] == "serve:replicate"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "hfrep_tpu/analysis/programs.py"
+    assert loc["region"]["startLine"] == 7
+    fp = result["partialFingerprints"]["hfrepFingerprint/v1"]
+    assert fp == f.fingerprint
+
+    # --diff reads the committed snapshot back through the same shape
+    snap = tmp_path / "snap.sarif"
+    snap.write_text(buf.getvalue(), encoding="utf-8")
+    assert cli._load_sarif_fingerprints(snap) == Counter({fp: 1})
+
+
+def test_committed_snapshot_and_baseline_are_clean():
+    from hfrep_tpu.analysis.cli import (DEFAULT_AUDIT_BASELINE,
+                                        DEFAULT_AUDIT_SNAPSHOT)
+    baseline = json.loads(DEFAULT_AUDIT_BASELINE.read_text(encoding="utf-8"))
+    assert baseline["entries"] == []          # debt-free by acceptance
+    snap = json.loads(DEFAULT_AUDIT_SNAPSHOT.read_text(encoding="utf-8"))
+    assert snap["runs"][0]["results"] == []
+
+
+def test_obs_explain_points_at_open_audit_findings(tmp_path):
+    from hfrep_tpu.obs.explain import annotate_static_audit
+
+    snap = tmp_path / "audit.sarif"
+    snap.write_text(json.dumps({"runs": [{"results": [
+        {"ruleId": "JPX001",
+         "properties": {"boundary": "compile:multi_step"}},
+        {"ruleId": "JPX002",
+         "properties": {"boundary": "serve:replicate"}},
+    ]}]}), encoding="utf-8")
+    doc = {"findings": [
+        {"kind": "program", "detail": {"program": "compile:multi_step"}},
+        {"kind": "program", "detail": {"program": "serve:replicate:b32"}},
+        {"kind": "metric", "detail": {"program": "compile:multi_step"}},
+    ], "notes": []}
+    out = annotate_static_audit(doc, snapshot_path=snap)
+    joined = "\n".join(out["notes"])
+    assert "JPX001" in joined and "compile:multi_step" in joined
+    assert "JPX002" in joined            # serve batch-bucket prefix join
+    # a clean (or missing) snapshot annotates nothing
+    assert annotate_static_audit({"findings": [], "notes": []},
+                                 snapshot_path=snap)["notes"] == []
+    assert annotate_static_audit(
+        {"findings": doc["findings"], "notes": []},
+        snapshot_path=tmp_path / "missing.sarif")["notes"] == []
+
+
+# ------------------------------------------------------- engine behavior
+def test_graceful_skip_on_factory_failure():
+    def boom():
+        raise RuntimeError("lowering exploded")
+
+    bad = _boundary(label="test:doomed", factory=boom)
+    res = programs.audit_boundaries(boundaries=[bad], use_cache=False)
+    assert res.findings == [] and res.traced == []
+    assert "RuntimeError" in res.skipped["test:doomed"]
+    # a factory-less row skips with its notes, same contract
+    none = _boundary(label="test:nofactory", notes="not traceable here")
+    res2 = programs.audit_boundaries(boundaries=[none], use_cache=False)
+    assert res2.skipped["test:nofactory"] == "not traceable here"
+
+
+def test_audit_cache_cold_vs_warm_identity(tmp_path, monkeypatch):
+    """Caching must be invisible in the verdict, and the warm path must
+    not trace at all (that is what keeps the check.sh gate at ~0.2s)."""
+    subset = [programs.BOUNDARIES_BY_LABEL["ae_chunk:init"]]
+    cache = tmp_path / "audit-cache.json"
+    cold = programs.audit_boundaries(boundaries=subset, cache_path=cache,
+                                     use_cache=True)
+    assert cache.exists() and cold.traced == ["ae_chunk:init"]
+
+    def no_trace(*a, **k):
+        raise AssertionError("warm audit must replay the cache, not trace")
+
+    monkeypatch.setattr(programs, "trace_boundary", no_trace)
+    warm = programs.audit_boundaries(boundaries=subset, cache_path=cache,
+                                     use_cache=True)
+    assert ([dataclasses.asdict(f) for f in warm.findings]
+            == [dataclasses.asdict(f) for f in cold.findings])
+    assert warm.traced == cold.traced and warm.skipped == cold.skipped
+
+    # the cache keys on the installed jax version: a different runtime
+    # must retrace, not replay stale verdicts.  The poisoned tracer's
+    # AssertionError lands in the graceful-skip note — proof the engine
+    # attempted a real trace instead of reading the stale cache.
+    monkeypatch.setattr(programs, "jax_version", lambda: "999.0.0")
+    stale = programs.audit_boundaries(boundaries=subset, cache_path=cache,
+                                      use_cache=True)
+    assert "AssertionError" in stale.skipped.get("ae_chunk:init", "")
+
+
+# --------------------------------------- the fixed true positives, pinned
+def test_bf16_serve_head_traces_bf16_dots():
+    """Regression pin for the first JPX002 true positive: serve/aot.py's
+    ``ae_batch_fn`` did not thread ``model.cfg.dtype``, so the bf16
+    replicate head silently served full-f32 matmuls.  The fixed head
+    must (a) pass JPX002 and (b) actually contain bf16 dots — guarding
+    both the fix and the rule's eyesight."""
+    b = programs.BOUNDARIES_BY_LABEL["serve:replicate@bf16"]
+    pctx = programs.trace_boundary(b)
+    assert PROGRAM_RULES_BY_ID["JPX002"].check_program(pctx) == []
+    dots = [e for e, _ in iter_eqns(pctx.jaxpr)
+            if e.primitive.name == "dot_general"]
+    assert dots, "serve head traced no dots at all"
+    assert any(str(a.dtype) == "bfloat16"
+               for e in dots for a in eqn_in_avals(e)), (
+        "bf16 serve head traces no bf16 dots — the compute dtype is "
+        "not reaching the AOT build path again")
+
+
+def test_ae_chunk_interface_is_strongly_typed():
+    """Regression pin for the first JPX004 true positive: ``_ae_init``
+    carried a bare ``jnp.inf`` (weak-typed) best-loss slot, splitting
+    the executable cache between resume paths.  The init program's
+    outputs — the carry every chunk program consumes — must all be
+    strongly typed now."""
+    b = programs.BOUNDARIES_BY_LABEL["ae_chunk:init"]
+    pctx = programs.trace_boundary(b)
+    assert PROGRAM_RULES_BY_ID["JPX004"].check_program(pctx) == []
+    assert all(not getattr(a, "weak_type", False) for a in pctx.out_avals)

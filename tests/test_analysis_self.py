@@ -50,7 +50,8 @@ def test_rules_registry_announces_all_rules():
     assert proc.returncode == 0
     for rid in ("JAX001", "JAX002", "JAX003", "JAX004", "JAX005",
                 "JAX006", "HF001", "HF002", "HF003", "HF004", "HF005",
-                "HF006", "HF007"):
+                "HF006", "HF007", "JPX001", "JPX002", "JPX003", "JPX004",
+                "JPX005", "JPX006"):
         assert rid in proc.stdout
 
 
@@ -117,3 +118,33 @@ def test_changed_scope_smoke(tmp_path):
     a subset of the full run's findings."""
     proc = _check(["--changed"], tmp_path / "c.json")
     assert proc.returncode in (0, 1), proc.stderr
+
+
+def test_warm_program_audit_is_fast_and_clean():
+    """The phase-3 budget contract: with the repo-default cache warm
+    (check.sh / the test above just ran the audit), a repeat audit must
+    come back clean well inside tier-1 — the warm path replays cached
+    per-boundary verdicts without importing jax, so ~0.2s observed; 15s
+    is the defended ceiling, not a benchmark."""
+    import json
+    import os
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # ensure the default cache is warm (first call may trace: ~20s cold)
+    subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.analysis", "audit"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        env=env)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "hfrep_tpu.analysis", "audit",
+         "--format", "json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+        env=env)
+    warm_s = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)   # --format json stdout stays pure
+    assert doc["findings"] == []
+    assert doc["traced"] >= 12, doc["boundaries"]
+    assert warm_s < 15, f"warm program audit took {warm_s:.1f}s"

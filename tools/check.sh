@@ -17,6 +17,16 @@ cd "$(dirname "$0")/.."
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
     python -m hfrep_tpu.analysis check \
     hfrep_tpu tools tests bench.py bench_extra.py "$@"
+# program audit (phase 3): abstractly trace every registered compile
+# boundary (GAN step families, conditional, mesh, AE chunk/init, serve
+# AOT heads) and run the JPX jaxpr/HLO rules — donation completeness,
+# precision-policy conformance, host syncs in loop bodies, recompile
+# hazards, sharding loss, scan-carry bloat.  Warm-cache runs never
+# import jax (per-boundary results keyed on the defining modules' shas
+# + the installed jax version).  CPU-pinned + env-stripped; status to
+# stderr so `--format json` callers keep stdout pure.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
+    python -m hfrep_tpu.analysis audit 1>&2
 # telemetry schema gate: writer (hfrep_tpu.obs) and parser (obs.report)
 # must agree on the committed fixture run directory.  Status goes to
 # stderr so `--format json` keeps stdout pure JSON for machine consumers.
