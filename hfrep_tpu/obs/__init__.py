@@ -48,6 +48,7 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
 import time
 from pathlib import Path
 from typing import IO, Dict, List, Optional
@@ -213,12 +214,26 @@ class Obs:
 
     enabled = True
 
-    def __init__(self, run_dir, flush_every: int = 32):
+    def __init__(self, run_dir, flush_every: int = 32,
+                 rotate_bytes: Optional[int] = None):
         self.run_dir = Path(run_dir)
         self.run_dir.mkdir(parents=True, exist_ok=True)
         self.events_path = self.run_dir / "events.jsonl"
         self._rotate_previous_run()
         self._fh: Optional[IO] = open(self.events_path, "a")
+        # writer-side stream rotation (the fleet retention tier): at the
+        # threshold the live stream is renamed to the next
+        # rollup/chunk-<n>.jsonl and reopened fresh, so a week-long
+        # soak's live stream stays bounded and `obs compact` folds the
+        # chunks.  0/None = never rotate (the default: short runs keep
+        # the one-stream layout every existing reader knows).
+        if rotate_bytes is None:
+            try:
+                rotate_bytes = int(
+                    os.environ.get("HFREP_OBS_ROTATE_BYTES") or 0)
+            except ValueError:
+                rotate_bytes = 0
+        self._rotate_bytes = max(0, int(rotate_bytes))
         # fault-injection hook for the append stream (HFREP_FAULTS
         # io_fail@obs_append=N): None unless a plan is active at sink
         # construction, so the per-emit cost stays one `if`.  Only an
@@ -272,8 +287,41 @@ class Obs:
             self._n_events += 1
             if self._n_events % self._flush_every == 0:
                 self._fh.flush()
+                if (self._rotate_bytes
+                        and self._fh.tell() >= self._rotate_bytes):
+                    self._rotate_live()
         except (OSError, ValueError):       # telemetry must not kill a run
             pass
+
+    def _rotate_live(self) -> None:
+        """Writer-side rotation: flush + close the live stream, rename
+        it to the next rollup chunk (``obs compact`` folds those into
+        segments + pinned evidence), reopen fresh.  Only the writer can
+        do this safely — an external rename would leave this process
+        appending to the renamed file through its held handle.
+        Best-effort like every other telemetry write: the worst failure
+        mode is the old unbounded-stream behavior."""
+        fh, self._fh = self._fh, None
+        try:
+            fh.flush()
+            fh.close()
+        except OSError:
+            pass
+        try:
+            from hfrep_tpu.obs import rollup as _rollup
+            chunk_dir = self.run_dir / _rollup.ROLLUP_DIR
+            chunk_dir.mkdir(parents=True, exist_ok=True)
+            if (self.events_path.exists()
+                    and self.events_path.stat().st_size > 0):
+                self.events_path.rename(
+                    chunk_dir
+                    / f"chunk-{_rollup.next_chunk_index(self.run_dir)}.jsonl")
+        except OSError:
+            pass
+        try:
+            self._fh = open(self.events_path, "a")
+        except OSError:
+            self._fh = None
 
     def flush(self) -> None:
         if self._fh is not None:
@@ -407,17 +455,19 @@ def is_enabled() -> bool:
 
 
 def enable(run_dir, *, manifest: bool = True, compile_listener: bool = True,
-           **manifest_extra) -> Obs:
+           rotate_bytes: Optional[int] = None, **manifest_extra) -> Obs:
     """Activate telemetry into ``run_dir`` (closing any previous sink).
 
     Writes ``run.json`` immediately (git SHA, versions, host, devices;
     callers merge config/mesh later via :meth:`Obs.annotate`) and installs
-    the ``jax.monitoring`` backend-compile listener.
+    the ``jax.monitoring`` backend-compile listener.  ``rotate_bytes``
+    arms writer-side live-stream rotation for long soaks (default: the
+    ``HFREP_OBS_ROTATE_BYTES`` env knob; see :class:`Obs`).
     """
     global _active
     if _active is not None:
         disable()
-    obs = Obs(run_dir)
+    obs = Obs(run_dir, rotate_bytes=rotate_bytes)
     _active = obs
     try:
         if manifest:

@@ -216,6 +216,50 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                              "abs_tol": 0.5, "mad_mult": 0.0},
     "chaos/run_secs":       {"direction": "down", "rel_tol": 0.50,
                              "mad_mult": 5.0},
+    # fleet-watcher gauges (hfrep_tpu/obs/fleet.py; ISSUE 17).  The
+    # invariant trio — ``ledger_deficit``/``breakers_open``/
+    # ``restart_storms`` — exists to be ZERO, the shed_rate class with
+    # exact floors (any nonzero value is already an incident; gating
+    # re-litigates it).  ``submitted``/``terminal`` are raw ledger
+    # sides: "down" would read MORE traffic as a regression and "up"
+    # would read a quieter soak as one, so both get wide relative
+    # floors and exist mainly so the cross-host fold direction is
+    # explicit.  ``replicas`` is a structural coverage floor (a fleet
+    # that silently lost a replica dir is the disarmed-gate failure
+    # mode); ``restarts`` tolerates supervision churn but flags storms
+    # via its dedicated zero-floor gauge.
+    "fleet/replicas":        {"direction": "up",   "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "fleet/submitted":       {"direction": "up",   "rel_tol": 0.50,
+                              "mad_mult": 5.0},
+    "fleet/terminal":        {"direction": "up",   "rel_tol": 0.50,
+                              "mad_mult": 5.0},
+    "fleet/ledger_deficit":  {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "fleet/breakers_open":   {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "fleet/restarts":        {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 2.0, "mad_mult": 5.0},
+    "fleet/restart_storms":  {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    # SLO burn-rate gauges (hfrep_tpu/obs/slo.py; ISSUE 17).
+    # ``worst_burn`` is the one that MUST be explicit: "_burn" carries
+    # no cost suffix, so the higher-is-better fallback would gate a
+    # rising burn rate — budget consumed FASTER — as an improvement,
+    # exactly the inverted-shed_rate failure mode the satellite calls
+    # out.  Burn sits anywhere in [0, 1) on a healthy fleet, so the
+    # floor is absolute slack below the 1.0 alert line, not relative.
+    # ``breaches``/``warnings`` exist to be zero (exact floors);
+    # ``evaluated`` is a coverage floor (a run that silently evaluated
+    # fewer objectives must not pass as "no breaches").
+    "slo/evaluated":         {"direction": "up",   "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "slo/breaches":          {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "slo/warnings":          {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.5, "mad_mult": 0.0},
+    "slo/worst_burn":        {"direction": "down", "rel_tol": 0.0,
+                              "abs_tol": 0.25, "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

@@ -105,11 +105,32 @@ def load_jsonl(path, parse_line, strict: bool = False,
 
 
 def load_events(run_dir, strict: bool = False) -> List[dict]:
-    """Parse + validate ``events.jsonl`` (torn-tail policy:
-    :func:`load_jsonl`)."""
-    return load_jsonl(Path(run_dir) / EVENTS_NAME, parse_event,
-                      strict=strict,
-                      torn_hint="run was likely killed mid-write")
+    """Parse + validate this run's event records (torn-tail policy:
+    :func:`load_jsonl`).  On a compacted run dir the evidence records
+    that ``obs compact`` pinned verbatim (``rollup/pinned-<n>.jsonl``)
+    are replayed FIRST — they predate everything in the live stream by
+    construction — then any rotated-but-not-yet-compacted chunks
+    (``rollup/chunk-<n>.jsonl``: earlier bytes of the SAME stream the
+    writer rotated aside mid-run), then the live tail, so readers see
+    the same record sequence a raw, never-rotated stream would have
+    given them.  High-volume records that compaction folded to
+    aggregates are NOT here; ``summarize`` re-seeds their contribution
+    from ``rollup/compact.json``."""
+    records: List[dict] = []
+    # lazy: rollup imports this module for the shared stream discipline
+    from hfrep_tpu.obs import rollup as _rollup
+    for pf in _rollup.pinned_files(run_dir):
+        records.extend(load_jsonl(pf, parse_event, strict=strict,
+                                  torn_hint="compactor was likely killed "
+                                            "mid-publish"))
+    for cf in _rollup.chunk_files(run_dir):
+        records.extend(load_jsonl(cf, parse_event, strict=strict,
+                                  torn_hint="writer was likely killed "
+                                            "mid-rotation"))
+    records.extend(load_jsonl(Path(run_dir) / EVENTS_NAME, parse_event,
+                              strict=strict,
+                              torn_hint="run was likely killed mid-write"))
+    return records
 
 
 # ------------------------------------------------------ trace collection
@@ -182,6 +203,21 @@ def trace_index(run_dirs, trace_ids=None) -> Dict[str, List[dict]]:
             recs = load_jsonl(f, parse_event)
         except (OSError, SchemaError):
             continue
+        if f.name == EVENTS_NAME:
+            # a compacted dir's pinned evidence records — and any
+            # rotated-but-uncompacted chunks — belonged to THIS live
+            # stream before rotation: replay them ahead of the live
+            # tail under the live stream's own identity, so trace
+            # reconstructions stay byte-equal to the raw-dir result
+            from hfrep_tpu.obs import rollup as _rollup
+            prior_recs: List[dict] = []
+            for pf in (_rollup.pinned_files(f.parent)
+                       + _rollup.chunk_files(f.parent)):
+                try:
+                    prior_recs.extend(load_jsonl(pf, parse_event))
+                except (OSError, SchemaError):
+                    continue
+            recs = prior_recs + recs
         base = None
         try:
             base = json.loads(
@@ -309,12 +345,28 @@ def summarize(run_dir, events: Optional[List[dict]] = None) -> dict:
     if events is None:
         events = load_events(run_dir)
 
+    # on a compacted run dir, pre-seed the aggregate contribution of the
+    # records compaction folded away (metric samples, plain spans).
+    # Dict insertion order is deliberate: the seed preserves the raw
+    # stream's first-seen order for every name it holds, and everything
+    # seen only in the live stream appends after — so gauge/counter/
+    # count ordering matches a raw replay exactly.
+    from hfrep_tpu.obs import rollup as _rollup
+    seed = _rollup.summary_seed(run_dir)
+
     counts: Dict[str, int] = {}
     blocks: List[dict] = []
     gauges: Dict[str, float] = {}
     counters: Dict[str, float] = {}
     high_water = 0
     compile_spans = 0.0
+    seed_events = 0
+    if seed:
+        for etype in seed.get("type_order") or []:
+            counts[etype] = seed["counts"].get(etype, 0)
+        gauges.update(seed["gauges"])
+        counters.update(seed["counters"])
+        seed_events = int(seed["n_events"])
     for rec in events:
         counts[rec["type"]] = counts.get(rec["type"], 0) + 1
         if rec["type"] == "span":
@@ -361,7 +413,7 @@ def summarize(run_dir, events: Optional[List[dict]] = None) -> dict:
         "run_id": manifest.get("run_id") or run_dir.name,
         "git_sha": (manifest.get("git") or {}).get("sha"),
         "backend": (manifest.get("devices") or {}).get("backend"),
-        "n_events": len(events),
+        "n_events": len(events) + seed_events,
         "event_counts": counts,
         "blocks": {"n": len(blocks), "steady": len(steady),
                    "warmup": len(blocks) - len(steady)},
@@ -661,6 +713,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exercise ingest/merge/baseline/verdict on the "
                         "committed history fixture (CI gate; pure-JSON "
                         "stdout)")
+    g.add_argument("--slo", default=None, metavar="FLEET_ROOT",
+                   help="also evaluate the declarative SLO burn rates "
+                        "over this fleet root and fail the gate on any "
+                        "breach (with no RUN_DIR: pure SLO gating, no "
+                        "per-run regression check)")
+    g.add_argument("--slos", default=None, metavar="FILE",
+                   help="with --slo: objectives JSON (default: "
+                        "<root>/slo.json if present, else built-ins)")
     g.add_argument("--explain", action="store_true",
                    help="on a failing gate, diff the offending run "
                         "against the comparable history runs still on "
@@ -729,6 +789,67 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("-o", "--out", default=None,
                    help="write to this file (atomic tmp+rename) instead "
                         "of stdout")
+    e.add_argument("--fleet", action="store_true",
+                   help="treat the single argument as a FLEET ROOT: "
+                        "discover every run dir beneath it, fold each "
+                        "through the durable rollup consumer and emit "
+                        "ONE federated exposition — per-replica series "
+                        "labeled {replica=...} plus hfrep_fleet_* "
+                        "invariant gauges (ledger deficit, breakers, "
+                        "restart storms)")
+    e.add_argument("--watch", type=int, default=None, metavar="N",
+                   help="with --fleet: keep re-ingesting and "
+                        "republishing every --interval seconds for N "
+                        "passes (advances the durable cursors)")
+    e.add_argument("--interval", type=float, default=5.0,
+                   help="with --fleet --watch: seconds between passes "
+                        "(default 5.0)")
+
+    s = sub.add_parser(
+        "slo", help="declarative SLOs with multi-window burn-rate "
+                    "alerts over a fleet root (p95 latency, shed rate, "
+                    "error rate vs targets; breach = fast AND slow "
+                    "windows both burning >= 1.0)")
+    s.add_argument("root", nargs="?",
+                   help="fleet root (omit with --self-test)")
+    s.add_argument("--slos", default=None, metavar="FILE",
+                   help="objectives JSON (default: <root>/slo.json if "
+                        "present, else the built-in serve objectives)")
+    s.add_argument("--fast-buckets", type=int, default=None, metavar="N",
+                   help="fast burn window, in rollup buckets (default 5)")
+    s.add_argument("--slow-buckets", type=int, default=None, metavar="N",
+                   help="slow burn window, in rollup buckets (default 30)")
+    s.add_argument("--bucket-secs", type=float, default=None,
+                   help="rollup bucket width in seconds (default 60)")
+    s.add_argument("--persist", action="store_true",
+                   help="advance each replica's durable rollup cursors "
+                        "(default: read-only fold)")
+    s.add_argument("--format", choices=("human", "json"), default="human")
+    s.add_argument("--self-test", action="store_true",
+                   help="evaluate the committed two-replica fleet "
+                        "fixture: the planted cross-replica silent drop "
+                        "and burn-rate breach must be caught (CI gate; "
+                        "pure-JSON stdout)")
+
+    c = sub.add_parser(
+        "compact", help="bounded retention for long soaks: rotate an "
+                        "oversized live stream aside, fold rotated "
+                        "chunks into rollup segments + a reader seed, "
+                        "pin the evidence records verbatim, delete the "
+                        "chunks — gate/explain/--trace verdicts stay "
+                        "identical on the compacted dir")
+    c.add_argument("run_dirs", nargs="+")
+    c.add_argument("--rotate-bytes", type=int, default=None, metavar="N",
+                   help="also rotate the live stream first when it "
+                        "exceeds N bytes (caller must know no writer "
+                        "holds it open; live processes rotate "
+                        "themselves via HFREP_OBS_ROTATE_BYTES)")
+    c.add_argument("--force-rotate", action="store_true",
+                   help="rotate a non-empty live stream regardless of "
+                        "size (offline runs only)")
+    c.add_argument("--bucket-secs", type=float, default=None,
+                   help="rollup bucket width in seconds (default 60)")
+    c.add_argument("--format", choices=("human", "json"), default="human")
 
     sub.add_parser(
         "crash-drill",
@@ -821,8 +942,30 @@ def _cmd_gate(args) -> int:
 
     if args.self_test:
         return gate_self_test()
+
+    slo_doc = None
+    if args.slo:
+        from hfrep_tpu.obs import slo as slo_mod
+        try:
+            slo_doc = slo_mod.evaluate_root(args.slo,
+                                            slos_path=args.slos)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: --slo: {e}", file=sys.stderr)
+            return 2
+        if not args.run_dir:
+            # pure SLO gating: no per-run regression half
+            if args.format == "json":
+                print(json.dumps(slo_doc, indent=2, default=str))
+            else:
+                print(slo_mod.render(slo_doc))
+            ok = slo_doc["ok"] and slo_doc["fleet"]["ok"]
+            print("slo gate: " + ("PASS" if ok else "FAIL"),
+                  file=sys.stderr)
+            return 0 if ok else 1
+
     if not args.run_dir:
-        print("gate wants a run dir (or --self-test)", file=sys.stderr)
+        print("gate wants a run dir (or --self-test / --slo ROOT)",
+              file=sys.stderr)
         return 2
     history_path = args.history or os.environ.get("HFREP_HISTORY")
     if not history_path:
@@ -856,10 +999,15 @@ def _cmd_gate(args) -> int:
         except Exception as e:
             print(f"explain failed ({e}); verdict unaffected",
                   file=sys.stderr)
+    extra = {}
+    if explain_doc is not None:
+        extra["explain"] = explain_doc
+    if slo_doc is not None:
+        extra["slo"] = slo_doc
     if args.format == "json":
-        if explain_doc is not None:
-            print(json.dumps(dict(verdict, explain=explain_doc),
-                             indent=2, default=str))
+        if extra:
+            print(json.dumps(dict(verdict, **extra), indent=2,
+                             default=str))
         else:
             print(regress.verdict_json(verdict))
     else:
@@ -867,6 +1015,9 @@ def _cmd_gate(args) -> int:
         if explain_doc is not None:
             from hfrep_tpu.obs import explain as explain_mod
             print(explain_mod.render_diagnosis(explain_doc))
+        if slo_doc is not None:
+            from hfrep_tpu.obs import slo as slo_mod
+            print(slo_mod.render(slo_doc))
     if verdict["ok"] and args.ingest:
         try:
             ok = hist_mod.append_record(
@@ -877,7 +1028,12 @@ def _cmd_gate(args) -> int:
             return 2
         print(("ingested into" if ok else "already indexed in")
               + f" {history_path}", file=sys.stderr)
-    return 0 if verdict["ok"] else 1
+    slo_ok = (slo_doc is None
+              or (slo_doc["ok"] and slo_doc["fleet"]["ok"]))
+    if not slo_ok:
+        print("slo gate: FAIL (burn-rate breach or fleet invariant)",
+              file=sys.stderr)
+    return 0 if (verdict["ok"] and slo_ok) else 1
 
 
 def _cmd_ingest(args) -> int:
@@ -935,8 +1091,83 @@ def _cmd_tail(args) -> int:
 
 
 def _cmd_export(args) -> int:
+    if args.fleet:
+        from hfrep_tpu.obs import fleet
+        if len(args.run_dirs) != 1:
+            print("export --fleet wants exactly one fleet root",
+                  file=sys.stderr)
+            return 2
+        return fleet.export_fleet_main(
+            args.run_dirs[0], out=args.out,
+            watch_iterations=args.watch, interval=args.interval,
+            persist=args.watch is not None)
     from hfrep_tpu.obs import tail
     return tail.export_main(args.run_dirs, out=args.out)
+
+
+def _cmd_slo(args) -> int:
+    from hfrep_tpu.obs import slo as slo_mod
+    if args.self_test:
+        return slo_mod.self_test()
+    if not args.root:
+        print("slo wants a fleet root (or --self-test)", file=sys.stderr)
+        return 2
+    kw = {"slos_path": args.slos, "persist": args.persist}
+    if args.fast_buckets is not None:
+        kw["fast_buckets"] = args.fast_buckets
+    if args.slow_buckets is not None:
+        kw["slow_buckets"] = args.slow_buckets
+    if args.bucket_secs is not None:
+        kw["bucket_secs"] = args.bucket_secs
+    try:
+        doc = slo_mod.evaluate_root(args.root, **kw)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(slo_mod.render(doc))
+        led = doc["fleet"]["ledger"]
+        print(f"fleet: {doc['fleet']['replicas']} replica(s), ledger "
+              f"{led['submitted']}→{led['terminal']} "
+              f"(deficit {led['deficit']}), "
+              f"{doc['fleet']['breakers']['open']} breaker(s) open, "
+              f"{len(doc['fleet']['restarts']['storms'])} restart "
+              f"storm(s)")
+    return 0 if (doc["ok"] and doc["fleet"]["ok"]) else 1
+
+
+def _cmd_compact(args) -> int:
+    from hfrep_tpu.obs import rollup
+    kw = {}
+    if args.bucket_secs is not None:
+        kw["bucket_secs"] = args.bucket_secs
+    results = []
+    rc = 0
+    for d in args.run_dirs:
+        try:
+            res = rollup.compact(d, rotate_bytes=args.rotate_bytes,
+                                 force_rotate=args.force_rotate, **kw)
+        except OSError as e:
+            print(f"error: {d}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        res["run_dir"] = str(d)
+        res["disk_bytes"] = rollup.disk_footprint(d)
+        results.append(res)
+    if args.format == "json":
+        print(json.dumps(results, indent=2, default=str))
+    else:
+        for res in results:
+            print(f"{res['run_dir']}: ingested {res['ingested']} "
+                  f"record(s), compacted {len(res['compacted'])} "
+                  f"chunk(s) ({res['chunks_total']} total, "
+                  f"{res['records_compacted']} records), "
+                  f"disk {res['disk_bytes']} B"
+                  + (f", rotated {res['rotated']}" if res["rotated"]
+                     else ""))
+    return rc
 
 
 def _cmd_crash_drill(args) -> int:
@@ -949,7 +1180,8 @@ def main(argv=None) -> int:
     return {"report": _cmd_report, "gate": _cmd_gate,
             "ingest": _cmd_ingest, "tail": _cmd_tail,
             "export": _cmd_export, "explain": _cmd_explain,
-            "profile": _cmd_profile,
+            "profile": _cmd_profile, "slo": _cmd_slo,
+            "compact": _cmd_compact,
             "crash-drill": _cmd_crash_drill}[args.command](args)
 
 

@@ -22,7 +22,6 @@ Everything here is stdlib-only, like the rest of the obs read path.
 from __future__ import annotations
 
 import json
-import math
 import os
 import re
 import sys
@@ -66,13 +65,14 @@ class TailAggregate:
                 if isinstance(value, (int, float)):
                     self.counters[name] = float(value)
             elif rec.get("kind") == "histogram":
-                h = self.hists.setdefault(
-                    name, {"n": 0, "sum": 0.0, "max": None})
-                if isinstance(value, (int, float)) and math.isfinite(value):
-                    h["n"] += 1
-                    h["sum"] += float(value)
-                    h["max"] = (float(value) if h["max"] is None
-                                else max(h["max"], float(value)))
+                # the full log-bucket accumulator (same math as the
+                # in-process obs.Histogram), not just count/sum/max —
+                # the Prometheus exposition derives real cumulative
+                # _bucket{le=...} series from it
+                from hfrep_tpu.obs import rollup as _rollup
+                h = self.hists.setdefault(name, _rollup.new_hist())
+                if isinstance(value, (int, float)):
+                    _rollup.hist_observe(h, value)
         elif rtype == "span":
             if rec.get("name") == "block" and rec.get("steps"):
                 try:
@@ -197,13 +197,18 @@ class _StreamFollower:
 
 
 def _stream_label(path: Path, roots: List[Path]) -> str:
+    # rollup chunks/pins are earlier bytes of their run dir's live
+    # stream — fold them under the run's label, not a "rollup" row
+    parent = path.parent
+    if parent.name == "rollup":
+        parent = parent.parent
     for root in roots:
         try:
-            rel = path.parent.relative_to(root)
+            rel = parent.relative_to(root)
         except ValueError:
             continue
         return root.name if str(rel) in ("", ".") else str(rel)
-    return str(path.parent)
+    return str(parent)
 
 
 def _discover(run_dirs: List[Path]) -> List[Path]:
@@ -211,9 +216,20 @@ def _discover(run_dirs: List[Path]) -> List[Path]:
     out = []
     for d in run_dirs:
         # real streams only: a crash bundle's events_tail.jsonl is a
-        # copy of stream tails and would double-count every record
-        out.extend(sorted(f for f in d.rglob("events*.jsonl")
-                          if is_stream_file(f)))
+        # copy of stream tails and would double-count every record.
+        # Rollup chunks/pins are earlier bytes of those same streams
+        # (writer rotation / compaction evidence) — follow them too, so
+        # a long soak's tail/export doesn't go blind at the first
+        # rotation.  Fully-compacted aggregates live in rollup
+        # state.json; `export --fleet` is the reader for those.
+        streams = sorted(f for f in d.rglob("events*.jsonl")
+                         if is_stream_file(f))
+        for f in streams:
+            if f.name == "events.jsonl":
+                from hfrep_tpu.obs import rollup as _rollup
+                out.extend(_rollup.pinned_files(f.parent))
+                out.extend(_rollup.chunk_files(f.parent))
+            out.append(f)
     return out
 
 
@@ -288,8 +304,16 @@ def prometheus_text(aggs: Dict[str, TailAggregate]) -> str:
             lines.append(f'{pname}{{stream="{esc(label)}"}} {v}')
     for name in sorted(hists):
         pname = _prom_name(name)
-        lines.append(f"# TYPE {pname} summary")
+        lines.append(f"# TYPE {pname} histogram")
         for label, h in hists[name]:
+            # proper cumulative buckets from the log-bucket accumulator:
+            # le = each bucket's exact upper edge (10**((idx+1)/100)),
+            # zero/negative samples folded in from the smallest bucket
+            # up, closed by the mandatory le="+Inf" = _count
+            from hfrep_tpu.obs import rollup as _rollup
+            for le, cum in _rollup.hist_cumulative(h):
+                lines.append(f'{pname}_bucket{{stream="{esc(label)}",'
+                             f'le="{le}"}} {cum}')
             lines.append(
                 f'{pname}_count{{stream="{esc(label)}"}} {h["n"]}')
             lines.append(f'{pname}_sum{{stream="{esc(label)}"}} {h["sum"]}')

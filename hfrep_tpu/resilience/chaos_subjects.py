@@ -322,6 +322,123 @@ def _run_walkforward(out: Path, fixture_seed: int, resume: bool) -> dict:
     return {"windows": int(spec.n_windows)}
 
 
+@_register("rollup", timeout=60.0,
+           hint_sites=("item", "rollup_publish", "obs_append"))
+def _run_rollup(out: Path, fixture_seed: int, resume: bool) -> dict:
+    """The fleet telemetry plane's retention loop (ISSUE 17) under
+    fire: a compressed-time soak that appends deterministic event
+    batches to a synthetic run dir, rotates the live stream at a byte
+    threshold and compacts every cycle — SIGKILL/EIO landing
+    mid-segment (``rollup_publish`` during the state publish) or
+    mid-compaction (during a pinned/ledger publish) must resume from
+    the durable cursor with zero lost or double-counted events.
+
+    Determinism notes (the oracle digests ``artifacts/`` bit-exactly):
+
+    * events are written as raw JSONL with seed-derived timestamps —
+      never through :class:`Obs`, whose ``perf_counter`` clock is
+      wall-nondeterministic;
+    * rotation is BYTE-driven and happens in the same guarded step as
+      the append (no fault site between them), so chunk numbering and
+      content are a pure function of the bytes appended — identical
+      between a faulted-then-resumed run and the undisturbed reference;
+    * per-batch progress is published atomically AFTER append+rotate
+      and BEFORE compaction, so a kill anywhere in compaction resumes
+      into the idempotent per-chunk ledger protocol, never into a
+      double append.
+
+    Invariants: ``items`` = records the final rollup state folded,
+    ``expected_items`` = records written — any drop or double-count
+    breaks the pair (zero-silent-drop oracle).
+    """
+    import hashlib
+    import json as _json
+
+    from hfrep_tpu import resilience
+    from hfrep_tpu.obs import rollup
+    from hfrep_tpu.utils.checkpoint import atomic_text
+
+    batches, rotate_bytes, bucket_secs = 24, 2048, 60.0
+    run = out / "scratch" / "soak_run"
+    run.mkdir(parents=True, exist_ok=True)
+    live = run / "events.jsonl"
+    progress_path = out / "scratch" / "progress.json"
+
+    def batch_lines(k: int) -> list:
+        base_t = k * 37.0
+        rnd = hashlib.sha256(f"{fixture_seed}:{k}".encode()).digest()
+        recs = []
+        for i in range(10):
+            recs.append({"v": 1, "t": base_t + i * 0.31, "type": "metric",
+                         "kind": "gauge", "name": "soak/depth",
+                         "value": rnd[i] % 17})
+        for i in range(8):
+            recs.append({"v": 1, "t": base_t + 3.1 + i * 0.17,
+                         "type": "metric", "kind": "histogram",
+                         "name": "serve/latency_ms",
+                         "value": 1.0 + (rnd[10 + i] % 50)})
+        for i in range(4):
+            recs.append({"v": 1, "t": base_t + 5.0 + i * 0.13,
+                         "type": "metric", "kind": "counter",
+                         "name": "soak/requests",
+                         "value": k * 4 + i + 1, "delta": 1})
+        for i in range(5):
+            recs.append({"v": 1, "t": base_t + 6.0 + i * 0.11,
+                         "type": "span", "name": "work",
+                         "dur": 0.01 * (1 + rnd[18 + i] % 9), "depth": 0})
+        recs.append({"v": 1, "t": base_t + 9.0, "type": "event",
+                     "name": "batch_end", "batch": k})
+        return [_json.dumps(r, sort_keys=True) for r in recs]
+
+    per_batch = len(batch_lines(0))
+    done = 0
+    if resume:
+        try:
+            done = int(_json.loads(progress_path.read_text())["batches"])
+        except (OSError, ValueError, KeyError):
+            done = 0
+        print(f"rollup: resuming after batch {done}", file=sys.stderr)
+
+    for k in range(done, batches):
+        # kills/preempts land here — between cycles, never mid-append
+        resilience.boundary("item")
+        data = "".join(ln + "\n" for ln in batch_lines(k))
+        with open(live, "a") as fh:
+            fh.write(data)
+        # byte-driven rotation INSIDE the guarded step: deterministic
+        rollup.rotate_live(run, rotate_bytes)
+        atomic_text(progress_path, _json.dumps({"batches": k + 1}))
+        # the consumer under test: one EIO is absorbed by a single
+        # bounded retry against the idempotent ledger; a persistent
+        # burst propagates as the typed storage exit (74)
+        try:
+            rollup.compact(run, bucket_secs=bucket_secs)
+        except OSError:
+            rollup.compact(run, bucket_secs=bucket_secs)
+
+    # drain the tail: rotate whatever is left, compact it, then
+    # normalize the cursor table to the (now empty) live stream
+    rollup.compact(run, bucket_secs=bucket_secs, force_rotate=True)
+    state, _ = rollup.ingest(run, bucket_secs=bucket_secs, persist=True)
+
+    art = out / "artifacts"
+    art.mkdir(parents=True, exist_ok=True)
+    atomic_text(art / "rollup_state.json",
+                _json.dumps(state, indent=2, sort_keys=True))
+    comp = rollup.load_compact(run) or {}
+    atomic_text(art / "rollup_compact.json",
+                _json.dumps(comp, indent=2, sort_keys=True))
+    pinned_digests = {
+        p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+        for p in rollup.pinned_files(run)}
+    atomic_text(art / "pinned_digests.json",
+                _json.dumps(pinned_digests, indent=2, sort_keys=True))
+    return {"items": rollup.n_records(state),
+            "expected_items": batches * per_batch,
+            "chunk_cycles": len((comp.get("chunks") or {})),
+            "disk_bytes": rollup.disk_footprint(run)}
+
+
 @_register("pipeline", timeout=240.0, tier="slow",
            hint_sites=("item", "idle", "actor", "queue_put", "queue_get",
                        "queue_item", "result", "result_save",

@@ -134,6 +134,7 @@ IO_SITES = (
     "queue_put",      # spool-queue item publication
     "queue_get",      # spool-queue item claim/read
     "serve_result",   # serving result-publish boundary
+    "rollup_publish",  # rollup state/seed/pinned atomic publication
 )
 POST_SAVE_SITES = (
     "ckpt",           # a published checkpoint directory

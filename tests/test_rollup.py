@@ -1,0 +1,413 @@
+"""Fleet telemetry plane (ISSUE 17): durable rollup cursors, bounded
+retention (rotate + compact), compaction-equivalence of the read path,
+fleet invariants over the committed fixture, SLO burn-rate gating, and
+the Prometheus export surfaces."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import hfrep_tpu.obs.fleet as fleet
+import hfrep_tpu.obs.rollup as rollup
+import hfrep_tpu.obs.slo as slo_mod
+from hfrep_tpu.obs import explain as explain_mod
+from hfrep_tpu.obs import history as hist_mod
+from hfrep_tpu.obs import regress
+from hfrep_tpu.obs import report as report_mod
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FX = REPO_ROOT / "hfrep_tpu" / "obs" / "_fixture"
+FLEET_FX = FX / "fleet"
+HIST_FX = FX / "history"
+
+
+def _obs_cli(*args):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("HFREP_OBS_DIR", "HFREP_HISTORY", "HFREP_FAULTS",
+                        "HFREP_OBS_ROTATE_BYTES")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, "-m", "hfrep_tpu.obs", *args],
+                          capture_output=True, text=True, env=env)
+
+
+# ------------------------------------------------------- synthetic runs
+def _batch_records(k: int):
+    """One deterministic soak batch: spans, all three metric kinds, an
+    event carrying a trace ID, and a pinned-class (warmup) span."""
+    t = k * 37.0
+    recs = [
+        {"v": 1, "t": t + 0.1, "type": "span", "name": "work",
+         "dur": 0.01 + k * 1e-4, "depth": 0},
+        {"v": 1, "t": t + 0.2, "type": "span", "name": "step",
+         "dur": 0.02, "depth": 0, "warmup": True},
+        {"v": 1, "t": t + 0.3, "type": "metric", "kind": "gauge",
+         "name": "soak/depth", "value": float(k % 7)},
+        {"v": 1, "t": t + 0.4, "type": "metric", "kind": "counter",
+         "name": "soak/requests", "value": float(k + 1), "delta": 1.0},
+        {"v": 1, "t": t + 0.5, "type": "metric", "kind": "histogram",
+         "name": "serve/latency_ms", "value": 5.0 + (k * 13 % 40)},
+        {"v": 1, "t": t + 0.6, "type": "event", "name": "serve_complete",
+         "trace": f"t-{k}", "latency_ms": 5.0 + (k * 13 % 40)},
+    ]
+    return recs
+
+
+PER_BATCH = len(_batch_records(0))
+
+
+def _append_batch(run_dir: Path, k: int) -> None:
+    run_dir.mkdir(parents=True, exist_ok=True)
+    with open(run_dir / "events.jsonl", "a") as fh:
+        for rec in _batch_records(k):
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def _mk_run(run_dir: Path, batches: int) -> Path:
+    for k in range(batches):
+        _append_batch(run_dir, k)
+    return run_dir
+
+
+# ------------------------------------------------------------ hist math
+def test_hist_math_matches_obs_histogram():
+    import hfrep_tpu.obs as obs_pkg
+
+    class _Sink:
+        def _emit(self, rec):
+            pass
+
+    ref = obs_pkg.Histogram(_Sink(), "x")
+    h = rollup.new_hist()
+    vals = [0.0, -2.5, 0.004, 1.0, 3.7, 42.0, 42.0, 999.5, 1e6, 0.3]
+    for v in vals:
+        ref.observe(v)
+        rollup.hist_observe(h, v)
+    for pct in (50, 90, 95, 99, 99.9):
+        assert rollup.hist_percentile(h, pct) == ref.percentile(pct)
+    cum = rollup.hist_cumulative(h)
+    assert cum[-1] == ("+Inf", len(vals))
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts)          # monotone cumulative
+
+
+def test_hist_merge_equals_single_fold():
+    a, b, whole = rollup.new_hist(), rollup.new_hist(), rollup.new_hist()
+    vals = [0.1 * i for i in range(40)]
+    for v in vals[:20]:
+        rollup.hist_observe(a, v)
+    for v in vals[20:]:
+        rollup.hist_observe(b, v)
+    for v in vals:
+        rollup.hist_observe(whole, v)
+    merged = rollup.hist_merge(rollup.hist_merge(rollup.new_hist(), a), b)
+    assert merged == whole
+
+
+# ------------------------------------------------- cursors & durability
+def test_ingest_folds_and_reingest_is_idempotent(tmp_path):
+    run = _mk_run(tmp_path / "run", 8)
+    state, consumed = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed == 8 * PER_BATCH
+    tot = rollup.totals(state)
+    assert tot["counters"]["soak/requests"]["inc"] == 8.0   # delta-summed
+    assert tot["gauges"]["soak/depth"]["last"] == 0.0       # k=7 -> 0
+    assert tot["gauges"]["soak/depth"]["max"] == 6.0
+    assert tot["hists"]["serve/latency_ms"]["n"] == 8
+    assert rollup.n_records(state) == 8 * PER_BATCH
+
+    before = (rollup.rollup_dir(run) / rollup.STATE_NAME).read_bytes()
+    state2, consumed2 = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed2 == 0
+    after = (rollup.rollup_dir(run) / rollup.STATE_NAME).read_bytes()
+    assert before == after                                  # bit-identical
+
+
+def test_incremental_ingest_bit_identical_to_one_shot(tmp_path):
+    inc, one = tmp_path / "inc" / "run", tmp_path / "one" / "run"
+    for k in range(6):
+        _append_batch(inc, k)
+        rollup.ingest(inc, bucket_secs=60.0)
+    _mk_run(one, 6)
+    rollup.ingest(one, bucket_secs=60.0)
+    a = (rollup.rollup_dir(inc) / rollup.STATE_NAME).read_bytes()
+    b = (rollup.rollup_dir(one) / rollup.STATE_NAME).read_bytes()
+    assert a == b
+
+
+def test_torn_tail_held_back_until_completed(tmp_path):
+    run = _mk_run(tmp_path / "run", 2)
+    line = json.dumps({"v": 1, "t": 99.0, "type": "event",
+                       "name": "serve_complete"}, sort_keys=True)
+    with open(run / "events.jsonl", "a") as fh:
+        fh.write(line[:10])                                 # torn tail
+    _, consumed = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed == 2 * PER_BATCH                        # tail held back
+    with open(run / "events.jsonl", "a") as fh:
+        fh.write(line[10:] + "\n")
+    state, consumed2 = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed2 == 1                                   # exactly once
+    assert rollup.n_records(state) == 2 * PER_BATCH + 1
+
+
+def test_cursor_follows_rotated_stream_without_double_count(tmp_path):
+    run = _mk_run(tmp_path / "run", 4)
+    state, consumed = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed == 4 * PER_BATCH
+    rollup.rotate_live(run, 1, force=True)                  # live -> chunk-1
+    _append_batch(run, 4)                                   # fresh live
+    state, consumed = rollup.ingest(run, bucket_secs=60.0)
+    assert consumed == PER_BATCH                            # no re-read
+    assert rollup.n_records(state) == 5 * PER_BATCH
+
+
+# --------------------------------------------------- retention/compaction
+def test_compaction_soak_bounds_disk_and_loses_nothing(tmp_path):
+    run = tmp_path / "run"
+    cycles, footprints = 12, []
+    for k in range(cycles):
+        _append_batch(run, k)
+        rollup.rotate_live(run, 64)                         # byte-driven
+        rollup.compact(run, bucket_secs=60.0)
+        footprints.append(rollup.disk_footprint(run))
+    comp = rollup.load_compact(run)
+    assert len(comp["chunks"]) >= 10                        # >=10 cycles
+    assert not rollup.chunk_files(run)                      # all folded
+    state, _ = rollup.ingest(run, bucket_secs=60.0)
+    assert rollup.n_records(state) == cycles * PER_BATCH    # zero lost
+    tot = rollup.totals(state)
+    assert tot["counters"]["soak/requests"]["inc"] == float(cycles)
+    # bounded: the steady-state footprint must not keep growing with the
+    # number of cycles (pinned evidence grows by the pinned classes only,
+    # never by the aggregated metric volume)
+    assert footprints[-1] < 40_000
+    growth = footprints[-1] - footprints[cycles // 2]
+    assert growth < 10_000
+
+
+def test_compaction_preserves_summary_and_evidence(tmp_path):
+    raw = _mk_run(tmp_path / "raw" / "run", 9)
+    comp = tmp_path / "comp" / "run"
+    shutil.copytree(raw, comp)
+    rollup.compact(comp, bucket_secs=60.0, rotate_bytes=64,
+                   force_rotate=True)
+    assert rollup.pinned_files(comp)                        # evidence kept
+
+    def _norm(doc, parent):
+        return json.dumps(doc).replace(str(parent), "<P>")
+
+    assert _norm(report_mod.summarize(raw), raw.parent) == \
+        _norm(report_mod.summarize(comp), comp.parent)
+    assert _norm(explain_mod.run_evidence(raw), raw.parent) == \
+        _norm(explain_mod.run_evidence(comp), comp.parent)
+
+
+def test_trace_identical_on_compacted_run(tmp_path):
+    raw = _mk_run(tmp_path / "raw" / "run", 5)
+    comp = tmp_path / "comp" / "run"                        # same basename:
+    shutil.copytree(raw, comp)                              # same label
+    rollup.compact(comp, bucket_secs=60.0, rotate_bytes=64,
+                   force_rotate=True)
+    ta = report_mod.trace_index([raw], ["t-3"])
+    tc = report_mod.trace_index([comp], ["t-3"])
+    sa = json.dumps(ta, sort_keys=True, default=str).replace(
+        str(raw.parent), "<P>")
+    sc = json.dumps(tc, sort_keys=True, default=str).replace(
+        str(comp.parent), "<P>")
+    assert sa == sc
+    assert ta["t-3"]                                        # non-vacuous
+
+
+def test_gate_verdict_identical_on_compacted_run(tmp_path):
+    raw_p, comp_p = tmp_path / "raw", tmp_path / "comp"
+    raw_p.mkdir(), comp_p.mkdir()
+    shutil.copytree(HIST_FX / "run_d", raw_p / "run_d")
+    shutil.copytree(HIST_FX / "run_d", comp_p / "run_d")
+    rollup.compact(comp_p / "run_d", bucket_secs=60.0, rotate_bytes=64,
+                   force_rotate=True)
+    outs = []
+    for parent in (raw_p, comp_p):
+        proc = _obs_cli("gate", str(parent / "run_d"),
+                        "--history", str(HIST_FX / "history.jsonl"),
+                        "--format", "json")
+        assert proc.returncode == 0, proc.stderr
+        outs.append(proc.stdout.replace(str(parent), "<P>"))
+    assert outs[0] == outs[1]
+
+
+def test_explain_identical_on_compacted_target(tmp_path):
+    raw_p, comp_p = tmp_path / "raw", tmp_path / "comp"
+    for parent in (raw_p, comp_p):
+        parent.mkdir()
+        shutil.copytree(HIST_FX / "run_c", parent / "run_c")
+        shutil.copytree(HIST_FX / "run_d", parent / "run_d")
+    rollup.compact(comp_p / "run_d", bucket_secs=60.0, rotate_bytes=64,
+                   force_rotate=True)
+    outs = []
+    for parent in (raw_p, comp_p):
+        proc = _obs_cli("explain", str(parent / "run_c"),
+                        str(parent / "run_d"), "--format", "json")
+        assert proc.returncode in (0, 1), proc.stderr
+        outs.append(proc.stdout.replace(str(parent), "<P>"))
+    assert outs[0] == outs[1]
+
+
+def test_rotated_uncompacted_run_reads_complete(tmp_path):
+    """Writer rotation alone (no compaction yet) must not blind the
+    read path: chunks are earlier bytes of the live stream."""
+    raw = _mk_run(tmp_path / "raw" / "run", 7)
+    rot = tmp_path / "rot" / "run"
+    shutil.copytree(raw, rot)
+    rollup.rotate_live(rot, 1, force=True)                  # all -> chunk-1
+    _append_batch(rot, 7)
+    _append_batch(raw, 7)
+
+    def _norm(doc, parent):
+        return json.dumps(doc).replace(str(parent), "<P>")
+
+    assert _norm(report_mod.summarize(raw), raw.parent) == \
+        _norm(report_mod.summarize(rot), rot.parent)
+    ta = report_mod.trace_index([raw], ["t-2"])
+    tr = report_mod.trace_index([rot], ["t-2"])
+    assert json.dumps(ta, default=str).replace(str(raw.parent), "<P>") == \
+        json.dumps(tr, default=str).replace(str(rot.parent), "<P>")
+    assert ta["t-2"]
+
+
+# ------------------------------------------------- writer-side rotation
+def test_writer_side_rotation_via_session(tmp_path):
+    import hfrep_tpu.obs as obs_pkg
+    run = tmp_path / "run"
+    with obs_pkg.session(run, command="rot-test", rotate_bytes=600) as obs:
+        g = obs.gauge("soak/depth")
+        for i in range(80):
+            g.set(float(i))
+    assert rollup.chunk_files(run)                          # rotated
+    man = json.loads((run / "run.json").read_text())
+    assert "rotate_bytes" not in man                        # knob, not metadata
+    state, _ = rollup.ingest(run, bucket_secs=60.0, persist=False)
+    tot = rollup.totals(state)
+    assert tot["gauges"]["soak/depth"]["n"] == 80           # nothing lost
+    assert tot["gauges"]["soak/depth"]["last"] == 79.0
+
+
+# ------------------------------------------------------ fleet invariants
+def test_fleet_fixture_catches_planted_ledger_drop():
+    states = fleet.fleet_states(FLEET_FX, persist=False)
+    assert sorted(states) == ["replica_a", "replica_b"]
+    inv = fleet.invariants(states)
+    led = inv["ledger"]
+    assert led["submitted"] == 74 and led["terminal"] == 72
+    assert led["deficit"] == 2 and not led["ok"]
+    assert led["bad_replicas"] == ["replica_b"]
+    assert not inv["ok"]
+    assert inv["breakers"]["open"] == 0                     # closed again
+    assert inv["restarts"]["storms"] == []
+    # read-only evaluation must leave the committed fixture pristine
+    assert not list(FLEET_FX.rglob("rollup"))
+
+
+def test_restart_storm_detection():
+    assert fleet._storm([0.0, 10.0, 20.0], 3, 60.0)
+    assert not fleet._storm([0.0, 100.0, 200.0], 3, 60.0)
+    assert fleet._storm([0.0, 100.0, 130.0, 140.0, 150.0], 3, 60.0)
+
+
+def test_fleet_prometheus_federation():
+    states = fleet.fleet_states(FLEET_FX, persist=False)
+    text = fleet.prometheus_text(states, fleet.invariants(states))
+    assert 'replica="replica_a"' in text and 'replica="replica_b"' in text
+    assert "hfrep_fleet_replicas 2" in text
+    assert "hfrep_fleet_ledger_deficit 2" in text
+    bucket_lines = [l for l in text.splitlines() if "_bucket{" in l]
+    assert any('le="+Inf"' in l for l in bucket_lines)
+    assert bucket_lines                                     # histograms out
+
+
+def test_export_fleet_cli(tmp_path):
+    out = tmp_path / "fleet.prom"
+    proc = _obs_cli("export", str(FLEET_FX), "--fleet", "-o", str(out))
+    assert proc.returncode == 0, proc.stderr
+    text = out.read_text()
+    assert "hfrep_fleet_replicas 2" in text
+    assert not list(FLEET_FX.rglob("rollup"))               # still pristine
+
+
+def test_export_emits_cumulative_histogram_buckets(tmp_path):
+    run = _mk_run(tmp_path / "run", 6)
+    proc = _obs_cli("export", str(run))
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines()
+             if l.startswith("hfrep_serve_latency_ms_bucket{")]
+    assert lines and 'le="+Inf"' in lines[-1]
+    counts = [int(float(l.rsplit(" ", 1)[1])) for l in lines]
+    assert counts == sorted(counts)
+    assert counts[-1] == 6
+
+
+# ------------------------------------------------------------- SLO layer
+def test_slo_fixture_breaches_shed_rate_only():
+    res = slo_mod.evaluate_root(FLEET_FX, fast_buckets=2, slow_buckets=5)
+    rows = {r["name"]: r for r in res["slos"]}
+    shed = rows["serve_shed_rate"]
+    assert shed["breach"]                                   # fast AND slow
+    assert shed["fast"]["burn"] >= 1.0 and shed["slow"]["burn"] >= 1.0
+    assert not rows["serve_latency_p95_ms"]["breach"]
+    assert not rows["serve_error_rate"]["breach"]
+    assert res["breaches"] == 1 and not res["ok"]
+    assert res["fleet"]["ledger"]["deficit"] == 2
+
+
+def test_slo_self_test_cli():
+    proc = _obs_cli("slo", "--self-test")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)                           # pure JSON stdout
+    assert doc["ok"] and all(c["ok"] for c in doc["checks"])
+
+
+def test_gate_pure_slo_mode_fails_on_breach():
+    proc = _obs_cli("gate", "--slo", str(FLEET_FX), "--format", "json")
+    assert proc.returncode == 1                             # breach + deficit
+    doc = json.loads(proc.stdout)
+    assert doc["breaches"] == 1 and not doc["fleet"]["ok"]
+
+
+def test_load_slos_rejects_malformed(tmp_path):
+    bad = tmp_path / "slo.json"
+    bad.write_text(json.dumps([{"name": "x", "kind": "ratio",
+                                "target": 0.1}]))           # ratio w/o bad
+    with pytest.raises(ValueError):
+        slo_mod.load_slos(str(bad))
+
+
+# ------------------------------------------- history/regress integration
+def test_fleet_and_slo_gauges_have_explicit_thresholds():
+    assert "fleet/" in hist_mod.GAUGE_PREFIXES
+    assert "slo/" in hist_mod.GAUGE_PREFIXES
+    for name in ("fleet/replicas", "fleet/ledger_deficit",
+                 "fleet/breakers_open", "fleet/restarts",
+                 "fleet/restart_storms", "slo/evaluated", "slo/breaches",
+                 "slo/warnings", "slo/worst_burn"):
+        row = regress.DEFAULT_THRESHOLDS[name]              # no fallback
+        assert row["direction"] in ("up", "down")
+    # burn/deficit-style gauges must fail loud, not ride the inverted
+    # suffix fallback: zero-floor rows are absolute, not relative
+    assert regress.DEFAULT_THRESHOLDS["fleet/ledger_deficit"]["rel_tol"] == 0.0
+    assert regress.DEFAULT_THRESHOLDS["slo/worst_burn"]["direction"] == "down"
+
+
+# --------------------------------------------------------- chaos surface
+def test_rollup_chaos_surface_registered():
+    from hfrep_tpu.resilience import chaos
+    from hfrep_tpu.resilience.chaos_subjects import SUBJECTS
+    from hfrep_tpu.resilience.faults import IO_SITES
+    assert "rollup_publish" in IO_SITES
+    assert "rollup" in SUBJECTS
+    assert "rollup_publish" in SUBJECTS["rollup"].hint_sites
+    entries = chaos.corpus_entries()
+    mine = [e for e in entries if e["subject"] == "rollup"]
+    assert mine and mine[0]["invariant"] == "resume_bit_identical"

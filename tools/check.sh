@@ -45,6 +45,14 @@ python -m hfrep_tpu.obs gate --self-test 1>&2
 # under) a CI self-test.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
     python -m hfrep_tpu.obs explain --self-test 1>&2
+# fleet-telemetry gate: rollup ingestion + cross-replica invariants +
+# SLO burn-rate math over the committed two-replica fleet fixture — the
+# planted ledger drop (submitted 74 vs terminal 72, replica_b) and the
+# shed burn breach must be caught, the healthy objectives must stay
+# green, and the read-only evaluation must leave the fixture pristine.
+# Env-stripped like the other self-tests; pure-JSON stdout → stderr.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
+    python -m hfrep_tpu.obs slo --self-test 1>&2
 # AE chunked-drive probe fast path: trains the early-exit fixture at tiny
 # shapes and asserts the >=2x chunked-vs-monolithic win, so the probe (and
 # the hot path it guards) can't rot.  Pinned to CPU (a self-test of the
