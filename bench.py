@@ -54,11 +54,11 @@ import json
 import os
 import sys
 import tempfile
-import time
 
 import jax
 import jax.numpy as jnp
 
+from hfrep_tpu.obs import timeline
 import hfrep_tpu.obs as obs_pkg
 from hfrep_tpu.config import ModelConfig, TrainConfig
 from hfrep_tpu.models.registry import build_gan
@@ -124,11 +124,11 @@ def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
         from hfrep_tpu.obs import attrib
         attrib.profile_jitted(multi, f"bench:{label}", state,
                               jax.random.fold_in(key, 0))
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for i in range(n_warmups):
         state, metrics = multi(state, jax.random.fold_in(key, i))
         float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
-    obs.record_span(span, time.perf_counter() - t0,
+    obs.record_span(span, timeline.clock() - t0,
                     steps=n_warmups * steps_per_call, warmup=True,
                     synced=True, config=label)
     if obs.enabled:
@@ -137,14 +137,14 @@ def _timed_multi(multi, state, key, n_warmups: int, n_calls: int,
         # them so the timed window below starts clean
         from hfrep_tpu.obs import attrib
         attrib.reset_window()
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     disp = 0.0
     for i in range(n_warmups, n_warmups + n_calls):
-        d0 = time.perf_counter()
+        d0 = timeline.clock()
         state, metrics = multi(state, jax.random.fold_in(key, i))
-        disp += time.perf_counter() - d0
+        disp += timeline.clock() - d0
     float(jax.device_get(metrics["d_loss"]).reshape(-1)[-1])
-    dt = time.perf_counter() - t0
+    dt = timeline.clock() - t0
     obs.record_span(span, dt, steps=n_calls * steps_per_call,
                     warmup=False, synced=True, config=label)
     if obs.enabled:
@@ -316,7 +316,7 @@ def _main_measured(obs_dir) -> None:
 
 
 def _bench(obs, mcfg: ModelConfig, tcfg: TrainConfig) -> int:
-    t_start = time.perf_counter()
+    t_start = timeline.clock()
     # Headline: committed-script shape, 20 × 50 = 1000 timed epochs —
     # the very dataclasses main() annotated into run.json (including the
     # precision policy), so the manifest can never drift from the
@@ -341,14 +341,14 @@ def _bench(obs, mcfg: ModelConfig, tcfg: TrainConfig) -> int:
     # tunnel); skip rather than risk losing the whole JSON line to a
     # driver timeout on a slow-compile day.
     dp = sp = None
-    if time.perf_counter() - t_start < 300:
+    if timeline.clock() - t_start < 300:
         try:
             dp = round(measure_dp(n_calls=10), 3)
         except Exception as e:  # bench must still emit its line on dp failure
             print(f"bench: dp measurement failed ({e!r})", file=sys.stderr)
     else:
         print("bench: skipping dp measurement (time budget)", file=sys.stderr)
-    if time.perf_counter() - t_start < 360:
+    if timeline.clock() - t_start < 360:
         try:
             sp = round(measure_sp(n_calls=10), 3)
         except Exception as e:  # likewise for the sp line

@@ -21,12 +21,12 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 import hfrep_tpu.obs as obs_pkg
 
 GEN_PKL = "/root/reference/GAN/generated_data2022-07-09.pkl"
@@ -51,9 +51,9 @@ def bench_ae_epoch() -> None:
     obs = obs_pkg.get_obs()
     times = []
     for r in range(3):
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         jax.block_until_ready(fn(jax.random.PRNGKey(r)).params)
-        dt = time.perf_counter() - t0
+        dt = timeline.clock() - t0
         times.append(dt)
         obs.record_span("bench", dt, steps=epochs, warmup=False,
                         synced=True, config="ae_epoch")

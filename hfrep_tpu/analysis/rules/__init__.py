@@ -18,6 +18,7 @@ from hfrep_tpu.analysis.rules.hf_version_gate import VersionGateRule
 from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
 from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
 from hfrep_tpu.analysis.rules.hf_mesh_launch import MeshLaunchRule
+from hfrep_tpu.analysis.rules.hf_wallclock import WallClockRule
 from hfrep_tpu.analysis.rules.jpx_base import ProgramRule  # noqa: F401
 from hfrep_tpu.analysis.rules.jpx_donation import ProgramDonationRule
 from hfrep_tpu.analysis.rules.jpx_precision import ProgramPrecisionRule
@@ -43,6 +44,9 @@ ALL_RULES = (
     ThreadSignalRule(),
     ExitCodeRule(),
     MeshLaunchRule(),
+    # the wall-clock ledger's monopoly (ISSUE 18): raw clock reads
+    # outside hfrep_tpu/obs/ measure time the ledger cannot conserve
+    WallClockRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

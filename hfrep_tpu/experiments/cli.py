@@ -423,7 +423,7 @@ def _make_trainer(preset: str, cleaned_dir: str, checkpoint_dir=None,
     from hfrep_tpu.config import get_preset
     from hfrep_tpu.core.data import build_gan_dataset, load_panel
     from hfrep_tpu.train.trainer import GanTrainer
-    from hfrep_tpu.utils.logging import MetricLogger
+    from hfrep_tpu.obs.metriclog import MetricLogger
 
     # Flag validation BEFORE mesh construction: --sp-remat's gating must
     # not depend on device availability (a <8-chip host would otherwise

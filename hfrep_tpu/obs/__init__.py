@@ -2,18 +2,22 @@
 
 The reference codebase's only observability is ``print`` statements in
 its epoch loops (SURVEY §5.5); rounds 1-5 of this port grew two point
-tools — :class:`~hfrep_tpu.utils.logging.MetricLogger` (JSONL metrics)
-and :class:`~hfrep_tpu.utils.profiling.StepTimer` (device-synced step
-timing) — with nothing connecting the trainer, the parallel launch
-paths, the replication engine and the bench probes.  This package is the
-single telemetry subsystem behind all of them:
+tools — a JSONL ``MetricLogger`` and a device-synced ``StepTimer`` —
+with nothing connecting the trainer, the parallel launch paths, the
+replication engine and the bench probes.  This package is the single
+telemetry subsystem behind all of them (the PR-2 shims are retired:
+:class:`hfrep_tpu.obs.metriclog.MetricLogger` carries the reference
+epoch-echo formats, :class:`hfrep_tpu.obs.timeline.BlockTimer` the
+block-boundary timing):
 
 * **spans** — ``with obs.span("compile"): ...`` nested, device-sync-aware
   timings (pass ``sync_on=`` a device array to block on XLA's async
   dispatch before the clock stops);
-* **metrics** — one registry of counters / gauges / histograms, which
-  :class:`MetricLogger` and :class:`StepTimer` now feed as thin
-  compatibility shims;
+* **metrics** — one registry of counters / gauges / histograms;
+* **wall-clock ledger** — every ms of an instrumented drive assigned to
+  exactly one category, Σ(categories) == wall pinned, perfetto-timeline
+  reconstruction from the event stream alone
+  (:mod:`hfrep_tpu.obs.timeline`; ``python -m hfrep_tpu.obs timeline``);
 * **device telemetry** — ``jax.live_arrays()`` / ``memory_stats()``
   snapshots and backend-compile counts via ``jax.monitoring``
   (:mod:`hfrep_tpu.obs.device`);
@@ -248,6 +252,12 @@ class Obs:
         else:
             self._io_fault = io_hook("obs_append")
         self._flush_every = max(1, flush_every)
+        # the wall-clock ledger's self-measurement: every _emit times its
+        # own body into the `obs_self` category, so `timeline/obs_self_frac`
+        # is measured by the same plane it polices (cached module ref —
+        # obs is fully imported by construction time, so no cycle)
+        from hfrep_tpu.obs import timeline as _timeline
+        self._timeline = _timeline
         self._t0 = time.perf_counter()
         self._stack: List[str] = []          # open span names (nesting)
         self._counters: Dict[str, Counter] = {}
@@ -279,6 +289,7 @@ class Obs:
     def _emit(self, rec: dict) -> None:
         if self._fh is None:
             return
+        t_emit = time.perf_counter()
         rec = {"v": SCHEMA_VERSION, "t": round(self.now(), 6), **rec}
         try:
             if self._io_fault is not None:
@@ -292,6 +303,9 @@ class Obs:
                     self._rotate_live()
         except (OSError, ValueError):       # telemetry must not kill a run
             pass
+        finally:
+            # pure accumulator arithmetic — no emit, so no recursion
+            self._timeline.note_obs_self(time.perf_counter() - t_emit)
 
     def _rotate_live(self) -> None:
         """Writer-side rotation: flush + close the live stream, rename
@@ -369,7 +383,7 @@ class Obs:
                         "synced": synced, **_json_safe(attrs)})
 
     def record_span(self, name: str, dur: float, **attrs) -> None:
-        """A span whose duration was measured elsewhere (e.g. StepTimer's
+        """A span whose duration was measured elsewhere (e.g. BlockTimer's
         already-device-synced windows) — same schema, no re-timing."""
         parent = self._stack[-1] if self._stack else None
         self._emit({"type": "span", "name": name, "dur": round(float(dur), 6),
@@ -467,6 +481,10 @@ def enable(run_dir, *, manifest: bool = True, compile_listener: bool = True,
     global _active
     if _active is not None:
         disable()
+    # a fresh run arms a fresh wall-clock ledger: the previous run's
+    # cumulative category fractions must not bleed into this one's gauges
+    from hfrep_tpu.obs import timeline
+    timeline.reset()
     obs = Obs(run_dir, rotate_bytes=rotate_bytes)
     _active = obs
     try:
@@ -656,7 +674,7 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
     the callable or runtime cannot lower) — and counts subsequent
     dispatches (un-synced — counting must not serialize the trainer's
     block pipelining) while accumulating their un-blocked host-side
-    durations into the attribution window ``StepTimer.stop`` flushes at
+    durations into the attribution window ``BlockTimer.stop`` flushes at
     the block boundaries the trainer already syncs at (the
     dispatch-vs-compute split; zero per-call events, zero new syncs).
     """
@@ -673,7 +691,12 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
             state["first"] = False
             # fingerprint BEFORE executing: the jitted step may donate
             # its input buffers, and lowering only reads avals anyway
-            attrib.profile_jitted(fn, f"compile:{name}", *args, **kwargs)
+            from hfrep_tpu.obs import timeline
+            # program fingerprinting is obs-only work (it does not run
+            # with telemetry off), so its lowering cost books as the
+            # obs layer's own overhead
+            with timeline.timed("obs_self"):
+                attrib.profile_jitted(fn, f"compile:{name}", *args, **kwargs)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
             try:
@@ -681,8 +704,12 @@ def instrument_step(fn, name: str, mesh=None, **attrs):
                 jax.block_until_ready(out)
             except Exception:
                 pass
-            obs.record_span(f"compile:{name}", time.perf_counter() - t0,
-                            synced=True)
+            dur = time.perf_counter() - t0
+            obs.record_span(f"compile:{name}", dur, synced=True)
+            # the warmup ledger window's dominant cost: trace + XLA
+            # compile + the synced first execution, booked as dispatch
+            # (warmup windows' dispatch includes compile by contract)
+            timeline.account("dispatch", dur)
             return out
         obs.counter(f"dispatch:{name}").inc()
         t0 = time.perf_counter()

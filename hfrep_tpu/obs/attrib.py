@@ -27,7 +27,7 @@ PAPERS.md's scaling references):
   host-dispatch time (the un-blocked jitted-call returns XLA's async
   dispatch hands back immediately) vs the residual the host spent
   blocked on device compute, measured ONLY at the block boundaries the
-  drives already sync at (``StepTimer.stop``, the AE engine's
+  drives already sync at (``BlockTimer.stop``, the AE engine's
   continue/stop scalar) — zero new syncs inside scans, no-op when obs
   is off, trajectories bit-identical (the PR-12 discipline; pinned by
   ``tests/test_obs_attrib.py``).  Surfaced as

@@ -304,6 +304,59 @@ def diagnose(target: dict, cohort: List[dict], top: int = 10) -> dict:
                 f"{label} share of the boundary window grew",
                 gauge=gname, observed=t_v, baseline=c_v))
 
+    # -- wall-clock ledger category deltas (ISSUE 18), gated on
+    # IDENTICAL program fingerprints: when the target compiled exactly
+    # the digests the cohort compiled (same boundaries, same HLO), the
+    # regression cannot be "the program changed" — the wall clock moved
+    # between categories instead, and the cumulative ``timeline/*_frac``
+    # gauges say from where to where.  On differing programs the
+    # program/compile findings above own the diagnosis and a category
+    # delta would only restate their symptom, so the section stays
+    # silent there (and on pre-ledger runs without the gauges).
+    same_programs = bool(target["programs"]) and cohort_has_programs \
+        and set(target["programs"]) == cohort_names
+    if same_programs:
+        for name in cohort_names:
+            c_dig = set()
+            for ev in cohort:
+                c_dig |= _digests(ev, name)
+            if _digests(target, name) != c_dig:
+                same_programs = False
+                break
+    if same_programs:
+        from hfrep_tpu.obs.timeline import CATEGORIES
+        for cat in CATEGORIES:
+            gname = f"timeline/{cat}_frac"
+            t_v = _num(target["gauges"].get(gname))
+            c_v = _cohort_median([ev["gauges"].get(gname)
+                                  for ev in cohort])
+            if t_v is None or c_v is None:
+                continue
+            dpt = (t_v - c_v) * 100.0
+            # device_compute is the one GOOD category: it shrinking is
+            # the symptom the overhead categories' growth explains
+            if cat == "device_compute" or dpt <= 2.0:
+                continue
+            findings.append(_finding(
+                "timeline", 1.2 + 0.12 * dpt,
+                f"{gname} {t_v:.3f} vs cohort {c_v:.3f} ({dpt:+.0f}pt) "
+                f"on an UNCHANGED program — the wall clock moved into "
+                f"{cat}, not into different device work",
+                gauge=gname, observed=t_v, baseline=c_v))
+        t_ov = _num(target["gauges"].get("timeline/overlap_frac"))
+        c_ov = _cohort_median([ev["gauges"].get("timeline/overlap_frac")
+                               for ev in cohort])
+        if t_ov is not None and c_ov is not None \
+                and (c_ov - t_ov) * 100.0 > 5.0:
+            findings.append(_finding(
+                "timeline", 1.2 + 0.12 * (c_ov - t_ov) * 100.0,
+                f"timeline/overlap_frac {t_ov:.3f} vs cohort {c_ov:.3f} "
+                f"({(t_ov - c_ov) * 100.0:+.0f}pt) — less host work is "
+                "hidden behind device execution than the baseline "
+                "managed (pipelining regressed)",
+                gauge="timeline/overlap_frac", observed=t_ov,
+                baseline=c_ov))
+
     # -- span movers (supporting evidence; per-occurrence mean so a run
     # with more blocks isn't "slower" by volume alone)
     for name in sorted(target["spans"]):
@@ -348,7 +401,8 @@ def diagnose(target: dict, cohort: List[dict], top: int = 10) -> dict:
     findings = findings[: max(1, int(top))]
     for i, f in enumerate(findings, 1):
         f["rank"] = i
-    attributed = any(f["kind"] in ("program", "compile", "cost", "attrib")
+    attributed = any(f["kind"] in ("program", "compile", "cost", "attrib",
+                                   "timeline")
                      for f in findings)
     return {
         "v": 1,

@@ -59,7 +59,7 @@ def mfu(steps_per_sec: float, w: int, f: int, h: int = H, batch: int = B,
         peak: float = PEAK_BF16) -> float:
     """Model FLOPs utilization of a measured epoch rate against ``peak``.
 
-    Non-finite / non-positive rates (e.g. a StepTimer with only warmup
+    Non-finite / non-positive rates (e.g. a BlockTimer with only warmup
     samples) come back as ``nan`` rather than raising inside telemetry.
     """
     try:

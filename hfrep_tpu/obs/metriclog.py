@@ -1,17 +1,22 @@
-"""Structured metric logging (compatibility shim over ``hfrep_tpu.obs``).
+"""Structured per-epoch metric logging (JSONL + reference-format echo).
 
 The reference's observability is ``print`` statements in the epoch loop
 (``GAN/MTSS_WGAN_GP.py:284``) — including the WGAN quirk of printing
 ``1 − d_loss`` (``GAN/WGAN.py:208``) while WGAN-GP prints raw losses
-(SURVEY §5.5).  Here metrics stream to JSONL (and optionally CSV) with a
-console formatter that can reproduce the reference's exact print lines
-for eyeball comparison.
+(SURVEY §5.5).  Here metrics stream to JSONL with a console formatter
+that reproduces the reference's exact print lines for eyeball
+comparison, and every ``log()`` additionally forwards into the active
+obs event stream (gauge metrics named ``train/<key>``) when telemetry
+is enabled — one logging call site, two sinks, zero cost when obs is
+off.
 
-Since the ``hfrep_tpu.obs`` layer landed, :class:`MetricLogger` is a thin
-shim: its per-run JSONL file and console echo are unchanged, and every
-``log()`` additionally forwards into the active obs event stream (gauge
-metrics named ``train/<key>``) when telemetry is enabled — one logging
-call site, two sinks, zero cost when obs is off.
+History: born as ``hfrep_tpu/utils/logging.py`` in PR 2, reduced to a
+shim when the obs layer landed, moved HERE when the wall-clock ledger
+(:mod:`hfrep_tpu.obs.timeline`) retired the shim tier — the epoch echo
+is part of the observability surface, so it lives with it.  Its
+companion shim ``utils.profiling.StepTimer`` is gone outright:
+:class:`hfrep_tpu.obs.timeline.BlockTimer` is the one block-boundary
+timing surface.
 """
 
 from __future__ import annotations

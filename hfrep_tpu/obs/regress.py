@@ -260,6 +260,39 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                               "abs_tol": 0.5, "mad_mult": 0.0},
     "slo/worst_burn":        {"direction": "down", "rel_tol": 0.0,
                               "abs_tol": 0.25, "mad_mult": 5.0},
+    # wall-clock ledger gauges (hfrep_tpu/obs/timeline.py; ISSUE 18).
+    # Every ``timeline/*`` row is explicit — "_frac" carries no cost
+    # suffix, so EVERY fraction here would hit the higher-is-better
+    # fallback inverted (the shed_rate class, again).  The two gated
+    # hygiene fractions use absolute floors near zero: a healthy drive
+    # keeps ``obs_self_frac`` under 1% (the <0.01 acceptance gate — the
+    # observer must not become the observed) and ``unattributed_frac``
+    # small, where any relative tolerance is ~nothing and would flag
+    # scheduler jitter.  ``device_compute_frac`` is the one
+    # higher-is-better fraction (more of the wall on the chip);
+    # dispatch/host_io/checkpoint/queue_wait are overheads.
+    # ``overlap_frac`` is ROADMAP item 2(a)'s before-measurement:
+    # higher = more host work hidden behind device execution.
+    # ``wall_ms`` is a cost with a wide floor (whole-drive wall clocks
+    # are host-load noisy; steps_per_sec stays the primary alarm).
+    "timeline/device_compute_frac": {"direction": "up",   "rel_tol": 0.0,
+                                     "abs_tol": 0.10, "mad_mult": 5.0},
+    "timeline/dispatch_frac":       {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.10, "mad_mult": 5.0},
+    "timeline/host_io_frac":        {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.05, "mad_mult": 5.0},
+    "timeline/checkpoint_frac":     {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.05, "mad_mult": 5.0},
+    "timeline/queue_wait_frac":     {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.05, "mad_mult": 5.0},
+    "timeline/obs_self_frac":       {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.01, "mad_mult": 0.0},
+    "timeline/unattributed_frac":   {"direction": "down", "rel_tol": 0.0,
+                                     "abs_tol": 0.10, "mad_mult": 5.0},
+    "timeline/overlap_frac":        {"direction": "up",   "rel_tol": 0.0,
+                                     "abs_tol": 0.10, "mad_mult": 5.0},
+    "timeline/wall_ms":             {"direction": "down", "rel_tol": 0.50,
+                                     "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

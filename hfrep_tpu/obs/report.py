@@ -831,6 +831,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "and burn-rate breach must be caught (CI gate; "
                         "pure-JSON stdout)")
 
+    tl = sub.add_parser(
+        "timeline", help="wall-clock ledger: fold a run's "
+                         "timeline_window records into the whole-run "
+                         "conservation ledger (Σ category ms == wall "
+                         "ms), report per-category fractions + achieved "
+                         "host/device overlap, and optionally "
+                         "reconstruct a Chrome-trace/perfetto timeline "
+                         "from the event stream alone (byte-identical "
+                         "on a compacted run dir)")
+    tl.add_argument("run_dir", nargs="?",
+                    help="run dir to account (omit with --self-test)")
+    tl.add_argument("-o", "--out", default=None, metavar="TRACE_JSON",
+                    help="also write the perfetto trace-event JSON here "
+                         "(atomic tmp+rename; open in ui.perfetto.dev "
+                         "or chrome://tracing)")
+    tl.add_argument("--format", choices=("human", "json"), default="human")
+    tl.add_argument("--self-test", action="store_true",
+                    help="accumulator conservation algebra + the "
+                         "hand-computed fixture ledger + compaction "
+                         "byte-identity + torn-tail degradation + the "
+                         "obs_self_frac<1%% ceiling (CI gate; pure-JSON "
+                         "stdout)")
+
     c = sub.add_parser(
         "compact", help="bounded retention for long soaks: rotate an "
                         "oversized live stream aside, fold rotated "
@@ -1170,6 +1193,17 @@ def _cmd_compact(args) -> int:
     return rc
 
 
+def _cmd_timeline(args) -> int:
+    from hfrep_tpu.obs import timeline
+    if args.self_test:
+        return timeline.self_test()
+    if not args.run_dir:
+        print("timeline wants a run dir (or --self-test)", file=sys.stderr)
+        return 2
+    return timeline.timeline_main(args.run_dir, out=args.out,
+                                  fmt=args.format)
+
+
 def _cmd_crash_drill(args) -> int:
     from hfrep_tpu.obs import crash
     return crash.drill()
@@ -1181,7 +1215,7 @@ def main(argv=None) -> int:
             "ingest": _cmd_ingest, "tail": _cmd_tail,
             "export": _cmd_export, "explain": _cmd_explain,
             "profile": _cmd_profile, "slo": _cmd_slo,
-            "compact": _cmd_compact,
+            "compact": _cmd_compact, "timeline": _cmd_timeline,
             "crash-drill": _cmd_crash_drill}[args.command](args)
 
 

@@ -1,0 +1,704 @@
+"""Wall-clock ledger: conservation-law time accounting for every drive.
+
+PR 13's ``attrib/{dispatch_ms,compute_ms}`` split answers "host or
+chip?"; nothing in the repo could say where the REST of a drive's wall
+clock goes — snapshot I/O? queue waits? the obs layer itself?
+un-overlapped boundary syncs?  This module is the missing plane: every
+millisecond of an instrumented drive's wall time is assigned to exactly
+one category of :data:`CATEGORIES`, and
+
+    Σ(category ms) == window wall ms
+
+is a pinned ledger invariant (the serve layer's submitted==terminal,
+applied to time).  Three moving parts:
+
+* **the accumulator** — a lock-guarded, per-process category ledger fed
+  by :func:`timed` / :func:`account` / :func:`note_obs_self`.  Nested
+  :func:`timed` frames account EXCLUSIVE (self) time — a ``host_io``
+  block wrapping a ``checkpoint`` write books only its own milliseconds,
+  so nesting can never double-count.  Accumulation is pure host-side
+  arithmetic: no events, no syncs, no device work.
+* **window flushes** — :func:`flush_window` closes the ledger at a
+  boundary the drive ALREADY syncs at (the trainer's block stop, the AE
+  engine's chunk boundary), emitting one ``timeline_window`` event
+  (pinned verbatim by ``obs compact`` — event records survive
+  compaction whole) plus cumulative ``timeline/*`` gauges.  The
+  residual ``wall − Σ(measured)`` lands in ``unattributed`` — never
+  negative (oversums are proportionally clamped and flagged), so the
+  invariant holds by construction.  Zero new device syncs: the boundary
+  sync duration is MEASURED here (that is the ``device_compute``
+  category — host time blocked on the device), not added.
+* **reconstruction** — :func:`build_trace` renders any run dir's event
+  stream as a Chrome-trace/perfetto ``trace.json`` (no chip capture
+  needed), and :func:`ledger_from_events` re-derives the whole-run
+  ledger from the ``timeline_window`` records.  Both consume only
+  records ``rollup.pin_record`` preserves verbatim, so their output is
+  byte-identical on a rotated+compacted run dir vs the raw original
+  (the PR-17 equivalence discipline), and a torn tail (SIGKILL) only
+  shrinks the covered window set — the gap degrades into a larger
+  ``unattributed`` bucket, never a crash or a miscount.
+
+``timeline/obs_self_frac`` makes the obs layer prove its own overhead:
+``Obs._emit`` times itself into the ``obs_self`` category, and the
+``--self-test`` gate enforces < 1% on the committed fixture.
+
+Reading ``unattributed`` on a host with fewer cores than XLA wants
+(the 1-core CI container is the extreme): XLA's CPU compute threads
+preempt the host thread at arbitrary bytecode positions, so device
+compute that OVERLAPS the instrumented host code steals wall time
+from *inside* otherwise-cheap host sections — it surfaces as an
+unattributed residual that migrates when instrumentation changes the
+scheduling, and no host-side probe can pin it to a category without
+device counters.  That residual is the measurement working as designed
+(the books still close; the gate still bounds it); on a real TPU host
+the host thread runs unpreempted and the split is clean.
+
+HF009 (analysis rule): raw ``time.perf_counter()``/``time.time()``
+timing outside ``hfrep_tpu/obs/`` is banned — call sites route through
+:func:`clock` / :func:`stopwatch` / :func:`timed` so measured wall time
+stays inside the conservation plane.  All three work with telemetry
+off (:func:`timed` still measures; it just accounts nothing).
+
+Stdlib-only at import (the CLI stays instant); :class:`BlockTimer`
+imports jax lazily, only when asked to sync.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from hfrep_tpu.obs import get_obs
+
+#: every ledger category, in rendering order.  ``device_compute`` is
+#: host time measurably blocked on the device (boundary syncs — on an
+#: async backend that IS the un-overlapped device time the host waited
+#: out); ``dispatch`` is un-blocked jitted-call host time (the attrib
+#: window's measure; warmup windows' dispatch includes XLA compile);
+#: ``checkpoint`` covers snapshot/checkpoint persistence, ``host_io``
+#: every other instrumented host I/O, ``queue_wait`` backpressure and
+#: empty-queue waits, ``obs_self`` the telemetry layer's own emit cost,
+#: and ``unattributed`` the non-negative residual that closes the books.
+CATEGORIES = ("device_compute", "dispatch", "host_io", "checkpoint",
+              "queue_wait", "obs_self", "unattributed")
+
+#: conservation tolerance: |Σ(cat) − wall| per window, as a fraction of
+#: wall (plus an absolute 0.5 ms floor for micro-windows)
+CONSERVATION_REL_TOL = 0.01
+CONSERVATION_ABS_TOL_MS = 0.5
+
+#: the ``--self-test`` gate's ceiling on ``timeline/obs_self_frac``
+OBS_SELF_FRAC_MAX = 0.01
+
+
+def clock() -> float:
+    """The sanctioned monotonic wall-clock read (seconds; differences
+    only).  HF009 bans raw ``time.perf_counter()`` outside ``obs/`` so
+    every measured duration is at least *visible* to this plane; sites
+    that can name a category should prefer :func:`timed`."""
+    return time.perf_counter()
+
+
+# ---------------------------------------------------------- accumulator
+class _Frame:
+    __slots__ = ("child",)
+
+    def __init__(self):
+        self.child = 0.0
+
+
+class _Ledger:
+    """Per-process category accumulator.  ``window`` holds seconds since
+    the last flush; ``cum``/``cum_wall`` the whole-run totals behind the
+    cumulative ``timeline/*`` gauges; the overlap pair accumulates over
+    steady (non-warmup) windows only.  The lock guards totals (the serve
+    layer flushes from worker threads); the frame stack is thread-local
+    so concurrent drives cannot corrupt each other's nesting."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.window: Dict[str, float] = {}
+        self.cum: Dict[str, float] = {}
+        self.cum_wall = 0.0
+        self.overlap_host = 0.0
+        self.sync_wait = 0.0
+        self._tls = threading.local()
+
+    def frames(self) -> List[_Frame]:
+        st = getattr(self._tls, "frames", None)
+        if st is None:
+            st = self._tls.frames = []
+        return st
+
+    def add(self, category: str, seconds: float) -> None:
+        with self.lock:
+            self.window[category] = self.window.get(category, 0.0) + seconds
+
+    def take(self) -> Dict[str, float]:
+        with self.lock:
+            w, self.window = self.window, {}
+            return w
+
+
+_LEDGER = _Ledger()
+
+
+def reset() -> None:
+    """Drop all accumulated state (a fresh ``obs.enable`` arms a fresh
+    run: the previous run's cumulative fractions must not bleed in)."""
+    global _LEDGER
+    _LEDGER = _Ledger()
+
+
+def account(category: str, seconds: float) -> None:
+    """Book ``seconds`` of already-measured wall time to ``category``.
+
+    Inside an open :func:`timed` frame the time is *moved*, not
+    duplicated: it is also added to the innermost frame's child total,
+    so the enclosing category books only its exclusive remainder."""
+    if seconds <= 0.0:
+        return
+    frames = _LEDGER.frames()
+    if frames:
+        frames[-1].child += seconds
+    _LEDGER.add(category, seconds)
+
+
+def note_obs_self(seconds: float) -> None:
+    """``Obs._emit``'s self-measurement hook — the obs layer's own cost,
+    booked like any other category so it shows up in (and is gated by)
+    the same ledger it maintains."""
+    account("obs_self", seconds)
+
+
+def note_sync(seconds: float) -> None:
+    """Host time spent blocked on the device at a boundary the drive
+    already pays (``block_until_ready`` / the chunk ``device_get``) —
+    the ``device_compute`` category's one source."""
+    account("device_compute", seconds)
+
+
+class stopwatch:
+    """``with stopwatch() as sw: ...; sw.s`` — pure measurement, no
+    ledger booking (phase timings that are reported, not accounted)."""
+
+    s = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.s = time.perf_counter() - self._t0
+        return False
+
+
+class timed:
+    """``with timed("checkpoint") as tm: ...; tm.s`` — measure AND book
+    the block's EXCLUSIVE time to a category.  Nested ``timed`` blocks
+    subtract cleanly (each frame books ``dur − child``), and
+    :func:`account`/:func:`note_obs_self` calls inside the block move
+    their seconds out of the enclosing frame the same way, so the
+    window's Σ(categories) can never exceed the real elapsed wall by
+    double counting.  Books nothing when ``category`` is falsy."""
+
+    s = 0.0
+
+    def __init__(self, category: Optional[str], **_attrs):
+        self.category = category
+
+    def __enter__(self):
+        self._frame = _Frame()
+        _LEDGER.frames().append(self._frame)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        self.s = dur
+        frames = _LEDGER.frames()
+        frames.pop()
+        if self.category:
+            _LEDGER.add(self.category, max(0.0, dur - self._frame.child))
+            if frames:
+                frames[-1].child += dur
+        elif frames:
+            # un-booked measurement: the child time already moved to
+            # categories stays moved; only that portion leaves the parent
+            frames[-1].child += self._frame.child
+        return False
+
+
+def flush_window(wall_s: float, *, drive: str, steps: Optional[int] = None,
+                 warmup: bool = False, dispatch_s: Optional[float] = None,
+                 sync_wait_s: Optional[float] = None, **attrs
+                 ) -> Optional[dict]:
+    """Close the ledger window against a synced wall clock.
+
+    ``wall_s`` spans the window (ending at a boundary the drive already
+    syncs at).  ``dispatch_s`` is the attrib window's un-blocked
+    dispatch total for the same span (the caller flushes attrib first
+    and hands the seconds over); ``sync_wait_s`` the measured host
+    block at the boundary sync (→ ``device_compute``).  Emits ONE
+    ``timeline_window`` event — Σ(``cat_ms``) == ``wall_ms`` exactly,
+    oversums proportionally clamped and flagged — plus the cumulative
+    ``timeline/*_frac`` gauges, and ``timeline/overlap_frac`` over
+    steady windows: the fraction of boundary-relevant host time that
+    overlapped device execution, ``(wall − sync) / wall`` (≈1 on a
+    synchronous CPU backend where the dispatch IS the compute —
+    structural only there; the TPU number is the ROADMAP item 2(a)
+    baseline).  With telemetry off the window is discarded.  Never
+    raises into a drive."""
+    cats = _LEDGER.take()
+    obs = get_obs()
+    if not obs.enabled or not wall_s > 0:
+        return None
+    try:
+        if dispatch_s:
+            cats["dispatch"] = cats.get("dispatch", 0.0) + float(dispatch_s)
+        if sync_wait_s:
+            cats["device_compute"] = (cats.get("device_compute", 0.0)
+                                      + float(sync_wait_s))
+        measured = sum(cats.values())
+        oversum = measured > wall_s * (1.0 + CONSERVATION_REL_TOL)
+        if oversum and measured > 0:
+            scale = wall_s / measured
+            cats = {k: v * scale for k, v in cats.items()}
+            measured = wall_s
+        unattributed = max(0.0, wall_s - measured)
+        cat_ms = {c: round(cats.get(c, 0.0) * 1e3, 3) for c in CATEGORIES
+                  if c != "unattributed"}
+        # close the books EXACTLY: the event's own numbers must satisfy
+        # the invariant after rounding, so unattributed is the rounded
+        # residual, not a rounded residual estimate
+        wall_ms = round(wall_s * 1e3, 3)
+        cat_ms["unattributed"] = max(
+            0.0, round(wall_ms - sum(cat_ms.values()), 3))
+        overlap = None
+        if sync_wait_s is not None:
+            overlap = max(0.0, wall_s - float(sync_wait_s)) / wall_s
+        obs.event("timeline_window", drive=drive, wall_ms=wall_ms,
+                  cat_ms=cat_ms, steps=steps, warmup=bool(warmup),
+                  oversum=bool(oversum),
+                  overlap_frac=(None if overlap is None
+                                else round(overlap, 6)),
+                  **attrs)
+        with _LEDGER.lock:
+            for c, v in cats.items():
+                _LEDGER.cum[c] = _LEDGER.cum.get(c, 0.0) + v
+            _LEDGER.cum["unattributed"] = (_LEDGER.cum.get("unattributed", 0.0)
+                                           + unattributed)
+            _LEDGER.cum_wall += wall_s
+            if not warmup and sync_wait_s is not None:
+                _LEDGER.overlap_host += max(0.0, wall_s - float(sync_wait_s))
+                _LEDGER.sync_wait += float(sync_wait_s)
+            cum, cum_wall = dict(_LEDGER.cum), _LEDGER.cum_wall
+            o_host, o_sync = _LEDGER.overlap_host, _LEDGER.sync_wait
+        for c in CATEGORIES:
+            obs.gauge(f"timeline/{c}_frac").set(
+                round(cum.get(c, 0.0) / cum_wall, 6), drive=drive)
+        obs.gauge("timeline/wall_ms").set(round(cum_wall * 1e3, 3),
+                                          drive=drive)
+        if o_host + o_sync > 0:
+            obs.gauge("timeline/overlap_frac").set(
+                round(o_host / (o_host + o_sync), 6), drive=drive)
+        return {"wall_ms": wall_ms, "cat_ms": cat_ms, "oversum": oversum,
+                "overlap_frac": overlap}
+    except Exception:       # telemetry must never kill a drive
+        return None
+
+
+# ----------------------------------------------------------- BlockTimer
+class BlockTimer:
+    """Device-synced step timing + the ledger's block boundary — the
+    retired ``utils.profiling.StepTimer``'s contract (``block`` spans,
+    ``step_time`` histogram, warmup-aware :attr:`steps_per_sec`, the
+    attrib window flush) plus a :func:`flush_window` at the same synced
+    boundary, with the boundary sync itself measured into
+    ``device_compute`` and the steady windows feeding
+    ``timeline/overlap_frac``.  Zero new syncs: the ``sync_on`` block
+    was always the boundary's price."""
+
+    def __init__(self, drive: str = "gan_block") -> None:
+        self.drive = drive
+        self.samples: List[tuple] = []      # (n_steps, secs, warmup)
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, n_steps: int, sync_on=None, warmup: bool = False) -> float:
+        """Close one timing window.  ``warmup=True`` marks a sample that
+        carries XLA compile (excluded from :attr:`steps_per_sec` when
+        steady samples exist; its attrib window is discarded — that
+        dispatch time IS the compile — but its ledger window still
+        flushes, compile riding in ``dispatch``, so the run's wall
+        stays conserved)."""
+        sync_s = None
+        if sync_on is not None:
+            import jax
+            t_sync = time.perf_counter()
+            jax.block_until_ready(sync_on)
+            sync_s = time.perf_counter() - t_sync
+        dt = time.perf_counter() - self._t0
+        self.samples.append((n_steps, dt, warmup))
+        obs = get_obs()
+        if obs.enabled:
+            obs.record_span("block", dt, steps=int(n_steps),
+                            warmup=bool(warmup), synced=sync_on is not None)
+            if n_steps > 0:
+                obs.histogram("step_time").observe(dt / n_steps,
+                                                   warmup=bool(warmup))
+            from hfrep_tpu.obs import attrib
+            if warmup or sync_on is None:
+                # compile-polluted or un-synced wall: either would lie
+                # in the dispatch-vs-compute split
+                with attrib._WINDOW.lock:
+                    disp = sum(attrib._WINDOW.dispatch_s.values())
+                attrib.reset_window()
+                flush_window(dt, drive=self.drive, steps=int(n_steps),
+                             warmup=True, dispatch_s=disp,
+                             sync_wait_s=sync_s)
+            else:
+                out = attrib.flush_window(dt, steps=int(n_steps))
+                flush_window(dt, drive=self.drive, steps=int(n_steps),
+                             dispatch_s=((out or {}).get("dispatch_ms", 0.0)
+                                         / 1e3),
+                             sync_wait_s=sync_s)
+        return dt
+
+    @property
+    def steps_per_sec(self) -> float:
+        """Steady-state rate (warmup samples excluded when possible);
+        ``nan`` on zero-duration windows rather than dividing by zero."""
+        steady = [(n, t) for n, t, w in self.samples if not w]
+        samples = steady or [(n, t) for n, t, _ in self.samples]
+        steps = sum(n for n, _ in samples)
+        secs = sum(t for _, t in samples)
+        return steps / secs if secs > 0.0 else float("nan")
+
+    def reset(self) -> None:
+        self.samples.clear()
+
+
+# ------------------------------------------------------- reconstruction
+def _trace_records(run_dir) -> List[dict]:
+    """The run's event records filtered to the verbatim-preserved set.
+
+    The filter IS ``rollup.pin_record`` — the same predicate ``obs
+    compact`` pins by — so the reconstruction consumes exactly the
+    records that survive compaction whole, and its output is
+    byte-identical on a compacted dir vs the raw original by
+    construction (metric samples and plain spans, which compaction
+    folds to aggregates, never enter the timeline)."""
+    from hfrep_tpu.obs import report, rollup
+    return [r for r in report.load_events(run_dir) if rollup.pin_record(r)]
+
+
+def build_trace(run_dir, records: Optional[List[dict]] = None) -> str:
+    """Chrome-trace/perfetto JSON (trace-event format) for one run dir.
+
+    Spans become complete ("X") slices ending at their emit time,
+    events become instants ("i"), ``timeline_window`` records
+    additionally publish per-category counter ("C") tracks, and
+    ``memory`` snapshots a high-water counter.  Deterministic
+    serialization (sorted keys, fixed separators) so byte-equality is
+    a meaningful check, not a formatting accident."""
+    if records is None:
+        records = _trace_records(run_dir)
+    out: List[dict] = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": f"hfrep run {Path(run_dir).name}"}},
+    ]
+    for rec in records:
+        t_us = round(float(rec["t"]) * 1e6, 1)
+        attrs = {k: v for k, v in rec.items()
+                 if k not in ("v", "t", "type", "name", "dur", "depth")
+                 and v is not None}
+        if rec["type"] == "span":
+            dur_us = round(float(rec["dur"]) * 1e6, 1)
+            out.append({"ph": "X", "pid": 1,
+                        "tid": 1 + int(rec.get("depth") or 0),
+                        "name": str(rec["name"]),
+                        "ts": round(t_us - dur_us, 1), "dur": dur_us,
+                        "args": attrs})
+        elif rec["type"] == "event":
+            name = str(rec["name"])
+            out.append({"ph": "i", "pid": 1, "tid": 0, "name": name,
+                        "ts": t_us, "s": "p", "args": attrs})
+            if name == "timeline_window" and isinstance(
+                    rec.get("cat_ms"), dict):
+                wall = rec.get("wall_ms")
+                ts0 = (round(t_us - float(wall) * 1e3, 1)
+                       if isinstance(wall, (int, float)) else t_us)
+                out.append({"ph": "C", "pid": 1, "tid": 0,
+                            "name": f"ledger:{rec.get('drive')}",
+                            "ts": ts0, "args": {
+                                c: rec["cat_ms"].get(c, 0.0)
+                                for c in CATEGORIES}})
+        elif rec["type"] == "memory":
+            out.append({"ph": "C", "pid": 1, "tid": 0, "name": "memory",
+                        "ts": t_us,
+                        "args": {"high_water": rec.get("high_water")}})
+    doc = {"traceEvents": out, "displayTimeUnit": "ms"}
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n"
+
+
+def ledger_from_events(records: List[dict]) -> dict:
+    """Fold a run's ``timeline_window`` records into the whole-run
+    ledger.  Run time the windows do not cover — instrumentation gaps,
+    and the windows a SIGKILL's torn tail dropped — degrades into
+    ``uncovered_ms`` and a larger effective ``unattributed``: the books
+    still close, the verdict just says less.  Per-window conservation
+    is re-checked (``max_residual_ms``) so a writer drifting from the
+    invariant is caught at read time too."""
+    windows = [r for r in records
+               if r["type"] == "event" and r.get("name") == "timeline_window"
+               and isinstance(r.get("cat_ms"), dict)]
+    cats = {c: 0.0 for c in CATEGORIES}
+    wall_ms = 0.0
+    max_residual = 0.0
+    oversums = 0
+    o_host_ms = 0.0
+    o_sync_ms = 0.0
+    for w in windows:
+        cm = w["cat_ms"]
+        ww = float(w.get("wall_ms") or 0.0)
+        wall_ms += ww
+        total = 0.0
+        for c in CATEGORIES:
+            v = float(cm.get(c, 0.0) or 0.0)
+            cats[c] += v
+            total += v
+        max_residual = max(max_residual, abs(total - ww))
+        if w.get("oversum"):
+            oversums += 1
+        if not w.get("warmup") and isinstance(w.get("overlap_frac"),
+                                              (int, float)):
+            sync = max(0.0, ww * (1.0 - float(w["overlap_frac"])))
+            o_sync_ms += sync
+            o_host_ms += ww - sync
+    ts = [float(r["t"]) for r in records]
+    run_ms = (max(ts) - min(ts)) * 1e3 if ts else 0.0
+    uncovered_ms = max(0.0, run_ms - wall_ms)
+    denom = wall_ms + uncovered_ms
+    fracs = {c: (cats[c] / denom if denom > 0 else 0.0) for c in CATEGORIES}
+    fracs["unattributed"] = ((cats["unattributed"] + uncovered_ms) / denom
+                             if denom > 0 else 0.0)
+    return {
+        "windows": len(windows),
+        "wall_ms": round(wall_ms, 3),
+        "run_span_ms": round(run_ms, 3),
+        "uncovered_ms": round(uncovered_ms, 3),
+        "categories_ms": {c: round(v, 3) for c, v in cats.items()},
+        "fracs": {c: round(v, 6) for c, v in fracs.items()},
+        "overlap_frac": (round(o_host_ms / (o_host_ms + o_sync_ms), 6)
+                         if (o_host_ms + o_sync_ms) > 0 else None),
+        "oversum_windows": oversums,
+        "conservation": {
+            "max_residual_ms": round(max_residual, 3),
+            "ok": all(
+                abs(sum(float(w["cat_ms"].get(c, 0.0) or 0.0)
+                        for c in CATEGORIES) - float(w.get("wall_ms") or 0.0))
+                <= max(CONSERVATION_ABS_TOL_MS,
+                       float(w.get("wall_ms") or 0.0) * CONSERVATION_REL_TOL)
+                for w in windows),
+        },
+    }
+
+
+def render_ledger(doc: dict) -> str:
+    lines = [f"timeline ledger — {doc['windows']} window(s), "
+             f"{doc['wall_ms']:.1f} ms covered of "
+             f"{doc['run_span_ms']:.1f} ms run span "
+             f"({doc['uncovered_ms']:.1f} ms uncovered)"]
+    for c in CATEGORIES:
+        lines.append(f"  {c:16s} {doc['categories_ms'][c]:>12.1f} ms  "
+                     f"{doc['fracs'][c] * 100:6.2f}%")
+    ov = doc.get("overlap_frac")
+    lines.append("  overlap_frac     "
+                 + (f"{ov * 100:6.2f}%" if ov is not None else "     -"))
+    cons = doc["conservation"]
+    lines.append(f"  conservation     max residual {cons['max_residual_ms']}"
+                 f" ms — {'OK' if cons['ok'] else 'VIOLATED'}"
+                 + (f" ({doc['oversum_windows']} oversum window(s) clamped)"
+                    if doc["oversum_windows"] else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- CLI
+def timeline_main(run_dir, out: Optional[str] = None,
+                  fmt: str = "human") -> int:
+    from hfrep_tpu.obs import report
+    try:
+        records = _trace_records(run_dir)
+    except (OSError, report.SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if out:
+        trace = build_trace(run_dir, records)
+        tmp = Path(out).with_name(Path(out).name + ".tmp")
+        tmp.write_text(trace)
+        tmp.replace(out)
+        print(f"wrote {out} ({len(trace)} bytes, "
+              f"{len(records)} records)", file=sys.stderr)
+    doc = ledger_from_events(records)
+    if fmt == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(render_ledger(doc))
+    return 0 if doc["conservation"]["ok"] else 1
+
+
+# ------------------------------------------------------------ self-test
+def fixture_dir() -> Path:
+    """The committed timeline fixture: a run dir whose ledger was
+    computed by hand (the numbers in :func:`self_test` are typed in,
+    not derived), so writer and reader cannot drift together."""
+    from hfrep_tpu.obs import report
+    return report.fixture_dir() / "timeline"
+
+
+def _expect(cond: bool, msg: str) -> None:
+    if not cond:
+        from hfrep_tpu.obs.report import SchemaError
+        raise SchemaError(msg)
+
+
+def self_test() -> int:
+    """CI gate (tools/check.sh, env-stripped): the accumulator's
+    conservation algebra, the hand-computed fixture ledger, the
+    compaction byte-identity discipline, torn-tail degradation, and the
+    ``obs_self_frac`` < 1% ceiling.  Pure-JSON stdout, diagnostics to
+    stderr, 0/1."""
+    import shutil
+    import tempfile
+
+    from hfrep_tpu.obs import report, rollup
+    from hfrep_tpu.obs.report import SchemaError
+    try:
+        # -- accumulator algebra (no fixture, no jax): nested timed()
+        # books exclusive time; account() inside a frame moves, never
+        # duplicates; an un-booked stopwatch frame is transparent
+        reset()
+        with timed("host_io"):
+            time.sleep(0.002)
+            with timed("checkpoint"):
+                time.sleep(0.002)
+            account("queue_wait", 0.001)
+        snap = dict(_LEDGER.window)
+        total = sum(snap.values())
+        _expect(snap.get("checkpoint", 0.0) > 0
+                and snap.get("host_io", 0.0) > 0,
+                f"nested categories missing: {snap}")
+        _expect(snap["queue_wait"] == 0.001, "account() lost seconds")
+        outer_wall = snap["host_io"] + snap["checkpoint"] + snap["queue_wait"]
+        _expect(total <= outer_wall + 1e-9,
+                f"nesting double-counted: {snap}")
+        # oversum clamp: booked 3x the wall → flagged, Σ == wall exactly
+        # (booked inside the session — enable() resets the ledger)
+        from hfrep_tpu.obs import session
+        with tempfile.TemporaryDirectory() as td:
+            with session(Path(td) / "run", manifest=False,
+                         compile_listener=False):
+                account("host_io", 0.3)
+                w = flush_window(0.1, drive="selftest", sync_wait_s=0.0)
+            _expect(w is not None and w["oversum"],
+                    f"oversum not flagged: {w}")
+            _expect(abs(sum(w["cat_ms"].values()) - w["wall_ms"]) <= 0.01,
+                    f"clamped window does not conserve: {w}")
+            # the live window the session just wrote must satisfy the
+            # invariant end to end through the writer+reader pair
+            live = ledger_from_events(
+                report.load_events(Path(td) / "run", strict=True))
+            _expect(live["windows"] == 1 and live["conservation"]["ok"],
+                    f"live round-trip failed: {live}")
+
+        # -- the committed fixture, against HAND-COMPUTED numbers
+        fx = fixture_dir()
+        records = report.load_events(fx, strict=True)
+        doc = ledger_from_events(records)
+        # three 1000 ms windows (1 warmup + 2 steady); run spans
+        # t=100.0→103.1 s, so 100 ms of the run is outside any window
+        _expect(doc["windows"] == 3, f"fixture windows {doc['windows']}")
+        _expect(doc["wall_ms"] == 3000.0, f"wall {doc['wall_ms']}")
+        _expect(doc["run_span_ms"] == 3100.0 and doc["uncovered_ms"] == 100.0,
+                f"span {doc['run_span_ms']} uncovered {doc['uncovered_ms']}")
+        _expect(doc["categories_ms"]["device_compute"] == 1500.0,
+                f"device_compute {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["dispatch"] == 1000.0,
+                f"dispatch {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["checkpoint"] == 180.0,
+                f"checkpoint {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["host_io"] == 100.0,
+                f"host_io {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["queue_wait"] == 60.0,
+                f"queue_wait {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["obs_self"] == 17.0,
+                f"obs_self {doc['categories_ms']}")
+        _expect(doc["categories_ms"]["unattributed"] == 143.0,
+                f"unattributed {doc['categories_ms']}")
+        _expect(doc["conservation"]["ok"] and doc["oversum_windows"] == 0,
+                f"fixture conservation: {doc['conservation']}")
+        # overlap over the two STEADY windows only: walls 1000+1000 ms
+        # at overlap 0.3 and 0.4 → syncs 700+600, host 300+400 →
+        # 700 / (700 + 1300)
+        _expect(doc["overlap_frac"] == 0.35,
+                f"overlap {doc['overlap_frac']}")
+        obs_self_frac = doc["fracs"]["obs_self"]
+        _expect(obs_self_frac < OBS_SELF_FRAC_MAX,
+                f"obs_self_frac {obs_self_frac} >= {OBS_SELF_FRAC_MAX}")
+        _expect(doc["fracs"]["unattributed"] < 0.10,
+                f"unattributed_frac {doc['fracs']['unattributed']}")
+
+        # -- compaction equivalence: rotate + compact a COPY, byte-equal
+        raw = build_trace(fx)
+        with tempfile.TemporaryDirectory() as td:
+            # same basename as the fixture: compaction-in-place is the
+            # claim under test, not the run dir's name (which the trace
+            # embeds as its process_name)
+            cp = Path(td) / fx.name
+            shutil.copytree(fx, cp)
+            rollup.compact(cp, force_rotate=True)
+            compacted = build_trace(cp)
+            _expect(compacted == raw,
+                    "trace bytes differ on the compacted dir")
+            # -- torn tail: SIGKILL mid-write drops the final window;
+            # the ledger shrinks its covered set and grows unattributed
+            tp = Path(td) / "torn"
+            shutil.copytree(fx, tp)
+            text = (tp / "events.jsonl").read_text()
+            lines = text.splitlines(keepends=True)
+            (tp / "events.jsonl").write_text(
+                "".join(lines[:-2]) + lines[-2][: len(lines[-2]) // 2])
+            torn_doc = ledger_from_events(report.load_events(tp))
+            _expect(torn_doc["windows"] < doc["windows"],
+                    "torn tail did not drop a window")
+            _expect(torn_doc["conservation"]["ok"],
+                    "torn ledger violates conservation")
+            _expect(torn_doc["fracs"]["unattributed"]
+                    >= doc["fracs"]["unattributed"],
+                    "torn ledger did not degrade toward unattributed")
+    except (OSError, json.JSONDecodeError, SchemaError, KeyError) as e:
+        print(f"obs timeline self-test FAILED: {e}", file=sys.stderr)
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 1
+    finally:
+        reset()
+    print("obs timeline self-test OK", file=sys.stderr)
+    print(json.dumps({
+        "ok": True,
+        "fixture": {"windows": doc["windows"], "wall_ms": doc["wall_ms"],
+                    "obs_self_frac": obs_self_frac,
+                    "unattributed_frac": doc["fracs"]["unattributed"],
+                    "overlap_frac": doc["overlap_frac"]},
+        "compaction_byte_identical": True,
+        "torn_tail": {"windows": torn_doc["windows"],
+                      "unattributed_frac":
+                          torn_doc["fracs"]["unattributed"]},
+    }))
+    return 0

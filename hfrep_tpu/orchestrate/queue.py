@@ -62,6 +62,7 @@ from typing import Dict, List, NamedTuple, Optional
 import numpy as np
 
 from hfrep_tpu import resilience
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.utils import checkpoint as ckpt
 
 READY = "ready"
@@ -183,14 +184,14 @@ class SpoolQueue:
             _obs_event("queue_put", source=source, seq=seq, duplicate=True,
                        trace=trace)
             return False
-        t0 = time.perf_counter()
-        while self.depth() >= self.capacity:
-            if resilience.drain_requested():
-                raise resilience.Preempted(
-                    site="queue_put", reason="drain requested while blocked "
-                    f"on backpressure (capacity {self.capacity})")
-            time.sleep(self.poll)
-        waited = time.perf_counter() - t0
+        with timeline.timed("queue_wait") as tm:
+            while self.depth() >= self.capacity:
+                if resilience.drain_requested():
+                    raise resilience.Preempted(
+                        site="queue_put", reason="drain requested while "
+                        f"blocked on backpressure (capacity {self.capacity})")
+                time.sleep(self.poll)
+        waited = tm.s
 
         def writer(tmp: Path) -> None:
             np.savez(tmp / "payload.npz", **arrays)

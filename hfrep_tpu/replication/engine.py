@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import time
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -553,17 +552,22 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
     # attribution adds zero sync points and cannot perturb the chunk
     # economics.  The first chunk is a warmup window (its dispatch
     # carries the XLA compile) and is discarded, like the trainer's.
-    from hfrep_tpu.obs import attrib, get_obs
+    from hfrep_tpu.obs import attrib, get_obs, timeline
     attrib_on = get_obs().enabled
     calls_here = 0          # dispatches THIS drive issued (≠ ``chunks``,
     #                         which a snapshot resume restores: the first
     #                         post-resume dispatch pays the fresh
     #                         process's XLA compile and must be discarded
     #                         as warmup even at chunks > 1)
+    # the wall-clock ledger's window runs boundary→boundary (opening at
+    # drive start), unlike attrib's dispatch-anchored wall: snapshot
+    # saves and chunk bookkeeping between boundaries then land inside
+    # the NEXT window instead of leaking into uncovered run span
+    t_window0 = timeline.clock()
     try:
         while pos < epochs and not stopped_all:
             length = min(chunk, epochs - pos)
-            t_chunk0 = time.perf_counter() if attrib_on else 0.0
+            t_chunk0 = timeline.clock() if attrib_on else 0.0
             with attrib.dispatch_timer("ae_chunk") if attrib_on \
                     else contextlib.nullcontext():
                 carry, tr = chunk_fn(carry, keys[..., pos:pos + length, :])
@@ -575,12 +579,24 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
             # health on, the boundary's health scalars ride the SAME sync
             # (and may raise NumericFault under abort_on_nonfinite)
             if pos < epochs:
+                t_sync0 = timeline.clock()
                 stopped_all = _boundary_sync(carry, tr, pos, snapshot)
                 if attrib_on:
-                    attrib.flush_window(time.perf_counter() - t_chunk0,
-                                        steps=length,
-                                        warmup=(calls_here == 1),
-                                        epoch=pos)
+                    now = timeline.clock()
+                    warm = calls_here == 1
+                    # read the dispatch seconds before attrib's flush
+                    # takes the window (a warmup flush discards them,
+                    # but the ledger still owes that time to a category)
+                    with attrib._WINDOW.lock:
+                        disp_s = sum(attrib._WINDOW.dispatch_s.values())
+                    attrib.flush_window(now - t_chunk0, steps=length,
+                                        warmup=warm, epoch=pos)
+                    timeline.flush_window(now - t_window0, drive="ae_chunk",
+                                          steps=length, warmup=warm,
+                                          dispatch_s=disp_s,
+                                          sync_wait_s=now - t_sync0,
+                                          epoch=pos)
+                    t_window0 = now
             if snapshot is not None:
                 try:
                     snapshot.save(carry, _concat_traces(traces), pos,

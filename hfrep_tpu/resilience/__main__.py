@@ -21,7 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
+from hfrep_tpu.obs import timeline
 from typing import List, Optional
 
 
@@ -63,7 +63,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.cmd == "selftest":
         from hfrep_tpu.resilience.selftest import run_selftest
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         try:
             doc = run_selftest()
         except Exception as e:
@@ -71,7 +71,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "error": f"{type(e).__name__}: {e}"}))
             return 1
         doc["selftest"] = "ok"
-        doc["secs"] = round(time.perf_counter() - t0, 2)
+        doc["secs"] = round(timeline.clock() - t0, 2)
         print(json.dumps(doc))
         return 0
 

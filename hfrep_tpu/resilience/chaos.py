@@ -56,6 +56,7 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.resilience import faults
 from hfrep_tpu.resilience.chaos_oracles import (
     Attempt,
@@ -255,7 +256,7 @@ class Driver:
                "--fixture-seed", str(fixture_seed)]
         if resume:
             cmd.append("--resume")
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         try:
             proc = subprocess.run(
                 cmd, env=env, capture_output=True, text=True,
@@ -266,7 +267,7 @@ class Driver:
             code = None
             stderr = (e.stderr or b"").decode(errors="replace") \
                 if isinstance(e.stderr, bytes) else (e.stderr or "")
-        secs = time.perf_counter() - t0
+        secs = timeline.clock() - t0
         self._runs += 1
         self._run_secs += secs
         return Attempt(spec=spec, exit_code=code, secs=secs,
@@ -325,7 +326,7 @@ class Driver:
         # schedule meant to fault; reference dirs may be reused — their
         # fingerprint-gated reuse is bit-identical by construction)
         out = self.workdir / f"r{os.getpid():x}_{tag}_{self._runs:04d}"
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         attempts = self._drive(sched, out)
         violations = check_run(
             deterministic=subject.deterministic,
@@ -333,7 +334,7 @@ class Driver:
             result_doc=_read_result(out))
         return Report(schedule=sched, attempts=attempts,
                       violations=violations,
-                      secs=time.perf_counter() - t0)
+                      secs=timeline.clock() - t0)
 
     @property
     def stats(self) -> dict:

@@ -231,14 +231,12 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
     artifacts bit-identical to an uninterrupted run, pinned; foreign
     state refuses).  ``resume`` is accepted for CLI symmetry; reuse is
     fingerprint-gated either way."""
-    import time
-
     import jax
     import jax.numpy as jnp
 
     from hfrep_tpu import resilience
     from hfrep_tpu.models.autoencoder import latent_mask
-    from hfrep_tpu.obs import get_obs
+    from hfrep_tpu.obs import get_obs, timeline
     from hfrep_tpu.utils import checkpoint as ckpt
 
     latent_dims = [int(d) for d in latent_dims]
@@ -266,7 +264,7 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
     # safe to reuse (bit-identical by construction), foreign state is
     # always refused.
     key = key if key is not None else jax.random.PRNGKey(cfg.seed)
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     grid = _load_grid(resume_root / TRAINED_GRID, fingerprint)
     stats = None
     if grid is None:
@@ -286,7 +284,7 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
                       path=str(resume_root / TRAINED_GRID), error=str(e))
             print(f"warning: trained grid not persisted ({e}); an "
                   "eval-phase kill will retrain", file=sys.stderr)
-    train_secs = time.perf_counter() - t0
+    train_secs = timeline.clock() - t0
 
     masks = jnp.stack([latent_mask(d, cfg.latent_dim)
                        for d in latent_dims])
@@ -297,11 +295,20 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
     surface_post = np.empty((spec.n_windows, len(latent_dims), y.shape[1]),
                             np.float32)
     surface_ante = np.empty_like(surface_post)
-    t1 = time.perf_counter()
+    t1 = timeline.clock()
+    # ledger windows run boundary→boundary across the eval loop: each
+    # walk-forward window's dispatch, score device_get (the sync the
+    # loop already pays) and atomic publish land in ONE flushed window;
+    # resumed windows flush too (pure host_io + verify), just without a
+    # sync to split against
+    t_w0 = t1
+    eval_compiled = False
     with resilience.graceful_drain():
         for w in range(spec.n_windows):
             name = f"w_{w:04d}"
             dst = windows_dir / name
+            win_sync = None
+            win_warm = False
             meta = None
             if (dst / ckpt.META_NAME).exists():
                 try:
@@ -318,15 +325,20 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
                 e = spec.train_rows(w)
                 params_w = jax.tree_util.tree_map(lambda a, d=w: a[d],
                                                   grid.params)
-                sa, sp = eval_fn(
-                    params_w, masks,
-                    jnp.asarray(x[e:e + horizon]),
-                    jnp.asarray(y[e:e + horizon]),
-                    jnp.asarray(rf[e:e + horizon]),
-                    jnp.asarray(x[e + horizon - (p_months + ols):
-                                  e + horizon]))
+                with timeline.timed("dispatch"):
+                    sa, sp = eval_fn(
+                        params_w, masks,
+                        jnp.asarray(x[e:e + horizon]),
+                        jnp.asarray(y[e:e + horizon]),
+                        jnp.asarray(rf[e:e + horizon]),
+                        jnp.asarray(x[e + horizon - (p_months + ols):
+                                      e + horizon]))
+                t_s0 = timeline.clock()
                 sa = np.asarray(jax.device_get(sa), np.float32)
                 sp = np.asarray(jax.device_get(sp), np.float32)
+                win_sync = timeline.clock() - t_s0
+                win_warm = not eval_compiled    # first eval pays compile
+                eval_compiled = True
 
                 def writer(tmp: Path, a=sa, p=sp, d=w) -> None:
                     np.savez(tmp / "scores.npz", sharpe_ante=a,
@@ -350,8 +362,13 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
             digests[name] = meta["checksum"]["digest"]
             # the window boundary: a requested drain exits here with
             # every published score intact (resume recomputes the gap)
+            now = timeline.clock()
+            timeline.flush_window(now - t_w0, drive="walkforward",
+                                  steps=len(latent_dims), warmup=win_warm,
+                                  sync_wait_s=win_sync, window=w)
+            t_w0 = now
             resilience.boundary("window")
-    eval_secs = time.perf_counter() - t1
+    eval_secs = timeline.clock() - t1
 
     manifest = _assemble(out, spec, cfg, latent_dims, digests,
                          surface_post, surface_ante)

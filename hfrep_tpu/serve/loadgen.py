@@ -17,12 +17,12 @@ figure a client experiences and the one the sentinel tracks as
 
 from __future__ import annotations
 
-import time
 from concurrent.futures import Future, wait
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.serve.admission import (
     DeadlineExceeded,
     Draining,
@@ -119,7 +119,7 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
     """
     futures: List[Future] = []
     trace_ids: List[str] = []
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     submitted = 0
     try:
         while submitted < total:
@@ -143,7 +143,13 @@ def drive_load(server, total: int, panels: Sequence[np.ndarray], *,
                 on_wave(submitted)
     finally:
         wait(futures)
-        wall = time.perf_counter() - t0
+        wall = timeline.clock() - t0
+    # one ledger window for the whole offered load: the worker threads
+    # booked queue_wait/dispatch/device_compute into the shared ledger as
+    # they served it, so the flush here closes the drive's books against
+    # the load's wall (parallel workers can legitimately oversum — the
+    # flush clamps and flags that)
+    timeline.flush_window(wall, drive="serve_load", steps=submitted)
     doc = classify(futures)
     if trace_prefix is not None:
         doc["trace_ids"] = trace_ids

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hfrep_tpu import resilience
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.serve import aot
 from hfrep_tpu.serve.admission import (
     OPEN,
@@ -396,9 +397,14 @@ class ReplicationServer:
             with self._lock:
                 if not self._running:
                     return
-            batch = self.batcher.next_batch(timeout=0.05)
+            # measure the batch wait unconditionally, book it only when a
+            # batch actually arrived: an idle worker's empty polls are
+            # not queue_wait in any drive's ledger window
+            with timeline.timed(None) as tm_wait:
+                batch = self.batcher.next_batch(timeout=0.05)
             if not batch:
                 continue
+            timeline.account("queue_wait", tm_wait.s)
             with self._lock:
                 self._in_flight += len(batch)
             # the injected-chaos hook: a ``kill@serve_worker`` directive
@@ -456,10 +462,13 @@ class ReplicationServer:
                        max_wait_ms=round(
                            (t_disp - min(r.arrival for r in batch)) * 1e3, 3))
         try:
-            if kind == "replicate":
-                values = self._run_replicate(batch)
-            else:
-                values = self._run_sample(batch)
+            with timeline.timed("dispatch"):
+                # the run helpers note their device_get separately, so
+                # this frame's exclusive remainder is pure host dispatch
+                if kind == "replicate":
+                    values = self._run_replicate(batch)
+                else:
+                    values = self._run_sample(batch)
         except Exception as e:           # compile/execute failure of the batch
             self.breaker.record_failure(cause=type(e).__name__)
             for r in batch:
@@ -577,7 +586,9 @@ class ReplicationServer:
         mask = self._ae_mask()
         fn = self._replicate_program(bsz, rows)
         recon, err = fn(model.params, x, n_rows, mask)
+        t_s = timeline.clock()
         recon, err, rows_h = jax.device_get((recon, err, n_rows))
+        timeline.note_sync(timeline.clock() - t_s)
         return [{"reconstruction": np.asarray(recon[i][: int(rows_h[i])]),
                  "recon_mse": float(err[i]),
                  "weights": model.decoder_host}
@@ -608,7 +619,9 @@ class ReplicationServer:
             key = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed),
                                      next(self._dispatch_seq))
             noise = jax.random.normal(key, (bucket, w, f))
+            t_s = timeline.clock()
             windows = np.asarray(jax.device_get(fn(model.params, noise)))
+            timeline.note_sync(timeline.clock() - t_s)
             off = 0
             for r in chunk:
                 n = int(r.payload)

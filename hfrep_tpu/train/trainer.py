@@ -23,9 +23,10 @@ from hfrep_tpu.models.registry import build_gan
 from hfrep_tpu.obs import get_obs, mesh_attrs
 from hfrep_tpu.train.states import GanState, init_gan_state
 from hfrep_tpu.train.steps import make_multi_step
+from hfrep_tpu.obs import timeline
+from hfrep_tpu.obs.metriclog import MetricLogger
+from hfrep_tpu.obs.timeline import BlockTimer
 from hfrep_tpu.utils import checkpoint as ckpt
-from hfrep_tpu.utils.logging import MetricLogger
-from hfrep_tpu.utils.profiling import StepTimer
 
 
 class GanTrainer:
@@ -91,7 +92,9 @@ class GanTrainer:
                 steps_per_call=cfg.train.steps_per_call)
         style = {"bce": "gan", "wgan_clip": "wgan", "wgan_gp": "wgan_gp"}[self.pair.loss]
         self.logger = logger or MetricLogger(echo=False, echo_style=style)
-        self.timer = StepTimer()
+        # block-boundary timing + the wall-clock ledger: every stop() is
+        # a timeline window flush at the sync the loop already pays
+        self.timer = BlockTimer(drive="gan_block")
         self.epoch = 0
         #: per-epoch metric history (host numpy), kept even with a null logger
         self.history: list[dict] = []
@@ -178,7 +181,7 @@ class GanTrainer:
         pipeline_ok = False
         try:
             while done < n_full:
-                self.key, sub = jax.random.split(self.key)
+                self.key, sub = self._next_key()
                 warm_block = not self._multi_warm
                 if warm_block or self.nan_guard:
                     close_steady()
@@ -236,7 +239,7 @@ class GanTrainer:
         done = 0
         while done < remainder:
             # exact epoch counts: leftover epochs run on a cached 1-epoch step
-            self.key, sub = jax.random.split(self.key)
+            self.key, sub = self._next_key()
             self.timer.start()
             metrics = self._guarded(self._one, sub)
             if metrics is None:
@@ -257,6 +260,19 @@ class GanTrainer:
                 self._drain_now()
         self.logger.flush()
         return self.state
+
+    def _next_key(self):
+        """Split + materialize the block's PRNG keys under the ledger.
+
+        The unpack blocks on the split's device computation, and on a
+        synchronous backend the runtime may park the host HERE while the
+        execution stream drains — host time feeding the dispatch chain
+        either way, so it books as ``dispatch`` (exclusive time: µs on
+        an async backend, the migrated stream-wait on a blocking one).
+        """
+        with timeline.timed("dispatch"):
+            key, sub = jax.random.split(self.key)
+        return key, sub
 
     def _drain_now(self) -> None:
         """Graceful preemption at a block boundary: persist a final
@@ -317,17 +333,29 @@ class GanTrainer:
                 self._single_step = make_gan_train_step(
                     self.pair, self.cfg.train, self.windows, self.mesh)
             else:
+                from hfrep_tpu.obs import instrument_step
                 from hfrep_tpu.train.steps import make_train_step
                 # donate the state like the multi-step does: the remainder
                 # epochs rebind `self.state` from the return value, so the
-                # input buffers are dead the moment the call is issued
-                self._single_step = jax.jit(
-                    make_train_step(self.pair, self.cfg.train, self.windows),
-                    donate_argnums=(0,))
+                # input buffers are dead the moment the call is issued;
+                # instrumented like the multi-step so the remainder's
+                # compile + dispatches land in the same ledger/attrib plane
+                self._single_step = instrument_step(
+                    jax.jit(
+                        make_train_step(self.pair, self.cfg.train,
+                                        self.windows),
+                        donate_argnums=(0,)),
+                    "single_step", batch=self.cfg.train.batch_size)
         return self._single_step(state, key)
 
     def _log_block(self, metrics: dict, n: int, base_epoch: int) -> None:
+        # the metrics fetch is where the pipelined host blocks on the
+        # previous block's device work — ledger it as device_compute so
+        # the steady windows' wall clock stays attributed (pure
+        # accumulator arithmetic when telemetry is off: no new syncs)
+        t0 = timeline.clock()
         host = jax.device_get(metrics)
+        timeline.note_sync(timeline.clock() - t0)
         for i in range(n):
             e = base_epoch + i
             rec = {k: v[i] for k, v in host.items()}

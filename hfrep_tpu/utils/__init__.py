@@ -1,4 +1,1 @@
-
 from __future__ import annotations
-from hfrep_tpu.utils.logging import MetricLogger  # noqa: F401
-from hfrep_tpu.utils.profiling import StepTimer  # noqa: F401

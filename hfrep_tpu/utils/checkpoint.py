@@ -48,6 +48,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax
 
 from hfrep_tpu import resilience
+from hfrep_tpu.obs import timeline
 
 META_NAME = "meta.json"
 
@@ -202,14 +203,20 @@ def write_atomic(path, writer: Callable[[Path], Optional[dict]],
         (tmp / META_NAME).write_text(json.dumps(meta, indent=2, default=str))
         _atomic_publish(tmp, dst, keep_prev=keep_prev)
 
-    try:
-        if retry:
-            resilience.retry_io(_write, what=io_site)
-        else:
-            _write()
-    finally:
-        shutil.rmtree(tmp, ignore_errors=True)
-    resilience.post_save(fault_site, dst)
+    # the wall-clock ledger's categorization rides the fault_site the
+    # callers already declare: checkpoint/snapshot publication is
+    # "checkpoint" time, everything else (queue items, spooled
+    # artifacts) is generic "host_io"
+    with timeline.timed("checkpoint" if fault_site in ("ckpt", "snapshot")
+                        else "host_io"):
+        try:
+            if retry:
+                resilience.retry_io(_write, what=io_site)
+            else:
+                _write()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        resilience.post_save(fault_site, dst)
     return dst
 
 
@@ -264,7 +271,10 @@ def save(path: str, pytree: Any, metadata: Optional[dict] = None,
     sharing this one's numbered naming scheme (``ckpt_<epoch>``).
     """
     p = Path(path).absolute()
-    pytree = jax.device_get(pytree)
+    with timeline.timed("checkpoint"):
+        # the device→host fetch is part of the checkpoint's bill, not a
+        # training sync — booked with the write it feeds
+        pytree = jax.device_get(pytree)
     if os.environ.get("HFREP_CKPT_FORMAT", "").lower() == "msgpack":
         coordination_free = True
 

@@ -1480,6 +1480,64 @@ class TestMeshLaunchDiscipline:
         assert fs == []
 
 
+# ------------------------------------------------------------------ HF009
+class TestWallClockMonopoly:
+    def test_positive_perf_counter(self):
+        fs = run_hf("""
+            import time
+            def bench(f):
+                t0 = time.perf_counter()
+                f()
+                return time.perf_counter() - t0
+            """, "HF009", relpath="hfrep_tpu/train/custom.py")
+        assert codes(fs) == ["HF009"] * 2
+        assert "timeline.clock()" in fs[0].message
+
+    def test_positive_time_time_and_import_alias(self):
+        fs = run_hf("""
+            import time as t
+            def stamp():
+                return t.time()
+            """, "HF009", relpath="tools/bench_custom.py")
+        assert codes(fs) == ["HF009"]
+
+    def test_positive_from_import_alias(self):
+        fs = run_hf("""
+            from time import perf_counter as pc
+            def bench():
+                return pc()
+            """, "HF009", relpath="hfrep_tpu/serve/custom.py")
+        assert codes(fs) == ["HF009"]
+
+    def test_negative_monotonic_stays_legal(self):
+        # time.monotonic is the injectable *scheduling* clock (serve
+        # admission deadlines) — not a measured duration, not banned
+        assert run_hf("""
+            import time
+            def deadline(budget):
+                return time.monotonic() + budget
+            """, "HF009", relpath="hfrep_tpu/serve/custom.py") == []
+
+    def test_negative_ledger_home_and_tests_exempt(self):
+        src = """
+            import time
+            def clock():
+                return time.perf_counter()
+            """
+        assert run_hf(src, "HF009",
+                      relpath="hfrep_tpu/obs/timeline.py") == []
+        assert run_hf(src, "HF009",
+                      relpath="tests/test_x_fixture.py") == []
+
+    def test_noqa_suppresses(self):
+        fs = run_hf("""
+            import time
+            def stamp():
+                return time.time()  # noqa: HF009
+            """, "HF009", relpath="hfrep_tpu/train/custom.py")
+        assert fs == []
+
+
 # -------------------------------------------- review-hardening regressions
 class TestReviewHardening:
     def test_hf005_not_hasattr_polarity(self):

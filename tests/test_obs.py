@@ -564,9 +564,9 @@ def test_report_rejects_malformed_events(tmp_path):
         report_mod.load_events(tmp_path)
 
 
-# --------------------------------------------------- compatibility shims
+# ------------------------------------------- metric log + block timing
 def test_metric_logger_context_manager_and_idempotent_close(tmp_path):
-    from hfrep_tpu.utils.logging import MetricLogger
+    from hfrep_tpu.obs.metriclog import MetricLogger
     path = tmp_path / "m.jsonl"
     with pytest.raises(RuntimeError):
         with MetricLogger(str(path)) as ml:
@@ -580,7 +580,7 @@ def test_metric_logger_context_manager_and_idempotent_close(tmp_path):
 
 
 def test_metric_logger_forwards_to_obs(tmp_path):
-    from hfrep_tpu.utils.logging import MetricLogger
+    from hfrep_tpu.obs.metriclog import MetricLogger
     obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
     with MetricLogger(str(tmp_path / "m.jsonl")) as ml:
         ml.log(7, {"d_loss": 0.5, "g_loss": 0.25})
@@ -592,9 +592,9 @@ def test_metric_logger_forwards_to_obs(tmp_path):
     assert gauges["train/d_loss"]["step"] == 7
 
 
-def test_step_timer_zero_duration_returns_nan():
-    from hfrep_tpu.utils.profiling import StepTimer
-    t = StepTimer()
+def test_block_timer_zero_duration_returns_nan():
+    from hfrep_tpu.obs.timeline import BlockTimer
+    t = BlockTimer()
     # only warmup samples, all at perf_counter resolution zero (the very
     # fast CPU-test regime): rate is undefined, must be nan not a crash
     t.samples.append((1, 0.0, True))
@@ -606,10 +606,10 @@ def test_step_timer_zero_duration_returns_nan():
     assert t.steps_per_sec == pytest.approx(5.0)
 
 
-def test_step_timer_emits_block_spans_when_enabled(tmp_path):
-    from hfrep_tpu.utils.profiling import StepTimer
+def test_block_timer_emits_block_spans_when_enabled(tmp_path):
+    from hfrep_tpu.obs.timeline import BlockTimer
     obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
-    t = StepTimer()
+    t = BlockTimer()
     t.start()
     t.stop(5, sync_on=jnp.ones(3), warmup=True)
     t.start()

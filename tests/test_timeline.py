@@ -1,0 +1,250 @@
+"""hfrep_tpu.obs.timeline: the wall-clock ledger (ISSUE 18) — the
+conservation invariant Σ(cat_ms) == wall_ms on every emitted window,
+exclusive-time nesting, oversum clamping, BlockTimer's synced boundary,
+perfetto reconstruction byte-identity across rotate+compact, torn-tail
+(SIGKILL) degradation, and the acceptance pin: trajectories bit-identical
+with the ledger on vs off."""
+
+import json
+import shutil
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.config import ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.obs import report as report_mod
+from hfrep_tpu.obs import rollup, timeline
+from hfrep_tpu.train.trainer import GanTrainer
+
+MCFG = ModelConfig(family="gan", features=5, window=8, hidden=8)
+TCFG = TrainConfig(epochs=3, batch_size=4, n_critic=2, steps_per_call=2,
+                   log_every=1)
+
+
+@pytest.fixture(autouse=True)
+def _ledger_reset():
+    """No test may leak an enabled sink or a half-filled ledger window
+    into the rest of the suite."""
+    obs_pkg.disable()
+    timeline.reset()
+    yield
+    obs_pkg.disable()
+    timeline.reset()
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    g = np.random.default_rng(7)
+    return jnp.asarray(g.uniform(0, 1, (32, 8, 5)).astype(np.float32))
+
+
+def _events(run_dir):
+    return report_mod.load_events(run_dir)
+
+
+def _windows(run_dir):
+    return [e for e in _events(run_dir)
+            if e["type"] == "event" and e["name"] == "timeline_window"]
+
+
+def _gauges(run_dir):
+    return {e["name"]: e["value"] for e in _events(run_dir)
+            if e["type"] == "metric" and e["kind"] == "gauge"}
+
+
+# ----------------------------------------------------- the accumulator
+def test_account_and_flush_conserve_exactly(tmp_path):
+    """The emitted window's own numbers satisfy Σ(cat_ms) == wall_ms
+    after rounding — conservation holds on the record, not just in
+    floating point before serialization."""
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    timeline.account("host_io", 0.120)
+    timeline.account("checkpoint", 0.0456789)
+    timeline.note_sync(0.200)
+    out = timeline.flush_window(0.5, drive="t1", steps=7)
+    obs_pkg.disable()
+
+    assert out is not None and not out["oversum"]
+    assert abs(sum(out["cat_ms"].values()) - out["wall_ms"]) < 1e-9
+    (w,) = _windows(tmp_path / "run")
+    assert w["drive"] == "t1" and w["steps"] == 7
+    assert set(w["cat_ms"]) == set(timeline.CATEGORIES)
+    assert abs(sum(w["cat_ms"].values()) - w["wall_ms"]) < 1e-9
+    assert w["cat_ms"]["device_compute"] == 200.0
+    assert w["cat_ms"]["unattributed"] >= 0.0
+
+
+def test_oversum_is_clamped_and_flagged(tmp_path):
+    """Booking 3x the wall (parallel serve workers can legitimately do
+    this) never breaks the invariant: categories scale down
+    proportionally and the window carries oversum=True."""
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    timeline.account("host_io", 0.2)
+    timeline.account("queue_wait", 0.1)
+    out = timeline.flush_window(0.1, drive="t2")
+    obs_pkg.disable()
+
+    assert out["oversum"]
+    assert abs(sum(out["cat_ms"].values()) - out["wall_ms"]) < 1e-9
+    # proportional: host_io booked 2x queue_wait, stays 2x after clamp
+    assert abs(out["cat_ms"]["host_io"]
+               - 2 * out["cat_ms"]["queue_wait"]) < 0.01
+    (w,) = _windows(tmp_path / "run")
+    assert w["oversum"] is True
+
+
+def test_timed_nesting_books_exclusive_time(tmp_path):
+    """A timed block wrapping an account() books only its exclusive
+    remainder — the moved seconds appear once, under the inner
+    category, so nesting can never double-count."""
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    with timeline.timed("host_io") as tm:
+        timeline.account("checkpoint", 0.25)
+    out = timeline.flush_window(max(0.5, tm.s + 0.3), drive="t3")
+    obs_pkg.disable()
+
+    assert out["cat_ms"]["checkpoint"] == 250.0
+    # the outer frame's exclusive time is the (tiny) real elapsed wall,
+    # not 250 ms + elapsed
+    assert out["cat_ms"]["host_io"] < 200.0
+
+
+def test_timed_none_measures_without_booking(tmp_path):
+    """timed(None) is a pure measurement (the serve worker's idle-poll
+    guard): nothing lands in the ledger, but child bookings inside it
+    still move out of any enclosing frame."""
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    timeline._LEDGER.take()     # drop enable()'s own obs_self booking
+    with timeline.timed(None) as tm:
+        pass
+    assert tm.s >= 0.0
+    with timeline._LEDGER.lock:
+        assert timeline._LEDGER.window == {}
+    obs_pkg.disable()
+
+
+def test_flush_window_disabled_discards(tmp_path):
+    """With telemetry off the window is taken and dropped — no events,
+    no carry-over into a later enabled run."""
+    timeline.account("host_io", 0.3)
+    assert timeline.flush_window(0.5, drive="off") is None
+    with timeline._LEDGER.lock:
+        assert timeline._LEDGER.window == {}
+
+
+def test_blocktimer_flushes_synced_ledger_window(tmp_path):
+    """BlockTimer.stop at a synced boundary emits a timeline_window for
+    its drive (warmup flagged on the compile block), the cumulative
+    timeline/* gauges, and overlap_frac over the steady windows only."""
+    obs_pkg.enable(tmp_path / "run", manifest=False, compile_listener=False)
+    x = jnp.ones((4, 4))
+    bt = timeline.BlockTimer(drive="t_block")
+    bt.start()
+    y = x * 2
+    bt.stop(2, sync_on=y, warmup=True)
+    bt.start()
+    y = x * 3
+    bt.stop(2, sync_on=y)
+    obs_pkg.disable()
+
+    ws = _windows(tmp_path / "run")
+    assert [w["warmup"] for w in ws] == [True, False]
+    assert all(w["drive"] == "t_block" for w in ws)
+    for w in ws:
+        assert abs(sum(w["cat_ms"].values()) - w["wall_ms"]) < 1e-9
+    g = _gauges(tmp_path / "run")
+    assert g["timeline/wall_ms"] > 0.0
+    assert 0.0 <= g["timeline/overlap_frac"] <= 1.0
+    assert abs(sum(g[f"timeline/{c}_frac"]
+                   for c in timeline.CATEGORIES) - 1.0) < 0.01
+
+
+# -------------------------------------------------------- reconstruction
+def test_fixture_ledger_hand_computed_values():
+    """The committed fixture against numbers typed in by hand (the
+    self-test's anchor) — writer and reader cannot drift together."""
+    doc = timeline.ledger_from_events(
+        report_mod.load_events(timeline.fixture_dir(), strict=True))
+    assert doc["windows"] == 3
+    assert doc["wall_ms"] == 3000.0
+    assert doc["run_span_ms"] == 3100.0 and doc["uncovered_ms"] == 100.0
+    assert doc["overlap_frac"] == 0.35
+    assert doc["fracs"]["obs_self"] < timeline.OBS_SELF_FRAC_MAX
+    assert doc["fracs"]["unattributed"] < 0.10
+    assert doc["conservation"]["ok"]
+
+
+def test_trace_byte_identical_after_rotate_and_compact(tmp_path):
+    """obs compact folds metrics/spans to aggregates but pins the
+    records the timeline consumes verbatim — the perfetto trace of a
+    rotated+compacted run dir is byte-identical to the raw one."""
+    fx = timeline.fixture_dir()
+    raw = timeline.build_trace(fx)
+    # same basename: the trace embeds the dir name as its process_name,
+    # and compaction-in-place is the claim under test
+    cp = tmp_path / fx.name
+    shutil.copytree(fx, cp)
+    rollup.compact(cp, force_rotate=True)
+    assert timeline.build_trace(cp) == raw
+
+
+def test_torn_tail_degrades_into_unattributed(tmp_path):
+    """A SIGKILL mid-write (simulated: drop the final records and tear
+    the last surviving line in half) loses windows, never the books:
+    the ledger still conserves, with the gap degrading into a larger
+    unattributed fraction."""
+    fx = timeline.fixture_dir()
+    full = timeline.ledger_from_events(report_mod.load_events(fx))
+    tp = tmp_path / "torn"
+    shutil.copytree(fx, tp)
+    lines = (tp / "events.jsonl").read_text().splitlines(keepends=True)
+    (tp / "events.jsonl").write_text(
+        "".join(lines[:-2]) + lines[-2][: len(lines[-2]) // 2])
+
+    torn = timeline.ledger_from_events(report_mod.load_events(tp))
+    assert torn["windows"] < full["windows"]
+    assert torn["conservation"]["ok"]
+    assert torn["fracs"]["unattributed"] >= full["fracs"]["unattributed"]
+
+
+def test_timeline_cli_writes_trace_and_ledger(tmp_path, capsys):
+    """`obs timeline RUN_DIR --out trace.json` exits 0 on the fixture,
+    writes parseable trace-event JSON, and prints the rendered ledger
+    with a conservation verdict."""
+    out = tmp_path / "trace.json"
+    rc = timeline.timeline_main(timeline.fixture_dir(), out=str(out))
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert {"M", "i", "C"} <= phases
+    captured = capsys.readouterr()
+    assert "conservation" in captured.out and "OK" in captured.out
+
+
+# ----------------------------------------------------------- acceptance
+def test_gan_trajectory_bit_identical_ledger_on_vs_off(tmp_path, dataset):
+    """The ledger adds zero device syncs and never touches the compiled
+    programs: fp32 training with the full instrumentation live is
+    BIT-identical — history and final generator parameters — to a run
+    with telemetry off."""
+    cfg = ExperimentConfig(model=MCFG, train=TCFG)
+
+    tr_off = GanTrainer(cfg, dataset)
+    tr_off.train(epochs=3)
+
+    obs_pkg.enable(tmp_path / "run")
+    tr_on = GanTrainer(cfg, dataset)
+    tr_on.train(epochs=3)
+    obs_pkg.disable()
+
+    assert tr_off.history == tr_on.history
+    off_leaves = jax.tree_util.tree_leaves(tr_off.state.g_params)
+    on_leaves = jax.tree_util.tree_leaves(tr_on.state.g_params)
+    for a, b in zip(off_leaves, on_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the instrumented run actually produced ledger windows
+    assert _windows(tmp_path / "run")

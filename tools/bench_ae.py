@@ -45,7 +45,6 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 if __name__ == "__main__":                     # `python tools/bench_ae.py`
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -55,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 import hfrep_tpu.obs as obs_pkg
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core import scaler as mm
@@ -90,9 +90,9 @@ def time_monolithic(key, xs, cfg, latent_dims, repeats: int = 1) -> float:
     _block(fn(key).params)                        # compile + warm
     best = float("inf")
     for _ in range(max(1, repeats)):
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         _block(fn(key).params)
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, timeline.clock() - t0)
     return best
 
 
@@ -105,10 +105,10 @@ def time_chunked(key, xs, cfg, latent_dims, repeats: int = 1):
     ae.sweep_autoencoders_chunked(key, xs, cfg, latent_dims)
     best = float("inf")
     for _ in range(max(1, repeats)):
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         res, stats = ae.sweep_autoencoders_chunked(key, xs, cfg, latent_dims)
         _block(res.params)
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, timeline.clock() - t0)
     return best, res, stats
 
 
@@ -116,22 +116,22 @@ def time_multi(key, x_stack, n_rows, cfg, latent_dims):
     """Batched (one (K+1)xL-lane program) vs serial (per-dataset padded
     sweeps) wall clock for the cross-dataset fabric."""
     ae.sweep_autoencoders_multi(key, x_stack, n_rows, cfg, latent_dims)
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     res, stats = ae.sweep_autoencoders_multi(key, x_stack, n_rows, cfg,
                                              latent_dims)
     _block(res.params)
-    batched = time.perf_counter() - t0
+    batched = timeline.clock() - t0
 
     dkeys = jax.random.split(key, x_stack.shape[0])
     for d in range(x_stack.shape[0]):             # warm the serial unit
         ae.sweep_autoencoders_padded(dkeys[d], x_stack[d], n_rows[d], cfg,
                                      latent_dims)
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for d in range(x_stack.shape[0]):
         r, _ = ae.sweep_autoencoders_padded(dkeys[d], x_stack[d], n_rows[d],
                                             cfg, latent_dims)
         _block(r.params)
-    serial = time.perf_counter() - t0
+    serial = timeline.clock() - t0
     return batched, serial, stats
 
 

@@ -49,6 +49,7 @@ if __name__ == "__main__":                     # `python tools/bench_async.py`
 
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 import hfrep_tpu.obs as obs_pkg
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec, run_pipeline
@@ -97,7 +98,7 @@ def _sequential(plan: PipelinePlan):
                                 plan.sources[0].params["feats"])
     sweep_item_arrays(warm_key, warm_panel, plan.ae_cfg, plan.latent_dims)
 
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for idx, src in enumerate(plan.sources):      # phase 1: generation
         for seq in range(plan.blocks):
             if delay > 0.0:
@@ -116,7 +117,7 @@ def _sequential(plan: PipelinePlan):
         np.savez(buf, **arrays)
         digests[name][f"{seq:05d}"] = ckpt.aggregate_digest(
             {"sweep.npz": hashlib.sha256(buf.getvalue()).hexdigest()})
-    return time.perf_counter() - t0, digests
+    return timeline.clock() - t0, digests
 
 
 def run_probe(obs, self_test: bool) -> int:
@@ -131,9 +132,9 @@ def run_probe(obs, self_test: bool) -> int:
 
         seq_s, seq_digests = _sequential(plan)
 
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         out = run_pipeline(plan)
-        pipe_s = time.perf_counter() - t0
+        pipe_s = timeline.clock() - t0
         pipe_digests = {name: doc["items"]
                         for name, doc in out["summary"]["sources"].items()}
 

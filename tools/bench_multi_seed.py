@@ -16,7 +16,6 @@ gauges + memory snapshots) so two bench runs diff machine-readably with
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from hfrep_tpu.config import ModelConfig, TrainConfig
-from hfrep_tpu.obs import get_obs
+from hfrep_tpu.obs import get_obs, timeline
 
 
 def measure(n_seeds: int, n_calls: int = 10) -> float:
@@ -44,17 +43,17 @@ def measure(n_seeds: int, n_calls: int = 10) -> float:
 
     run_keys = jnp.stack([jax.random.PRNGKey(s) for s in range(n_seeds)])
     fold = jax.jit(jax.vmap(jax.random.fold_in, in_axes=(0, None)))
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     states, metrics = fn(states, fold(run_keys, 0))      # compile + warm
     jax.block_until_ready(metrics)
-    obs.record_span("block", time.perf_counter() - t0,
+    obs.record_span("block", timeline.clock() - t0,
                     steps=tcfg.steps_per_call, warmup=True, synced=True,
                     n_seeds=n_seeds)
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for i in range(1, n_calls + 1):
         states, metrics = fn(states, fold(run_keys, i))
     jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
+    dt = timeline.clock() - t0
     obs.record_span("block", dt, steps=n_calls * tcfg.steps_per_call,
                     warmup=False, synced=True, n_seeds=n_seeds)
     assert jnp.isfinite(metrics["d_loss"]).all()

@@ -26,7 +26,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 flags = os.environ.get("XLA_FLAGS", "")
@@ -43,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.config import ModelConfig, TrainConfig
 from hfrep_tpu.models.registry import build_gan
 from hfrep_tpu.train.states import init_gan_state
@@ -51,16 +51,16 @@ from hfrep_tpu.train.states import init_gan_state
 def _time_step(step, state, reps, label=None):
     from hfrep_tpu.obs import get_obs
     obs = get_obs()
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     state, m = step(state, jax.random.PRNGKey(99))      # compile + warm
     jax.block_until_ready(m["d_loss"])
-    obs.record_span("block", time.perf_counter() - t0, steps=1, warmup=True,
+    obs.record_span("block", timeline.clock() - t0, steps=1, warmup=True,
                     synced=True, config=label)
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for r in range(reps):
         state, m = step(state, jax.random.PRNGKey(100 + r))
         jax.block_until_ready(m["d_loss"])
-    dt = time.perf_counter() - t0
+    dt = timeline.clock() - t0
     obs.record_span("block", dt, steps=reps, warmup=False, synced=True,
                     config=label)
     return dt / reps * 1e3                              # ms/epoch

@@ -38,7 +38,6 @@ import os
 import shutil
 import sys
 import tempfile
-import time
 
 if __name__ == "__main__":               # `python tools/bench_scenario.py`
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -46,6 +45,7 @@ if __name__ == "__main__":               # `python tools/bench_scenario.py`
 
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 import hfrep_tpu.obs as obs_pkg
 
 
@@ -65,10 +65,10 @@ def _bank_check(problems: list, feats: int, window: int,
     d1 = tempfile.mkdtemp(prefix="scn_bank1_")
     d2 = tempfile.mkdtemp(prefix="scn_bank2_")
     try:
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         m1 = generate_bank(bundle, d1, blocks=blocks,
                            block_size=block_size, stream_seed=5)
-        bank_secs = time.perf_counter() - t0
+        bank_secs = timeline.clock() - t0
         replay = replay_block_digest(bundle, 5, 1, 0, block_size)
         if replay != m1["block_digests"]["r1_00000"]:
             problems.append("bank: in-memory replay digest diverged from "

@@ -26,7 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import time
+from hfrep_tpu.obs import timeline
 
 
 def main() -> None:
@@ -112,10 +112,10 @@ def main() -> None:
                              tf.float32))
 
     epoch()                                  # trace + warmup
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     for _ in range(args.epochs):
         epoch()
-    dt = time.perf_counter() - t0
+    dt = timeline.clock() - t0
 
     print(json.dumps({
         "metric": "tf_baseline_epochs_per_sec",

@@ -53,6 +53,13 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
 # Env-stripped like the other self-tests; pure-JSON stdout → stderr.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
     python -m hfrep_tpu.obs slo --self-test 1>&2
+# wall-clock ledger gate: accumulator algebra + conservation invariant
+# (Σ cat_ms == wall_ms on every emitted window), hand-computed fixture
+# ledger, perfetto reconstruction byte-identical on a rotated+compacted
+# dir, and torn-tail degradation (SIGKILLed run → larger unattributed,
+# never a crash).  Env-stripped like the other self-tests.
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
+    python -m hfrep_tpu.obs timeline --self-test 1>&2
 # AE chunked-drive probe fast path: trains the early-exit fixture at tiny
 # shapes and asserts the >=2x chunked-vs-monolithic win, so the probe (and
 # the hot path it guards) can't rot.  Pinned to CPU (a self-test of the

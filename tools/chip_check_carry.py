@@ -18,7 +18,6 @@ import argparse
 import functools
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -28,6 +27,7 @@ import numpy as np
 
 assert jax.default_backend() == "tpu", "this driver needs the real chip"
 
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.ops.pallas_lstm import lstm_seq_carry  # noqa: E402
 
 KEY = jax.random.PRNGKey(42)
@@ -229,11 +229,11 @@ def section_speed(mesh, sp_lstm):
         jax.block_until_ready(f(x0))
         xs = [jax.random.normal(jax.random.fold_in(KEY, 101 + i),
                                 (bb2, wl, hh)) for i in range(n)]
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         for x1 in xs:                 # distinct inputs: tunnel dedupes
             r = f(x1)
         jax.block_until_ready(r)
-        return (time.perf_counter() - t0) / n
+        return (timeline.clock() - t0) / n
 
     t_xla, t_pal = timed("xla"), timed("pallas")
     print(f"  xla {t_xla*1e3:.2f} ms  pallas {t_pal*1e3:.2f} ms  "

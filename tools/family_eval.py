@@ -9,7 +9,7 @@ import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import json
-import time
+from hfrep_tpu.obs import timeline
 
 import jax
 
@@ -34,11 +34,11 @@ def main(out="results/family_eval.json", seeds: int = 1):
         cfg = get_preset(preset)
         ds = build_gan_dataset(cfg.data, jax.random.PRNGKey(cfg.data.seed), panel)
         n = min(500, ds.windows.shape[0])
-        t0 = time.perf_counter()
+        t0 = timeline.clock()
         if seeds == 1:
             tr = GanTrainer(cfg, ds)
             tr.train()
-            wall = time.perf_counter() - t0
+            wall = timeline.clock() - t0
             fakes = [tr.generate(jax.random.PRNGKey(11), n, unscale=False)]
             epochs = tr.epoch
         else:
@@ -51,7 +51,7 @@ def main(out="results/family_eval.json", seeds: int = 1):
                                    [cfg.train.seed + k for k in range(seeds)],
                                    mesh="auto")
             mst.train()
-            wall = time.perf_counter() - t0
+            wall = timeline.clock() - t0
             cube = mst.generate(jax.random.PRNGKey(11), n, unscale=False)
             fakes = [cube[k] for k in range(seeds)]
             epochs = mst.epoch

@@ -26,14 +26,13 @@ import glob
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
-from hfrep_tpu.obs import attrib
+from hfrep_tpu.obs import attrib, timeline
 from hfrep_tpu.obs.attrib import interval_union_s, load_trace_events
 
 # module top on purpose: a broken shim must fail BEFORE an expensive
@@ -66,9 +65,9 @@ def calibrate(log_dir: str, k: int = 50, n: int = 2048) -> dict:
 
     attrib.profile_jitted(chain, "perf_probe:calibration", a, b)
     jax.device_get(chain(a, b))                           # compile + warm
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     jax.device_get(chain(a * 1.0001, b))
-    wall = time.perf_counter() - t0
+    wall = timeline.clock() - t0
     with jax.profiler.trace(log_dir):
         jax.device_get(chain(a * 1.0002, b))
     events, threads = load_trace_events(_latest_trace(log_dir))
@@ -101,10 +100,10 @@ def epoch_trace(log_dir: str) -> dict:
                           jax.random.PRNGKey(2))
     state, m = multi(state, jax.random.PRNGKey(2))        # compile + warm
     float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
-    t0 = time.perf_counter()
+    t0 = timeline.clock()
     state, m = multi(state, jax.random.PRNGKey(3))
     float(jax.device_get(m["d_loss"]).reshape(-1)[-1])
-    steady_epoch_wall = (time.perf_counter() - t0) / 50
+    steady_epoch_wall = (timeline.clock() - t0) / 50
 
     tcfg1 = TrainConfig(batch_size=32, steps_per_call=1)
     st1 = init_gan_state(jax.random.PRNGKey(4), mcfg, tcfg1, pair)
@@ -209,12 +208,12 @@ def sp_main(args) -> int:
             f = chain(stage, apply)
             attrib.profile_jitted(f, f"perf_probe:sp:{stage}:{name}",
                                   d_params, x)
-            t_c0 = time.perf_counter()
+            t_c0 = timeline.clock()
             float(f(d_params, x))                       # compile + run
-            compile_s = time.perf_counter() - t_c0
-            t0 = time.perf_counter()
+            compile_s = timeline.clock() - t_c0
+            t0 = timeline.clock()
             float(f(d_params, x * 1.0001))
-            row[name] = (time.perf_counter() - t0) / reps
+            row[name] = (timeline.clock() - t0) / reps
             print(f"  {stage:4s} {name:5s}: {row[name]*1e3:8.2f} ms/unit "
                   f"(compile {compile_s:.0f}s)")
         print(f"{stage}: sp/plain = {row['sp']/row['plain']:.1f}x")

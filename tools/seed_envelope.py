@@ -18,7 +18,6 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -26,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core.data import load_panel
 from hfrep_tpu.models.autoencoder import latent_mask
@@ -67,9 +67,9 @@ def main() -> int:
         rf_j, factor_j, p, m))
 
     keys = jnp.stack([jax.random.PRNGKey(s) for s in range(args.seeds)])
-    t0 = time.time()
+    t0 = timeline.clock()
     swept = jax.block_until_ready(train_all(keys))
-    t_train = time.time() - t0
+    t_train = timeline.clock() - t0
 
     rows = []
     for s in range(args.seeds):

@@ -4,7 +4,8 @@ round-1 host-serial engine loop on the real panel."""
 
 import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import time, dataclasses, jax, jax.numpy as jnp, numpy as np
+import dataclasses, jax, jax.numpy as jnp, numpy as np
+from hfrep_tpu.obs import timeline
 from hfrep_tpu.config import AEConfig
 from hfrep_tpu.core.data import load_panel
 from hfrep_tpu.models.autoencoder import latent_mask
@@ -19,20 +20,20 @@ dims = list(range(1, 22))
 cfg = dataclasses.replace(AEConfig(), latent_dim=21)
 eng = ReplicationEngine(x_train, y_train, x_test, y_test, cfg)
 
-t0 = time.perf_counter()
+t0 = timeline.clock()
 swept = sweep_autoencoders(jax.random.PRNGKey(0), eng.x_train, cfg, dims)
 jax.block_until_ready(swept.params)
-t_train = time.perf_counter() - t0
+t_train = timeline.clock() - t0
 
 masks = jnp.stack([latent_mask(d, 21) for d in dims])
-t0 = time.perf_counter()
+t0 = timeline.clock()
 ev = jax.device_get(sweep_evaluate(eng.model, cfg, eng.x_train, eng.x_test,
                                    eng.y_test, jnp.asarray(rf_test, jnp.float32),
                                    jnp.asarray(panel.factors, jnp.float32),
                                    swept.params, masks))
-t_eval_vmap = time.perf_counter() - t0
+t_eval_vmap = timeline.clock() - t0
 
-t0 = time.perf_counter()
+t0 = timeline.clock()
 for i, d in enumerate(dims):
     params_i = jax.tree_util.tree_map(lambda a: a[i], swept.params)
     eng.use_params(params_i, latent_mask(d, 21))
@@ -41,7 +42,7 @@ for i, d in enumerate(dims):
     ante = eng.ante(rf_test); eng.post(panel.factors); eng.turnover()
     np.asarray(perf_stats.annualized_sharpe(jnp.asarray(ante),
                jnp.asarray(rf_test, jnp.float32)[-ante.shape[0]:]))
-t_eval_serial = time.perf_counter() - t0
+t_eval_serial = timeline.clock() - t0
 
 print(f"train 21 latents (vmapped, 1000-epoch cap): {t_train:.2f}s")
 print(f"eval 21 latents vmapped one-program:        {t_eval_vmap:.2f}s (incl. compile)")
