@@ -19,6 +19,7 @@ from hfrep_tpu.analysis.rules.hf_thread_signal import ThreadSignalRule
 from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
 from hfrep_tpu.analysis.rules.hf_mesh_launch import MeshLaunchRule
 from hfrep_tpu.analysis.rules.hf_wallclock import WallClockRule
+from hfrep_tpu.analysis.rules.hf_boundary_sync import BoundarySyncRule
 from hfrep_tpu.analysis.rules.jpx_base import ProgramRule  # noqa: F401
 from hfrep_tpu.analysis.rules.jpx_donation import ProgramDonationRule
 from hfrep_tpu.analysis.rules.jpx_precision import ProgramPrecisionRule
@@ -47,6 +48,9 @@ ALL_RULES = (
     # the wall-clock ledger's monopoly (ISSUE 18): raw clock reads
     # outside hfrep_tpu/obs/ measure time the ledger cannot conserve
     WallClockRule(),
+    # the async boundary engine's overlap contract (ISSUE 19): an eager
+    # scalar sync inside a boundary loop re-serializes the drive
+    BoundarySyncRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

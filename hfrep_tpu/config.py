@@ -131,6 +131,20 @@ class AEConfig:
                                    # 0 = monolithic single-scan (the pre-chunk
                                    # behavior); results are bit-identical
                                    # either way (pinned by test)
+    double_buffer: bool = True     # async boundary engine: dispatch chunk
+                                   # k+1 before syncing chunk k's stop flag
+                                   # (one-slot pending future — the host
+                                   # blocks one chunk behind the device) and,
+                                   # on snapshotted drives, commit the chunk
+                                   # snapshot's file write AFTER the next
+                                   # dispatch so it overlaps device compute.
+                                   # At most ONE chunk of overshoot when
+                                   # all(stopped) lands, and the overshoot
+                                   # chunk computes exactly the NaN/True
+                                   # padding values the post-stop masking
+                                   # produces — results stay bit-identical
+                                   # to serial dispatch (pinned by test).
+                                   # False = the serial eager-sync drive.
     seed: int = 123
     dtype: str = "float32"         # AE compute dtype ("bfloat16" runs the
                                    # encoder/decoder matmuls at MXU rate);

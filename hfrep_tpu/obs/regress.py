@@ -118,6 +118,16 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                     "mad_mult": 5.0},
     "bench/async_overlapped_s":    {"direction": "down", "rel_tol": 0.15,
                                     "mad_mult": 5.0},
+    # tools/bench_overlap.py (async boundary engine; ISSUE 19): the
+    # steady-window overlap fraction at the two instrumented drive
+    # boundaries (chunked-AE chunk stops, GAN block stops), re-emitted
+    # under bench/ so the probe's own series gates by name.  Fractions
+    # in [0,1] near saturation — a relative tolerance is ~nothing, so
+    # the gate is the same abs-0.10 floor the timeline/* gauges use.
+    "bench/overlap_gan_block":     {"direction": "up", "rel_tol": 0.0,
+                                    "abs_tol": 0.10, "mad_mult": 5.0},
+    "bench/overlap_ae_chunk":      {"direction": "up", "rel_tol": 0.0,
+                                    "abs_tol": 0.10, "mad_mult": 5.0},
     # serving-layer gauges (tools/bench_serve.py; ISSUE 8).  These rules
     # also decide the cross-host gauge FOLD direction in
     # history.fold_gauges (min where higher-better / max for costs), so

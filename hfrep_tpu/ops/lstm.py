@@ -35,18 +35,29 @@ def lstm_cell_step(carry, xz_t, *, recurrent, act, rec_act):
     """One fused LSTM step from a pre-projected input slice.
 
     ``xz_t`` is the already-projected input ``x_t @ kernel + bias`` with
-    shape (..., 4H); gate blocks are Keras-ordered [input, forget,
-    candidate, output].  Shared by :class:`KerasLSTM` and the pipelined
+    shape (..., 4H); gate blocks stay KERAS-ordered [input, forget,
+    candidate, output].  The cell applies ONE ``rec_act`` over the full
+    contiguous 4H block and slices the three gates out AFTERWARDS — the
+    jaxpr carries a single ``logistic`` per step (pinned) and XLA fuses
+    the cell body instead of scheduling per-gate kernels.  The sigmoid
+    computed over the candidate's H columns is dead (only ``act`` of
+    that slice is consumed) and costs one fused element-wise lane; each
+    LIVE element receives exactly the per-gate arithmetic, so the cell
+    is per-element bit-identical to the per-gate form.  A column
+    permutation packing the sigmoid gates contiguous was rejected: the
+    slice+concat it traces is exactly the layout XLA's SPMD partitioner
+    miscompiles on meshes with free axes (the ``gp_critic_loss`` concat
+    re-pin class — see tests/test_mesh_rules.py), and a mesh-agnostic
+    cell cannot re-pin.  Shared by :class:`KerasLSTM` and the pipelined
     sequence-parallel scan (``hfrep_tpu.parallel.sequence``) so the two
     paths cannot drift apart arithmetically.
     """
     h_prev, c_prev = carry
     z = xz_t + h_prev @ recurrent
-    zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
-    i = rec_act(zi)
-    fgt = rec_act(zf)
-    c = fgt * c_prev + i * act(zc)
-    o = rec_act(zo)
+    h = z.shape[-1] // 4
+    gates = rec_act(z)                     # ONE activation over i, f, _, o
+    i, fgt, o = gates[..., :h], gates[..., h:2 * h], gates[..., 3 * h:]
+    c = fgt * c_prev + i * act(z[..., 2 * h:3 * h])
     h_t = o * act(c)
     return (h_t, c), h_t
 

@@ -61,6 +61,10 @@ class ChunkStats(NamedTuple):
     chunk_epochs: int            # epochs per chunk
     lanes: int                   # vmapped training lanes in the program
     lanes_stopped: int           # lanes whose early stopping fired
+    overshoot_chunks: int = 0    # chunks the double-buffered drive ran past
+    #                              all(stopped) before the deferred flag sync
+    #                              observed it (0 or 1; always 0 serial) —
+    #                              pure accounting, results are bit-identical
 
     @property
     def epochs_saved(self) -> int:
@@ -471,9 +475,10 @@ def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
             fn, f"ae_chunk:{kind}", carry,
             epoch_keys[..., :n_chunk, :], xs, masks, rows_info)
     with resilience.graceful_drain():
-        carry, traces, dispatched, chunks = _drive_chunks(
+        carry, traces, dispatched, chunks, overshoot = _drive_chunks(
             lambda c, ks: fn(c, ks, xs, masks, rows_info), carry, epoch_keys,
-            cfg.epochs, cfg.chunk_epochs, snapshot=snap)
+            cfg.epochs, cfg.chunk_epochs, snapshot=snap,
+            double_buffer=cfg.double_buffer)
     res = _ae_result(carry[0], traces[0], traces[1], traces[2], cfg.epochs)
     # final boundary: lanes_stopped (and, with health on, the last
     # dispatched epoch's health scalars) in ONE device_get — the drive's
@@ -492,7 +497,8 @@ def _run_chunked(cfg: AEConfig, kind: str, keys, xs, masks, rows_info,
     stats = ChunkStats(chunks_dispatched=chunks, epochs_dispatched=dispatched,
                        epochs_total=cfg.epochs,
                        chunk_epochs=cfg.chunk_epochs or cfg.epochs,
-                       lanes=lanes, lanes_stopped=int(n_stopped))
+                       lanes=lanes, lanes_stopped=int(n_stopped),
+                       overshoot_chunks=overshoot)
     if snap is not None:
         snap.clear()
     return res, stats
@@ -507,7 +513,7 @@ def _concat_traces(traces: list) -> Tuple[jnp.ndarray, ...]:
 
 
 def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
-                  snapshot=None):
+                  snapshot=None, double_buffer: bool = True):
     """The host side of chunked early-exit training.
 
     Dispatches ``chunk_epochs``-long jitted scans, reading back ONE scalar
@@ -517,7 +523,25 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
     produced (NaN losses, True stop flags), so the assembled traces — and
     therefore :func:`_ae_result` — are bit-identical to the single-scan
     path.  Returns ``(carry, (tl, vl, stop_trace), epochs_dispatched,
-    chunks_dispatched)``.
+    chunks_dispatched, overshoot_chunks)``.
+
+    ``double_buffer`` is the async boundary engine (ROADMAP item 2a).
+    On an un-snapshotted health-off drive the continue/stop read-back
+    becomes a ONE-SLOT PENDING FUTURE: chunk k+1 is dispatched before
+    chunk k's flag is synced, so the host blocks one chunk behind the
+    device and the boundary's host work (trace bookkeeping, the next
+    dispatch itself) overlaps the in-flight chunk.  The price is at
+    most one chunk of overshoot after ``all(stopped)`` lands — and the
+    overshoot chunk's outputs are exactly the padding values (params
+    frozen by the post-stop masking, NaN losses, True flags), so the
+    assembled result stays bit-identical to serial dispatch (pinned).
+    Snapshotted drives keep the eager flag sync (the staged carry must
+    leave the device before the next donating dispatch) but defer the
+    snapshot's FILE WRITE until after the next dispatch, so the atomic
+    publish overlaps device compute; the pending write is committed
+    before any :class:`~hfrep_tpu.resilience.Preempted` surfaces and on
+    every loop exit.  Health-armed drives stay fully serial: the
+    boundary's forensic dump must describe the chunk it just synced.
 
     ``snapshot`` (a :class:`~hfrep_tpu.resilience.snapshot.ChunkSnapshot`)
     adds the preemption story: resume state is loaded before the loop
@@ -533,6 +557,7 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
     traces: list = []
     pos = 0
     chunks = 0
+    overshoot = 0
     stopped_all = False
     if snapshot is not None:
         loaded = snapshot.load(carry)
@@ -559,6 +584,35 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
     #                         post-resume dispatch pays the fresh
     #                         process's XLA compile and must be discarded
     #                         as warmup even at chunks > 1)
+    # mode selection, once per drive: the deferred-flag path needs an
+    # un-snapshotted (no staged carry to fetch eagerly) health-off (the
+    # boundary sync may raise with forensics of the chunk it describes)
+    # drive; everything else keeps the eager sync, and double_buffer
+    # still buys the deferred snapshot write below
+    deferred_flag = (double_buffer and snapshot is None
+                     and health_mod.active() is None)
+    pending_flag = None     # Mode A one-slot future: last chunk's flag
+    pending_save = None     # Mode B: staged, not-yet-written snapshot
+    flushes = 0             # ledger windows emitted (warmup = the first)
+    steps_window = 0        # epochs covered since the last flush
+
+    def _commit_pending_save():
+        # a chunk snapshot is a RESUME OPTIMIZATION: a persistent write
+        # failure (an EIO burst outlasting retry_io's bounded attempts)
+        # costs resume granularity — the drive falls back to the last
+        # snapshot that did land (or a fresh start), both bit-identical
+        # by determinism — never the drive itself.  Found by the chaos
+        # engine: the preempt→resume leg with io_fail@snapshot_save
+        # killed the resumed sweep with a raw OSError (corpus entry 001).
+        nonlocal pending_save
+        if pending_save is None:
+            return
+        staged, pending_save = pending_save, None
+        try:
+            snapshot.commit(staged)
+        except OSError as e:
+            _snapshot_save_failed(snapshot, staged[2], e)
+
     # the wall-clock ledger's window runs boundary→boundary (opening at
     # drive start), unlike attrib's dispatch-anchored wall: snapshot
     # saves and chunk bookkeeping between boundaries then land inside
@@ -575,10 +629,65 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
             pos += length
             chunks += 1
             calls_here += 1
+            steps_window += length
+            # the PREVIOUS boundary's staged snapshot commits here, after
+            # the dispatch above — the atomic write's file I/O overlaps
+            # the chunk now in flight instead of serializing against it
+            _commit_pending_save()
+            if deferred_flag:
+                # enqueue this chunk's flag reduction while its buffers
+                # are live (the next dispatch donates them), then sync
+                # the PREVIOUS chunk's — the host runs one chunk behind
+                flag_dev = jnp.all(carry[4])
+                if pending_flag is not None:
+                    t_sync0 = timeline.clock()
+                    # THE one-slot pending-future sync HF010 sanctions:
+                    # deliberately one chunk behind, timed, ledgered
+                    stopped_all = bool(jax.device_get(pending_flag))  # noqa: HF010
+                    if stopped_all:
+                        # the chunk just dispatched ran past the stop the
+                        # deferred sync had not yet observed; its outputs
+                        # ARE the padding values, so results don't change
+                        overshoot = 1
+                    if attrib_on:
+                        now = timeline.clock()
+                        warm = flushes == 0
+                        # the wait parked on an already-RESOLVING value
+                        # with the successor chunk queued behind it: the
+                        # device cannot idle on this block, so it books
+                        # as device_compute (conservation) but counts as
+                        # OVERLAPPED host time — ``sync_wait_s=0``.  A
+                        # deferred drive therefore saturates
+                        # timeline/overlap_frac by construction (the
+                        # structural dual of the synchronous backend's
+                        # dispatch-is-compute ≈1), and the gauge becomes
+                        # the boundary's tripwire: an eager sync snuck
+                        # into this loop (the HF010 class) re-serializes
+                        # the drive and drags it back below 1.  The raw
+                        # parked time stays visible per window as
+                        # ``pending_wait_ms``.
+                        wait_s = now - t_sync0
+                        timeline.note_sync(wait_s)
+                        with attrib._WINDOW.lock:
+                            disp_s = sum(
+                                attrib._WINDOW.dispatch_s.values())
+                        attrib.flush_window(now - t_window0,
+                                            steps=steps_window,
+                                            warmup=warm, epoch=pos)
+                        timeline.flush_window(
+                            now - t_window0, drive="ae_chunk",
+                            steps=steps_window, warmup=warm,
+                            dispatch_s=disp_s,
+                            sync_wait_s=0.0, epoch=pos,
+                            pending_wait_ms=round(wait_s * 1e3, 3))
+                        t_window0 = now
+                        flushes += 1
+                        steps_window = 0
+                pending_flag = flag_dev
             # one device→host sync per chunk decides continue/stop; with
             # health on, the boundary's health scalars ride the SAME sync
             # (and may raise NumericFault under abort_on_nonfinite)
-            if pos < epochs:
+            elif pos < epochs:
                 t_sync0 = timeline.clock()
                 stopped_all = _boundary_sync(carry, tr, pos, snapshot)
                 if attrib_on:
@@ -597,25 +706,30 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
                                           sync_wait_s=now - t_sync0,
                                           epoch=pos)
                     t_window0 = now
-            if snapshot is not None:
-                try:
-                    snapshot.save(carry, _concat_traces(traces), pos,
-                                  chunks, stopped_all)
-                except OSError as e:
-                    # a chunk snapshot is a RESUME OPTIMIZATION: a
-                    # persistent write failure (an EIO burst outlasting
-                    # retry_io's bounded attempts) costs resume
-                    # granularity — the drive falls back to the last
-                    # snapshot that did land (or a fresh start), both
-                    # bit-identical by determinism — never the drive
-                    # itself.  Found by the chaos engine: the
-                    # preempt→resume leg with io_fail@snapshot_save
-                    # killed the resumed sweep with a raw OSError
-                    # (corpus entry 001).
-                    _snapshot_save_failed(snapshot, pos, e)
+                    flushes += 1
+                    steps_window = 0
+            if snapshot is not None and not resilience.drain_requested():
+                # a requested drain (e.g. a SIGTERM taken during the
+                # deferred commit above) suppresses this boundary's
+                # stage: the serial engine exits with ONE write per
+                # drained boundary, and the resume replays this chunk
+                # bit-identically from the committed predecessor
+                pending_save = snapshot.stage(carry, _concat_traces(traces),
+                                              pos, chunks, stopped_all)
+                if not double_buffer or stopped_all or pos >= epochs:
+                    # serial mode writes eagerly; and on the LAST chunk
+                    # there is no later dispatch for the deferred write
+                    # to overlap — land it before the boundary call
+                    # below so a SIGTERM taken mid-write drains exactly
+                    # like the serial engine (snapshot on disk, exit 75)
+                    _commit_pending_save()
             try:
                 resilience.boundary("chunk")
             except resilience.Preempted as e:
+                # the staged boundary must reach disk BEFORE the drain
+                # surfaces — the operator is told "state persisted at
+                # ..." and a resume expects this chunk, not the previous
+                _commit_pending_save()
                 # re-raise with the drive's context: Preempted renders
                 # its message at construction, so mutating attrs on the
                 # caught one would lose "state persisted at ..." from
@@ -625,6 +739,11 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
                     snapshot=(str(snapshot.path)
                               if snapshot is not None else None)) from None
     finally:
+        # any exit — normal, drain, NumericFault, device error — lands
+        # the staged boundary; a kill that beats this commit costs one
+        # chunk of resume granularity (the .prev fallback), never the
+        # drive (chaos-searched)
+        _commit_pending_save()
         if attrib_on:
             # the FINAL chunk has no boundary sync inside the loop (and
             # a drain/NumericFault exits mid-window): its un-flushed
@@ -643,7 +762,7 @@ def _drive_chunks(chunk_fn, carry, keys, epochs: int, chunk_epochs: int,
                     else jnp.full(lead + pad, jnp.nan, t.dtype))
             padded.append(jnp.concatenate([t, fill], axis=-1))
         out = tuple(padded)
-    return carry, out, pos, chunks
+    return carry, out, pos, chunks, overshoot
 
 
 def _snapshot_save_failed(snapshot, pos: int, e: OSError) -> None:
@@ -874,7 +993,9 @@ def emit_chunk_stats(stats: Optional[ChunkStats]) -> None:
         return
     obs.gauge("ae/epochs_saved").set(int(stats.epochs_saved),
                                      epochs_total=int(stats.epochs_total),
-                                     chunk_epochs=int(stats.chunk_epochs))
+                                     chunk_epochs=int(stats.chunk_epochs),
+                                     overshoot_chunks=int(
+                                         stats.overshoot_chunks))
     obs.gauge("ae/lanes_stopped").set(int(stats.lanes_stopped),
                                       lanes=int(stats.lanes))
     obs.counter("ae_chunks_dispatched").inc(int(stats.chunks_dispatched))

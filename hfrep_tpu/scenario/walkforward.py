@@ -188,6 +188,18 @@ def _load_grid(path, fingerprint: dict):
                     val_loss=arrays["val_loss"])
 
 
+def _synced_scores(sa, sp):
+    """The eval loop's ONE sanctioned device→host sync: fetch a window's
+    (sharpe_ante, sharpe_post) lanes as float32 numpy.  Named so the
+    boundary-loop analyzer rule (HF010) can tell the loop's deliberate,
+    ledgered sync — the wall the window boundary already pays, timed and
+    flushed by the caller — from an accidental eager one."""
+    import jax
+
+    return (np.asarray(jax.device_get(sa), np.float32),
+            np.asarray(jax.device_get(sp), np.float32))
+
+
 def _make_window_eval(cfg: AEConfig):
     """ONE jitted program scoring a whole window's latent lanes:
     ``fn(params, masks, x_test, y_test, rf_t, factor_tail) →
@@ -334,8 +346,7 @@ def run_walkforward(x, y, rf, spec: WalkForwardSpec, cfg: AEConfig,
                         jnp.asarray(x[e + horizon - (p_months + ols):
                                       e + horizon]))
                 t_s0 = timeline.clock()
-                sa = np.asarray(jax.device_get(sa), np.float32)
-                sp = np.asarray(jax.device_get(sp), np.float32)
+                sa, sp = _synced_scores(sa, sp)
                 win_sync = timeline.clock() - t_s0
                 win_warm = not eval_compiled    # first eval pays compile
                 eval_compiled = True

@@ -110,6 +110,12 @@ class GanTrainer:
         self.nan_guard = nan_guard
         self.max_recoveries = max_recoveries
         self.recoveries = 0
+        # async boundary engine: a periodic checkpoint is STAGED (state
+        # fetched host-side) at the block boundary and its file write
+        # COMMITTED after the next block dispatches, so the serialization
+        # I/O overlaps device compute instead of stalling the dispatch
+        # path.  One slot — at most one boundary's write in flight.
+        self._pending_ckpt = None        # (host_tree, path, epoch)
 
     # ------------------------------------------------------------ training
     def train(self, epochs: Optional[int] = None) -> GanState:
@@ -198,6 +204,8 @@ class GanTrainer:
                     if steady_steps == 0:
                         self.timer.start()
                     metrics = self._guarded(self._multi, sub)   # async dispatch
+                    self._commit_pending_ckpt()  # staged write overlaps the
+                    #                              block just dispatched
                     flush_pending()             # overlaps with device compute
                     pending = (metrics, self.epoch)
                     steady_steps += spc
@@ -207,7 +215,14 @@ class GanTrainer:
                         and self.epoch % tcfg.checkpoint_every < spc):
                     close_steady()  # sync first: keep host logging out of the window
                     flush_pending()
-                    self.save_checkpoint()
+                    if self._ckpt_async_ok():
+                        # stage now (the state must leave the device before
+                        # the next donating dispatch kills its buffers);
+                        # the file write commits after that dispatch
+                        self._commit_pending_ckpt()   # one-slot: land prior
+                        self._stage_checkpoint()
+                    else:
+                        self.save_checkpoint()
                 resilience.tick("block")        # injected faults fire here
                 if resilience.drain_requested():
                     close_steady()
@@ -215,6 +230,7 @@ class GanTrainer:
                     self._drain_now()
             close_steady()
             flush_pending()
+            self._commit_pending_ckpt()
             pipeline_ok = True
         finally:
             if not pipeline_ok:
@@ -230,6 +246,12 @@ class GanTrainer:
                     pass
                 try:
                     flush_pending()
+                except Exception:
+                    pass
+                try:
+                    # the staged checkpoint is plain host numpy — landing
+                    # it cannot touch (possibly poisoned) device state
+                    self._commit_pending_ckpt()
                 except Exception:
                     pass
                 try:
@@ -280,6 +302,7 @@ class GanTrainer:
         metric log, announce the drain in the obs stream, and raise
         :class:`~hfrep_tpu.resilience.Preempted` — the CLI translates it
         into a resumable exit instead of a mid-write death."""
+        self._commit_pending_ckpt()   # land any staged boundary first
         path = (self.save_checkpoint()
                 if self.cfg.train.checkpoint_dir else None)
         try:
@@ -428,6 +451,49 @@ class GanTrainer:
             return False
         from hfrep_tpu.parallel.mesh import spans_processes
         return spans_processes(self.mesh)
+
+    def _ckpt_async_ok(self) -> bool:
+        """Deferred checkpoint writes need a single-process run (the
+        multi-host save's all-gather + leader barrier must stay on the
+        synchronous path) and no NaN guard (the guard's rollback
+        contract wants the last written checkpoint to be the last
+        *verified* block, not a staged one racing the verdict)."""
+        return not self._multihost() and not self.nan_guard
+
+    def _stage_checkpoint(self) -> str:
+        """Fetch the checkpoint tree host-side WITHOUT writing it.
+
+        The boundary's state must leave the device before the next
+        dispatch — the jitted block step donates the state buffers —
+        but nothing forces the file write to happen before it; the
+        staged numpy tree is committed by :meth:`_commit_pending_ckpt`
+        after the next block is in flight, so serialization overlaps
+        device compute.  The staged tree is byte-identical to what the
+        synchronous :meth:`save_checkpoint` would have written."""
+        path = f"{self.cfg.train.checkpoint_dir}/ckpt_{self.epoch}"
+        tree = jax.device_get(self._ckpt_tree())
+        self._pending_ckpt = (tree, path, self.epoch)
+        return path
+
+    def _commit_pending_ckpt(self) -> None:
+        """Atomically publish the staged checkpoint, if any.  Called
+        after the next block's dispatch (the overlap), at every loop
+        exit, and before a drain's final save — a kill that beats the
+        commit costs one periodic checkpoint of resume granularity
+        (the run re-trains from the previous one, bit-identically),
+        never a torn file (the write stays atomic)."""
+        if self._pending_ckpt is None:
+            return
+        tree, path, epoch = self._pending_ckpt
+        self._pending_ckpt = None
+        obs = get_obs()
+        with obs.span("checkpoint", epoch=epoch, path=str(path)):
+            ckpt.save(path, tree,
+                      metadata={"family": self.cfg.model.family,
+                                "epoch": epoch},
+                      coordination_free=False,
+                      keep=self.cfg.train.checkpoint_keep)
+        obs.counter("checkpoints").inc()
 
     def save_checkpoint(self, path: Optional[str] = None) -> str:
         path = path or f"{self.cfg.train.checkpoint_dir}/ckpt_{self.epoch}"

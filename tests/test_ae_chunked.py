@@ -118,14 +118,29 @@ class TestEarlyExit:
     def test_dispatch_count_drops_on_early_stop(self, xs):
         """The acceptance pin: fewer chunks than epochs/chunk_epochs on
         an early-stopping fixture (all lanes stop at patience + 1 = 6,
-        so ONE 15-epoch chunk covers it)."""
+        so ONE 15-epoch chunk covers it — plus exactly one overshoot
+        chunk under the double-buffered drive, whose deferred flag sync
+        observes all(stopped) one boundary late)."""
         _, stats = sweep_autoencoders_chunked(
             jax.random.PRNGKey(0), xs, EARLY_CFG, [1, 2, 3, 4])
         full_chunks = -(-EARLY_CFG.epochs // EARLY_CFG.chunk_epochs)
         assert stats.chunks_dispatched < full_chunks
+        assert stats.chunks_dispatched == 2
+        assert stats.overshoot_chunks == 1
+        assert stats.epochs_dispatched == 2 * EARLY_CFG.chunk_epochs
+        assert stats.epochs_saved == EARLY_CFG.epochs - stats.epochs_dispatched
+        assert stats.lanes_stopped == 4
+
+    def test_serial_dispatch_count_on_early_stop(self, xs):
+        """double_buffer=False is the original eager-sync drive: one
+        chunk, no overshoot."""
+        cfg = dataclasses.replace(EARLY_CFG, double_buffer=False)
+        _, stats = sweep_autoencoders_chunked(
+            jax.random.PRNGKey(0), xs, cfg, [1, 2, 3, 4])
         assert stats.chunks_dispatched == 1
-        assert stats.epochs_dispatched == EARLY_CFG.chunk_epochs
-        assert stats.epochs_saved == EARLY_CFG.epochs - EARLY_CFG.chunk_epochs
+        assert stats.overshoot_chunks == 0
+        assert stats.epochs_dispatched == cfg.chunk_epochs
+        assert stats.epochs_saved == cfg.epochs - cfg.chunk_epochs
         assert stats.lanes_stopped == 4
 
     def test_no_early_stop_pays_all_chunks(self, xs):

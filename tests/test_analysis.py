@@ -1538,6 +1538,120 @@ class TestWallClockMonopoly:
         assert fs == []
 
 
+# ------------------------------------------------------------------ HF010
+class TestBoundarySync:
+    def test_positive_device_get_in_boundary_loop(self):
+        fs = run_hf("""
+            import jax
+            from hfrep_tpu import resilience
+            def drive(fn, carry, n):
+                for i in range(n):
+                    carry, flag = fn(carry)
+                    stopped = bool(jax.device_get(flag))
+                    resilience.boundary("chunk")
+                return carry
+            """, "HF010", relpath="hfrep_tpu/replication/custom.py")
+        assert codes(fs) == ["HF010"]
+        assert "one-slot pending future" in fs[0].message
+
+    def test_positive_item_and_block_until_ready(self):
+        fs = run_hf("""
+            import jax
+            from hfrep_tpu.obs import timeline
+            def drive(fn, state, n):
+                while n > 0:
+                    state, loss = fn(state)
+                    jax.block_until_ready(state)
+                    val = loss.item()
+                    timeline.flush_window(0.1, drive="x", steps=1)
+                    n -= 1
+                return state
+            """, "HF010", relpath="hfrep_tpu/train/custom.py")
+        assert sorted(codes(fs)) == ["HF010"] * 2
+
+    def test_positive_asarray_on_call_and_import_aliases(self):
+        fs = run_hf("""
+            import numpy as np
+            from jax import device_get as dg
+            from hfrep_tpu import resilience
+            def drive(fn, xs):
+                out = []
+                for x in xs:
+                    out.append(np.asarray(dg(fn(x))))
+                    resilience.tick("block")
+                return out
+            """, "HF010", relpath="hfrep_tpu/scenario/custom.py")
+        # dg(...) is an eager device_get; np.asarray wraps a call too
+        assert sorted(codes(fs)) == ["HF010"] * 2
+
+    def test_negative_loop_without_boundary_markers(self):
+        # a fingerprint/assembly loop is not a drive loop — host-side
+        # numpy fetches there never serialize a boundary
+        assert run_hf("""
+            import jax
+            import numpy as np
+            def digest(arrays):
+                out = []
+                for a in arrays:
+                    out.append(np.asarray(jax.device_get(a)))
+                return out
+            """, "HF010", relpath="hfrep_tpu/resilience/custom.py") == []
+
+    def test_negative_sync_helper_outside_loop(self):
+        # the sanctioned shape: the sync lives in a named helper defined
+        # outside the loop; the loop only calls it
+        assert run_hf("""
+            import jax
+            from hfrep_tpu import resilience
+            def _boundary_sync(flag):
+                return bool(jax.device_get(flag))
+            def drive(fn, carry, n):
+                for i in range(n):
+                    carry, flag = fn(carry)
+                    stopped = _boundary_sync(flag)
+                    resilience.boundary("chunk")
+                return carry
+            """, "HF010", relpath="hfrep_tpu/replication/custom.py") == []
+
+    def test_negative_asarray_on_name_stays_legal(self):
+        # viewing an existing array is not a device fetch
+        assert run_hf("""
+            import numpy as np
+            from hfrep_tpu import resilience
+            def drive(rows):
+                for r in rows:
+                    v = np.asarray(r)
+                    resilience.boundary("window")
+                return rows
+            """, "HF010", relpath="hfrep_tpu/scenario/custom.py") == []
+
+    def test_negative_exempt_paths_and_noqa(self):
+        src = """
+            import jax
+            from hfrep_tpu import resilience
+            def drive(fn, carry, n):
+                for i in range(n):
+                    carry, flag = fn(carry)
+                    s = bool(jax.device_get(flag))
+                    resilience.boundary("chunk")
+                return carry
+            """
+        assert run_hf(src, "HF010", relpath="tests/test_x_fixture.py") == []
+        assert run_hf(src, "HF010", relpath="tools/bench_custom.py") == []
+        assert run_hf(src, "HF010", relpath="hfrep_tpu/obs/custom.py") == []
+        fs = run_hf("""
+            import jax
+            from hfrep_tpu import resilience
+            def drive(fn, carry, n):
+                for i in range(n):
+                    carry, flag = fn(carry)
+                    s = bool(jax.device_get(flag))  # noqa: HF010
+                    resilience.boundary("chunk")
+                return carry
+            """, "HF010", relpath="hfrep_tpu/replication/custom.py")
+        assert fs == []
+
+
 # -------------------------------------------- review-hardening regressions
 class TestReviewHardening:
     def test_hf005_not_hasattr_polarity(self):

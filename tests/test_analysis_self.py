@@ -23,14 +23,28 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 
 def test_repo_is_clean_under_static_analysis():
     # drive tools/check.sh itself so the CI tier and the developer script
-    # can never check different target lists.  The chaos step runs
-    # corpus-replay-only here (min-schedules 0, budget 0 — the soak loop
-    # exits immediately): the tier-1 suite has a hard global wall clock,
-    # and the full 25-schedule soak floor is the standalone check.sh
-    # default, not this smoke's job; the committed corpus still replays
-    # green in full on every tier-1 run
+    # can never check different target lists — the tier's job is the
+    # STATIC side (analyzer, program audit, schema/doc sync, self-test
+    # smokes), which no standalone test duplicates.
+    # The dynamic gates the tier already runs as standalone tests skip by
+    # name (resilience → test_resilience_selftest_smoke, bench_ae →
+    # test_bench_ae_self_test_smoke, bench_overlap → the DB-vs-serial
+    # identity pins in test_ae_chunked/test_async_boundary, bench_serve →
+    # tests/test_serve.py, bench_scenario → tests/test_scenario.py,
+    # crash_drill → the recorder/crash-bundle pins in the test_obs_*
+    # files, chaos → test_chaos.py's planted-bug search + oracle +
+    # corpus well-formedness pins): the tier-1 suite has a hard global
+    # wall clock, and the full gates (25-schedule chaos soak, complete
+    # corpus replay, every bench self-test) are the standalone check.sh
+    # default — run it directly before shipping perf- or
+    # resilience-sensitive changes.  HFREP_CHAOS_MIN/BUDGET stay pinned
+    # to 0 so a future un-skip of the chaos gate here degrades to the
+    # corpus-replay-only smoke instead of eating the tier's clock.
     import os
-    env = dict(os.environ, HFREP_CHAOS_MIN="0", HFREP_CHAOS_BUDGET="0")
+    env = dict(os.environ, HFREP_CHAOS_MIN="0", HFREP_CHAOS_BUDGET="0",
+               HFREP_CHECK_SKIP_GATES=(
+                   "resilience,bench_ae,bench_overlap,"
+                   "bench_serve,bench_scenario,crash_drill,chaos"))
     proc = subprocess.run(
         ["bash", str(REPO_ROOT / "tools" / "check.sh")],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=540,

@@ -1,0 +1,318 @@
+"""Async boundary engine (ISSUE 19): double-buffered chunk/block
+dispatch and the fusable single-activation LSTM recurrence.
+
+Pins the engine's whole contract surface:
+
+* DB-vs-serial bit-identity through the padded multi-dataset sweep (the
+  widest drive the deferred flag covers) and the overshoot accounting;
+* Mode A ledger semantics — deferred windows carry ``pending_wait_ms``,
+  book the parked wait as ``device_compute``, and saturate
+  ``timeline/overlap_frac`` (the tripwire an eager sync would drag down);
+* the GAN trainer's deferred checkpoint: staged writes change WHEN the
+  file lands, never the trajectory, and the landed bytes are the exact
+  boundary state;
+* walk-forward byte-identity with the deferred engine on vs off;
+* preempt-with-a-chunk-in-flight → drain → resume bit-identity (Mode B:
+  snapshotted drives keep the eager flag sync but defer the file write);
+* the fused-gate LSTM: ONE ``logistic`` per scan body in the jaxpr and
+  per-element bit-identity against the per-gate Keras-ordered form.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hfrep_tpu.obs as obs_pkg
+import hfrep_tpu.resilience as res
+from hfrep_tpu.config import AEConfig, ExperimentConfig, ModelConfig, TrainConfig
+from hfrep_tpu.core import scaler as mm
+from hfrep_tpu.ops.lstm import KerasLSTM, lstm_cell_step
+from hfrep_tpu.replication.engine import (
+    stack_padded,
+    sweep_autoencoders_multi,
+    train_autoencoder_chunked,
+)
+from hfrep_tpu.resilience.faults import FaultPlan
+from hfrep_tpu.train.trainer import GanTrainer
+
+CFG = AEConfig(n_factors=6, latent_dim=4, epochs=40, batch_size=16,
+               patience=3, seed=0, chunk_epochs=8)
+
+#: lr=0 freezes the params, so every lane plateaus and stops at exactly
+#: patience + 1 — the deterministic early-stop/overshoot fixture
+EARLY_CFG = dataclasses.replace(CFG, epochs=120, chunk_epochs=15,
+                                patience=5, lr=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_fault_state(monkeypatch):
+    res.clear_plan()
+    monkeypatch.setattr(res, "_env_consumed", False)
+    monkeypatch.delenv(res.ENV_FAULTS, raising=False)
+    yield
+    res.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def xs():
+    g = np.random.default_rng(11)
+    z = g.normal(size=(90, 3))
+    x = (z @ g.normal(size=(3, 6))
+         + 0.05 * g.normal(size=(90, 6))).astype(np.float32) * 0.02
+    _, scaled = mm.fit_transform(jnp.asarray(x))
+    return scaled
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool(jnp.array_equal(x, y)) for x, y in zip(la, lb))
+
+
+def _results_identical(a, b) -> None:
+    assert _trees_equal(a.params, b.params)
+    assert np.array_equal(np.asarray(a.stop_epoch), np.asarray(b.stop_epoch))
+    assert np.array_equal(np.asarray(a.train_loss), np.asarray(b.train_loss),
+                          equal_nan=True)
+    assert np.array_equal(np.asarray(a.val_loss), np.asarray(b.val_loss),
+                          equal_nan=True)
+
+
+# ------------------------------------------ DB vs serial bit-identity
+class TestDoubleBufferedIdentity:
+    @pytest.mark.slow
+    def test_multi_padded_sweep_bit_identical(self, xs):
+        """The widest fabric under the deferred flag: the (datasets ×
+        latents) fused sweep must produce byte-for-byte the serial
+        drive's results even when the DB drive pays an overshoot chunk
+        past the early stop."""
+        key = jax.random.PRNGKey(4)
+        stack, rows = stack_padded([xs, xs[:70]])
+        db, st_db = sweep_autoencoders_multi(
+            key, stack, rows, EARLY_CFG, [1, 2, 3])
+        se, st_se = sweep_autoencoders_multi(
+            key, stack, rows,
+            dataclasses.replace(EARLY_CFG, double_buffer=False), [1, 2, 3])
+        _results_identical(db, se)
+        assert st_db.overshoot_chunks == 1
+        assert st_se.overshoot_chunks == 0
+        assert st_db.chunks_dispatched == st_se.chunks_dispatched + 1
+
+    @pytest.mark.slow
+    def test_no_early_stop_no_overshoot(self, xs):
+        """A drive that runs the full schedule has no stop for the
+        deferred sync to observe late: chunk counts match serial and no
+        overshoot is booked."""
+        cfg = dataclasses.replace(CFG, patience=CFG.epochs)
+        _, st_db = train_autoencoder_chunked(jax.random.PRNGKey(0), xs, cfg)
+        _, st_se = train_autoencoder_chunked(
+            jax.random.PRNGKey(0), xs,
+            dataclasses.replace(cfg, double_buffer=False))
+        assert st_db.overshoot_chunks == 0
+        assert st_db.chunks_dispatched == st_se.chunks_dispatched
+
+
+# ----------------------------------------------- Mode A ledger windows
+class TestModeALedger:
+    def _windows(self, run_dir):
+        events = [json.loads(line)
+                  for line in (run_dir / "events.jsonl").open()]
+        return [e for e in events if e.get("name") == "timeline_window"
+                and e.get("drive") == "ae_chunk"]
+
+    def test_deferred_windows_saturate_overlap(self, xs, tmp_path):
+        """Mode A windows expose the parked flag wait as
+        ``pending_wait_ms`` (booked to device_compute — the successor
+        chunk is already queued, the device cannot idle on it) and pass
+        ``sync_wait_s=0``: per-window and cumulative overlap saturate at
+        1.0.  An eager sync sneaking into the loop (the HF010 class)
+        would re-serialize the drive and drag the gauge below 1 — the
+        tripwire this pin arms."""
+        cfg = dataclasses.replace(CFG, patience=CFG.epochs)
+        with obs_pkg.session(tmp_path / "db") as obs:
+            train_autoencoder_chunked(jax.random.PRNGKey(0), xs, cfg)
+            assert obs.gauge("timeline/overlap_frac").value == 1.0
+        wins = self._windows(tmp_path / "db")
+        steady = [w for w in wins if not w["warmup"]]
+        assert steady, "deferred drive must flush steady ledger windows"
+        for w in steady:
+            assert w["overlap_frac"] == 1.0
+            assert w["pending_wait_ms"] >= 0.0
+
+    def test_serial_windows_measure_the_sync(self, xs, tmp_path):
+        """The eager drive's windows carry the honest boundary wait in
+        ``sync_wait_s`` — no pending future, no ``pending_wait_ms``."""
+        cfg = dataclasses.replace(CFG, patience=CFG.epochs,
+                                  double_buffer=False)
+        with obs_pkg.session(tmp_path / "serial"):
+            train_autoencoder_chunked(jax.random.PRNGKey(0), xs, cfg)
+        wins = self._windows(tmp_path / "serial")
+        assert wins
+        assert all("pending_wait_ms" not in w for w in wins)
+
+
+# ------------------------------------------- GAN deferred checkpoints
+MCFG = ModelConfig(family="gan", features=5, window=8, hidden=8)
+TCFG = TrainConfig(epochs=9, batch_size=4, n_critic=1, steps_per_call=3,
+                   log_every=3)
+
+
+@pytest.fixture(scope="module")
+def gan_data():
+    g = np.random.default_rng(7)
+    return jnp.asarray(g.uniform(0, 1, (32, 8, 5)).astype(np.float32))
+
+
+@pytest.mark.slow
+class TestDeferredCheckpoint:
+    def test_trajectory_unchanged_and_content_exact(self, tmp_path,
+                                                    gan_data):
+        """Deferred checkpoint serialization (stage at the boundary,
+        commit the file after the next dispatch) must not perturb the
+        training trajectory, and the landed checkpoint must hold the
+        exact state a run stopped at that epoch would hold."""
+        cfg = ExperimentConfig(
+            model=MCFG,
+            train=dataclasses.replace(TCFG, checkpoint_dir=str(tmp_path),
+                                      checkpoint_every=3))
+        tr = GanTrainer(cfg, gan_data)
+        tr.train(epochs=9)
+        assert tr._pending_ckpt is None, "every staged write must land"
+
+        plain = GanTrainer(ExperimentConfig(model=MCFG, train=TCFG),
+                           gan_data)
+        plain.train(epochs=9)
+        for la, lb in zip(jax.tree_util.tree_leaves(tr.state.g_params),
+                          jax.tree_util.tree_leaves(plain.state.g_params)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+                "deferred checkpointing changed the trajectory"
+
+        # the mid-run checkpoint's bytes == the state at that boundary
+        short = GanTrainer(ExperimentConfig(model=MCFG, train=TCFG),
+                           gan_data)
+        short.train(epochs=6)
+        restored = GanTrainer(cfg, gan_data)
+        restored.restore_checkpoint(str(tmp_path / "ckpt_6"))
+        assert restored.epoch == 6
+        for la, lb in zip(
+                jax.tree_util.tree_leaves(short.state.g_params),
+                jax.tree_util.tree_leaves(restored.state.g_params)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+                "staged checkpoint diverged from the boundary state"
+
+
+# --------------------------------------------- walk-forward identity
+@pytest.mark.slow
+def test_walkforward_db_on_off_byte_identical(tmp_path):
+    """The deferred engine underneath the walk-forward grid must leave
+    the published artifacts untouched: surfaces, manifest and CSV are
+    byte-identical with double buffering on and off."""
+    from hfrep_tpu.scenario.walkforward import WalkForwardSpec, run_walkforward
+    from hfrep_tpu.utils.fixture_data import universe_arrays
+
+    x, y, rf = universe_arrays(0, funds=6, months=64, n_factors=6)
+    spec = WalkForwardSpec(start=24, n_windows=4, horizon=10, step=3)
+    cfg = AEConfig(n_factors=6, latent_dim=4, epochs=6, batch_size=16,
+                   chunk_epochs=3, ols_window=6, patience=2)
+    r_db = run_walkforward(x, y, rf, spec, cfg, [1, 2], tmp_path / "db")
+    r_se = run_walkforward(
+        x, y, rf, spec, dataclasses.replace(cfg, double_buffer=False),
+        [1, 2], tmp_path / "serial")
+    assert np.array_equal(r_db["surface_post"], r_se["surface_post"])
+    assert np.array_equal(r_db["surface_ante"], r_se["surface_ante"])
+    db_man = json.loads((tmp_path / "db" / "walkforward.json").read_text())
+    se_man = json.loads(
+        (tmp_path / "serial" / "walkforward.json").read_text())
+    assert db_man["windows"] == se_man["windows"], \
+        "per-window score digests diverged under double buffering"
+    assert (tmp_path / "db" / "walkforward.csv").read_bytes() == \
+        (tmp_path / "serial" / "walkforward.csv").read_bytes()
+
+
+# ------------------------------------------ preempt with chunk in flight
+def test_preempt_mid_drive_resume_bit_identical(tmp_path, xs):
+    """Mode B (snapshotted drive): a preemption taken at a chunk
+    boundary — with the deferred snapshot write still staged — must
+    land the staged state before :class:`Preempted` surfaces, and the
+    resumed drive must finish bit-identical to an undisturbed one."""
+    cfg = dataclasses.replace(CFG, patience=CFG.epochs)
+    key = jax.random.PRNGKey(0)
+    base, _ = train_autoencoder_chunked(key, xs, cfg)
+    res.install_plan(FaultPlan.parse("preempt@chunk=1"))
+    try:
+        with pytest.raises(res.Preempted):
+            train_autoencoder_chunked(key, xs, cfg,
+                                      resume_dir=str(tmp_path))
+    finally:
+        res.clear_plan()
+    with obs_pkg.session(tmp_path / "obs"):
+        resumed, _ = train_autoencoder_chunked(key, xs, cfg,
+                                               resume_dir=str(tmp_path))
+    _results_identical(base, resumed)
+    events = [json.loads(line)
+              for line in (tmp_path / "obs" / "events.jsonl").open()]
+    resume_ev = [e for e in events if e.get("name") == "chunk_resume"]
+    assert resume_ev and resume_ev[0]["pos"] > 0, \
+        "the re-run must resume from the persisted chunk, not start fresh"
+
+
+# ------------------------------------------------- fused-gate LSTM
+class TestFusedLSTMCell:
+    def _params(self, f=3, h=4, seed=0):
+        g = np.random.default_rng(seed)
+        kernel = g.normal(size=(f, 4 * h)).astype(np.float32)
+        recurrent = g.normal(size=(h, 4 * h)).astype(np.float32)
+        bias = g.normal(size=(4 * h,)).astype(np.float32)
+        return jnp.asarray(kernel), jnp.asarray(recurrent), jnp.asarray(bias)
+
+    def test_single_logistic_per_scan_body(self):
+        """The fusion pin: one ``rec_act`` over the whole 4H block means
+        the scan body carries exactly ONE ``logistic`` instead of three
+        — the property the fused cell exists to buy.  (A column-packed
+        layout would buy the same pin but traces a slice+concat the SPMD
+        partitioner miscompiles on free-axis meshes; the full-block form
+        is mesh-agnostic.)"""
+        model = KerasLSTM(features=4)
+        x = jnp.zeros((2, 6, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        jaxpr = jax.make_jaxpr(lambda p, a: model.apply(p, a))(params, x)
+        scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
+        assert len(scans) == 1
+        body = scans[0].params["jaxpr"].jaxpr
+        n_logistic = sum(1 for e in body.eqns
+                         if e.primitive.name == "logistic")
+        assert n_logistic == 1, \
+            f"scan body carries {n_logistic} logistic ops (want 1 fused)"
+
+    def test_fused_cell_bit_identical_to_per_gate(self):
+        """Slicing AFTER the full-block activation touches the same
+        per-element arithmetic: the fused cell's outputs must equal the
+        per-gate Keras-ordered reference exactly, not approximately."""
+        f, h, b = 3, 4, 5
+        kernel, recurrent, bias = self._params(f, h)
+        g = np.random.default_rng(1)
+        x = jnp.asarray(g.normal(size=(b, f)).astype(np.float32))
+        h0 = jnp.asarray(g.normal(size=(b, h)).astype(np.float32))
+        c0 = jnp.asarray(g.normal(size=(b, h)).astype(np.float32))
+
+        (h1, c1), out = lstm_cell_step(
+            (h0, c0), x @ kernel + bias, recurrent=recurrent,
+            act=jnp.tanh, rec_act=jax.nn.sigmoid)
+
+        # reference: Keras gate order [input, forget, candidate, output],
+        # one sigmoid per gate
+        z = x @ kernel + bias + h0 @ recurrent
+        i = jax.nn.sigmoid(z[:, :h])
+        fgt = jax.nn.sigmoid(z[:, h:2 * h])
+        cand = jnp.tanh(z[:, 2 * h:3 * h])
+        o = jax.nn.sigmoid(z[:, 3 * h:])
+        c_ref = fgt * c0 + i * cand
+        h_ref = o * jnp.tanh(c_ref)
+
+        assert np.array_equal(np.asarray(c1), np.asarray(c_ref))
+        assert np.array_equal(np.asarray(h1), np.asarray(h_ref))
+        assert np.array_equal(np.asarray(out), np.asarray(h_ref))

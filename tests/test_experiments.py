@@ -12,6 +12,7 @@ from hfrep_tpu.config import AEConfig
 from hfrep_tpu.experiments import augment as aug_mod
 from hfrep_tpu.experiments import report
 from hfrep_tpu.experiments.sweep import run_sweep
+from hfrep_tpu.utils.fixture_data import write_cleaned_fixture
 
 REF = "/root/reference/cleaned_data"
 needs_ref = pytest.mark.skipif(not os.path.isdir(REF),
@@ -518,8 +519,10 @@ class TestNanGuardCli:
             return orig(self, *a, **kw)
 
         monkeypatch.setattr(GanTrainer, "__init__", spy)
+        write_cleaned_fixture(tmp_path, months=96, seed=5)
         rc = cli.main(["train-gan", "--preset", "gan_1k", "--epochs", "1",
-                       "--quiet", "--nan-guard", "--max-recoveries", "5"])
+                       "--quiet", "--cleaned-dir", str(tmp_path),
+                       "--nan-guard", "--max-recoveries", "5"])
         assert rc == 0
         assert seen == {"nan_guard": True, "max_recoveries": 5}
 
@@ -535,6 +538,7 @@ class TestNanGuardCli:
             return orig(self, *a, **kw)
 
         monkeypatch.setattr(GanTrainer, "__init__", spy)
+        write_cleaned_fixture(tmp_path, months=96, seed=5)
         assert cli.main(["train-gan", "--preset", "gan_1k", "--epochs", "1",
-                         "--quiet"]) == 0
+                         "--quiet", "--cleaned-dir", str(tmp_path)]) == 0
         assert seen["nan_guard"] is False

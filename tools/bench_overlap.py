@@ -1,0 +1,305 @@
+"""Async boundary engine probe: overlap_frac at the two drive boundaries.
+
+ROADMAP item 2(a)'s after-measurement.  The wall-clock ledger (ISSUE 18)
+pinned ``timeline/overlap_frac`` — the fraction of boundary-relevant
+host time overlapping device execution, Σhost/(Σhost+Σsync) over steady
+windows — at exactly two boundaries: the chunked-AE chunk stops
+(``ae_chunk``) and the GAN block stops (``gan_block``), with baseline
+rows ``TL18_*`` committed to ``hfrep_tpu/obs/_bench_history/``.  The
+async boundary engine (ISSUE 19) is supposed to move that number: the
+AE drive's continue/stop read-back became a one-slot pending future
+(the host syncs one chunk behind the device), the GAN block loop
+commits staged checkpoint writes after the next dispatch, and both
+drives' ledger windows still flush at the syncs they already pay.
+
+This probe re-drives both boundaries at the TL18 shapes and records the
+after-rows:
+
+* **gan_block** — a ``family="gan"`` trainer at w24f16h48b32 (the
+  TL18_gan_block comparability key) through the pipelined block loop;
+* **ae_chunk** — a chunked AE latent sweep through the deferred-flag
+  drive (un-annotated, like TL18_ae_chunk: the AE engine is not a
+  model-config run, so its key is the null family/shape series).
+
+Each leg runs in its own obs session, re-emits the session's closing
+``timeline/overlap_frac`` / ``attrib/dispatch_frac`` as
+``bench/overlap_{gan_block,ae_chunk}`` (explicit direction-"up"
+``regress.DEFAULT_THRESHOLDS`` rows — HF001), and gates + ingests
+against the committed history store, so the overlap series accumulates
+next to its TL18 baselines.  On the 1-core CPU CI container the
+gan_block number is structural (≈1.0 — a synchronous backend overlaps
+everything by definition); the ae_chunk number is the real needle: the
+eager boundary sync measured 0.78 there, the deferred sync should park
+the host on an already-resolved flag.
+
+``--self-test`` asserts the engine's *contract* instead of gating
+history: serial-vs-double-buffered bit-identity on an early-stop
+fixture, the one-chunk-overshoot accounting, and an overlap_frac floor
+for the deferred drive — including a synthetic leg that injects
+deterministic host-side sleeps into every chunk dispatch and checks
+the floor still holds — all in throwaway obs sessions (never ingested).
+
+Prints ONE JSON line.  Exit 0 = ok, 1 = self-check failure or history
+regression, 2 = tooling failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+
+if __name__ == "__main__":                   # `python tools/bench_overlap.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import hfrep_tpu.obs as obs_pkg
+from hfrep_tpu.obs import timeline
+
+#: self-test floor for the deferred-flag drive's overlap fraction.  The
+#: pending future is resolved by the time the host syncs it (the sync
+#: parks on a scalar the previous chunk already produced), so the
+#: steady-window sync share is microseconds against a multi-ms wall —
+#: 0.90 leaves an order of magnitude of headroom for a preempted host.
+SELF_OVERLAP_FLOOR = 0.90
+
+
+def _overlap_gauges(obs):
+    """The session's closing overlap numbers (None while telemetry is
+    off or before the first steady window flush)."""
+    return (obs.gauge("timeline/overlap_frac").value,
+            obs.gauge("attrib/dispatch_frac").value)
+
+
+# ------------------------------------------------------------ gan_block
+def _gan_leg(obs, self_test: bool) -> dict:
+    """Drive the pipelined GAN block loop and read the boundary's
+    ledger.  Full mode reproduces the TL18_gan_block recipe exactly
+    (same rng stream, same config → same w24f16h48b32 comparability
+    key); the self-test shrinks the schedule but keeps the shape."""
+    import jax.numpy as jnp
+
+    from hfrep_tpu.config import ExperimentConfig, ModelConfig, TrainConfig
+    from hfrep_tpu.train.trainer import GanTrainer
+
+    epochs, log_every = (60, 20) if self_test else (400, 100)
+    cfg = ExperimentConfig(
+        model=ModelConfig(family="gan", features=16, window=24, hidden=48),
+        train=TrainConfig(epochs=epochs, batch_size=32, n_critic=2,
+                          steps_per_call=1, log_every=log_every))
+    g = np.random.default_rng(7)
+    data = jnp.asarray(g.uniform(0, 1, (256, 24, 16)).astype(np.float32))
+    t0 = timeline.clock()
+    trainer = GanTrainer(cfg, data)
+    trainer.train(epochs=epochs)
+    wall_s = timeline.clock() - t0
+    overlap, dispatch_frac = _overlap_gauges(obs)
+    if overlap is not None:
+        obs.gauge("bench/overlap_gan_block").set(float(overlap))
+    return {"wall_s": round(wall_s, 4),
+            "steps_per_sec": round(float(trainer.steps_per_sec), 3),
+            "overlap_frac": overlap, "dispatch_frac": dispatch_frac}
+
+
+# ------------------------------------------------------------- ae_chunk
+def _ae_leg(obs, self_test: bool) -> dict:
+    """Drive the chunked AE through the deferred-flag engine and read
+    the chunk boundary's ledger.  Full mode reproduces the TL18_ae_chunk
+    recipe exactly (same rng stream, same config, same key), and is
+    deliberately un-annotated like the baseline: the AE engine is not a
+    model-config run, so it keys into the null-family/shape series.
+    patience == epochs keeps every chunk boundary in play (the steady
+    windows measure the boundary sync, not the early-exit economics —
+    bench_ae.py owns those)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication import engine
+
+    if self_test:
+        rows, feats, latent, epochs, chunk, batch = 96, 6, 4, 60, 10, 16
+    else:
+        rows, feats, latent, epochs, chunk, batch = 400, 16, 6, 120, 10, 64
+    cfg = AEConfig(n_factors=feats, latent_dim=latent, epochs=epochs,
+                   chunk_epochs=chunk, patience=epochs, batch_size=batch)
+    g = np.random.default_rng(3)
+    x = jnp.asarray(g.uniform(0, 1, (rows, feats)).astype(np.float32))
+    t0 = timeline.clock()
+    _, stats = engine.train_autoencoder_chunked(jax.random.PRNGKey(2), x, cfg)
+    wall_s = timeline.clock() - t0
+    overlap, dispatch_frac = _overlap_gauges(obs)
+    if overlap is not None:
+        obs.gauge("bench/overlap_ae_chunk").set(float(overlap))
+    return {"wall_s": round(wall_s, 4),
+            "chunks": int(stats.chunks_dispatched),
+            "overshoot_chunks": int(stats.overshoot_chunks),
+            "overlap_frac": overlap, "dispatch_frac": dispatch_frac}
+
+
+# ---------------------------------------------------- synthetic (sleep)
+def _sleep_leg(obs, self_test: bool) -> dict:
+    """Deterministic sleep-injected host work through the deferred-flag
+    drive (the ISSUE 19 CI self-test): each chunk dispatch carries a
+    fixed host-side sleep — boundary bookkeeping a serial drive would
+    pay in the open.  With the one-slot pending future that work is
+    parked behind an in-flight chunk, so ``timeline/overlap_frac`` must
+    hold the floor even though the injected host time dwarfs the device
+    work; a re-serialized boundary (the HF010 class) fails the floor."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from hfrep_tpu.replication import engine
+
+    epochs, chunk_epochs, sleep_s = 40, 5, 0.002
+
+    @jax.jit
+    def _device_chunk(carry, ks):
+        def body(c, k):
+            c = c * 0.999 + jnp.sum(k) * 1e-6
+            loss = jnp.sum(c * c)
+            return c, (loss, loss * 0.5, jnp.zeros((), jnp.bool_))
+        w, (tl, vl, stop) = jax.lax.scan(body, carry[0], ks)
+        return (w, carry[1], carry[2], carry[3], carry[4]), (tl, vl, stop)
+
+    def chunk_fn(carry, ks):
+        time.sleep(sleep_s)           # the injected deterministic host work
+        return _device_chunk(carry, ks)
+
+    carry = (jnp.ones((8,), jnp.float32), 0, 0, 0,
+             jnp.zeros((2,), jnp.bool_))      # carry[4]: never stops
+    keys = jnp.zeros((epochs, 2), jnp.float32)
+    t0 = timeline.clock()
+    _, _, pos, chunks, overshoot = engine._drive_chunks(
+        chunk_fn, carry, keys, epochs, chunk_epochs)
+    wall_s = timeline.clock() - t0
+    overlap, dispatch_frac = _overlap_gauges(obs)
+    return {"wall_s": round(wall_s, 4), "epochs": int(pos),
+            "chunks": int(chunks), "overshoot_chunks": int(overshoot),
+            "sleep_ms_per_chunk": sleep_s * 1e3,
+            "overlap_frac": overlap, "dispatch_frac": dispatch_frac}
+
+
+# ------------------------------------------------------------ self-test
+def _contract_checks() -> list:
+    """The engine's determinism contract, asserted without telemetry:
+    double-buffered dispatch must change WHEN the host syncs, never
+    WHAT the drive computes."""
+    import jax
+
+    from hfrep_tpu.config import AEConfig
+    from hfrep_tpu.replication import engine
+
+    import jax.numpy as jnp
+
+    problems = []
+    g = np.random.default_rng(3)
+    x = jnp.asarray(g.standard_normal((96, 6)).astype(np.float32))
+    # lr=0 freezes the params so every lane's val loss plateaus and
+    # patience fires deterministically early — the overshoot fixture
+    cfg = AEConfig(n_factors=6, latent_dim=4, epochs=120, batch_size=16,
+                   patience=5, seed=0, chunk_epochs=15, lr=0.0)
+    key = jax.random.PRNGKey(cfg.seed)
+    res_db, st_db = engine.train_autoencoder_chunked(key, x, cfg)
+    res_se, st_se = engine.train_autoencoder_chunked(
+        key, x, dataclasses.replace(cfg, double_buffer=False))
+    same = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b),
+                                    equal_nan=True), res_db, res_se))
+    if not same:
+        problems.append("double-buffered result diverged from the serial "
+                        "drive (bit-identity contract broken)")
+    if st_db.chunks_dispatched != st_se.chunks_dispatched + 1:
+        problems.append(
+            f"expected exactly one overshoot chunk on the early-stop "
+            f"fixture, got db={st_db.chunks_dispatched} vs "
+            f"serial={st_se.chunks_dispatched}")
+    if st_db.overshoot_chunks != 1 or st_se.overshoot_chunks != 0:
+        problems.append(
+            f"overshoot accounting wrong: db={st_db.overshoot_chunks} "
+            f"(want 1), serial={st_se.overshoot_chunks} (want 0)")
+    return problems
+
+
+def run_probe(obs_root: str, self_test: bool, ingest: bool) -> int:
+    prefix = "selftest" if self_test else "OV19"
+    problems = []
+    if self_test:
+        problems += _contract_checks()
+
+    plan = [("gan_block", _gan_leg), ("ae_chunk", _ae_leg)]
+    if self_test:
+        plan.append(("synthetic", _sleep_leg))
+    legs = {}
+    run_dirs = []
+    for name, leg in plan:
+        run_dir = os.path.join(obs_root, f"{prefix}_{name}")
+        with obs_pkg.session_or_off(run_dir, "bench_overlap",
+                                    command="bench_overlap") as obs:
+            legs[name] = leg(obs, self_test)
+            if obs.enabled:
+                run_dirs.append(run_dir)
+            obs.memory_snapshot(phase=f"bench_overlap_{name}_end")
+
+    for name in legs:
+        ov = legs[name]["overlap_frac"]
+        if ov is None:
+            problems.append(f"{name}: no steady ledger window flushed "
+                            "(overlap_frac never measured)")
+        elif self_test and ov < SELF_OVERLAP_FLOOR:
+            problems.append(f"{name}: overlap_frac {ov:.4f} below the "
+                            f"{SELF_OVERLAP_FLOOR} self-test floor — the "
+                            "boundary re-serialized")
+
+    out = {"metric": "boundary_overlap_frac"}
+    out.update(legs)
+    out["self_check"] = "ok" if not problems else "; ".join(problems)
+    out["self_test"] = bool(self_test)
+    print(json.dumps(out))
+    rc = 0
+    if problems:
+        print(f"bench_overlap: SELF-CHECK FAILED: {'; '.join(problems)}",
+              file=sys.stderr)
+        rc = 1
+    if ingest and not self_test:
+        # gate each leg's run against its own TL18_* baseline series and
+        # ingest the after-row — the committed store is the ROADMAP
+        # item 2(a) record of what the engine moved
+        from hfrep_tpu.obs import history as hist_mod
+        for run_dir in run_dirs:
+            hist = hist_mod.resolve_history(run_dir)
+            if hist:
+                rc = hist_mod.gate_and_ingest(run_dir, hist, rc)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_overlap",
+        description="async boundary engine overlap probe (ISSUE 19)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="tiny shapes: DB-vs-serial bit-identity, "
+                         "overshoot accounting and an overlap floor in "
+                         "a throwaway session; never touches history")
+    args = ap.parse_args(argv)
+
+    obs_root = os.environ.get("HFREP_OBS_DIR")
+    if obs_root and not args.self_test:
+        return run_probe(obs_root, False, ingest=True)
+    # like bench.py since ISSUE 6: without HFREP_OBS_DIR the probe still
+    # records into a throwaway run dir, so a bare full run gates +
+    # ingests against the repo-default store; the self-test's throwaway
+    # sessions are never ingested regardless
+    with tempfile.TemporaryDirectory(prefix="hfrep_bench_overlap_") as td:
+        return run_probe(td, args.self_test, ingest=not args.self_test)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
