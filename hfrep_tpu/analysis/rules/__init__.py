@@ -20,6 +20,7 @@ from hfrep_tpu.analysis.rules.hf_exit_codes import ExitCodeRule
 from hfrep_tpu.analysis.rules.hf_mesh_launch import MeshLaunchRule
 from hfrep_tpu.analysis.rules.hf_wallclock import WallClockRule
 from hfrep_tpu.analysis.rules.hf_boundary_sync import BoundarySyncRule
+from hfrep_tpu.analysis.rules.hf_drive_envelope import DriveEnvelopeRule
 from hfrep_tpu.analysis.rules.jpx_base import ProgramRule  # noqa: F401
 from hfrep_tpu.analysis.rules.jpx_donation import ProgramDonationRule
 from hfrep_tpu.analysis.rules.jpx_precision import ProgramPrecisionRule
@@ -51,6 +52,9 @@ ALL_RULES = (
     # the async boundary engine's overlap contract (ISSUE 19): an eager
     # scalar sync inside a boundary loop re-serializes the drive
     BoundarySyncRule(),
+    # the Drive runtime's monopoly (ISSUE 20): hand-rolled survival
+    # envelopes outside resilience/drive.py regrow the copy-paste class
+    DriveEnvelopeRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

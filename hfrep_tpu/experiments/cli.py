@@ -521,23 +521,15 @@ def cmd_train_gan(args) -> int:
         # one run dir per process: a shared filesystem must not interleave
         # several processes' appends into one events.jsonl
         obs_dir = os.path.join(obs_dir, f"proc{jax.process_index()}")
-    # session() guarantees run_end + flush on the error path; enable
-    # BEFORE trainer construction — the parallel step builders'
-    # instrument_step hook decides at build time
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu.resilience import Preempted
-    with obs_pkg.session(obs_dir, command="train-gan", preset=args.preset):
-        try:
-            return _cmd_train_gan_impl(args)
-        except Preempted as e:
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            bundle_if_enabled(e)   # flight recorder: drain forensics
-            # graceful drain: the final checkpoint is on disk and the obs
-            # session's run_end still lands; 75 = EX_TEMPFAIL (re-run with
-            # --resume to continue the schedule)
-            print(f"preempted: {e}; re-run with --resume to continue",
-                  file=sys.stderr)
-            return 75
+    # run_drive opens the session (guaranteeing run_end + flush on the
+    # error path) BEFORE trainer construction — the parallel step
+    # builders' instrument_step hook decides at build time — and owns
+    # drain→75 / storage→74 / watchdog / crash bundling for this drive
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
+    return run_drive(DRIVE_REGISTRY["gan_ckpt"],
+                     lambda: _cmd_train_gan_impl(args), obs_dir=obs_dir,
+                     session_meta={"command": "train-gan",
+                                   "preset": args.preset})
 
 
 def _cmd_train_gan_impl(args) -> int:
@@ -669,23 +661,19 @@ def cmd_eval_gan(args) -> int:
 
 
 def cmd_sweep(args) -> int:
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu.resilience import Preempted
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
     obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
-    with obs_pkg.session(obs_dir, command="sweep", latents=args.latents):
-        try:
-            return _cmd_sweep_impl(args)
-        except Preempted as e:
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            bundle_if_enabled(e)   # flight recorder: drain forensics
-            # only the --resume path has a snapshot to come back to; a
-            # bare sweep would silently retrain from scratch on re-run
-            hint = ("re-run the same command to resume from the last chunk"
-                    if args.resume else
-                    "no snapshot was kept (run with --resume to make the "
-                    "sweep resumable)")
-            print(f"preempted: {e}; {hint}", file=sys.stderr)
-            return 75
+    # only the --resume path has a snapshot to come back to; a bare
+    # sweep would silently retrain from scratch on re-run
+    hint = ("re-run the same command to resume from the last chunk"
+            if args.resume else
+            "no snapshot was kept (run with --resume to make the "
+            "sweep resumable)")
+    return run_drive(DRIVE_REGISTRY["ae_sweep"],
+                     lambda: _cmd_sweep_impl(args), obs_dir=obs_dir,
+                     session_meta={"command": "sweep",
+                                   "latents": args.latents},
+                     drain_hint=hint)
 
 
 def _sample_augmentations(args, panel):
@@ -831,18 +819,11 @@ def _sweep_outputs(args, result, out_dir, panel, y_test, rf_test) -> int:
 
 
 def cmd_pipeline(args) -> int:
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu.resilience import Preempted
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
     obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
-    with obs_pkg.session(obs_dir, command="pipeline"):
-        try:
-            return _cmd_pipeline_impl(args)
-        except Preempted as e:
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            bundle_if_enabled(e)   # flight recorder: drain forensics
-            print(f"preempted: {e}; re-run with --resume to continue "
-                  "from the drained state", file=sys.stderr)
-            return 75
+    return run_drive(DRIVE_REGISTRY["pipeline"],
+                     lambda: _cmd_pipeline_impl(args), obs_dir=obs_dir,
+                     session_meta={"command": "pipeline"})
 
 
 def _cmd_pipeline_impl(args) -> int:
@@ -909,19 +890,14 @@ def _cmd_pipeline_impl(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu.resilience import Preempted
+    # drain semantics (admission stopped, in-flight flushed, every
+    # request reaching a typed terminal outcome) live in the impl's
+    # on_wave hook; the envelope just maps its Preempted to 75
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
     obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
-    with obs_pkg.session(obs_dir, command="serve"):
-        try:
-            return _cmd_serve_impl(args)
-        except Preempted as e:
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            bundle_if_enabled(e)   # flight recorder: drain forensics
-            # graceful drain: admission stopped, in-flight flushed, every
-            # request reached a typed terminal outcome; 75 = EX_TEMPFAIL
-            print(f"preempted: {e}", file=sys.stderr)
-            return 75
+    return run_drive(DRIVE_REGISTRY["serve_load"],
+                     lambda: _cmd_serve_impl(args), obs_dir=obs_dir,
+                     session_meta={"command": "serve"})
 
 
 def _cmd_serve_impl(args) -> int:
@@ -1003,19 +979,16 @@ def _cmd_serve_impl(args) -> int:
 
 
 def cmd_scenario(args) -> int:
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu.resilience import Preempted
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
     obs_dir = args.obs_dir or os.environ.get("HFREP_OBS_DIR")
-    with obs_pkg.session(obs_dir, command="scenario", mode=args.mode):
-        try:
-            return _cmd_scenario_impl(args)
-        except Preempted as e:
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            bundle_if_enabled(e)   # flight recorder: drain forensics
-            print(f"preempted: {e}; re-run with --resume to continue "
-                  "(published blocks/windows are kept and verified)",
-                  file=sys.stderr)
-            return 75
+    # one CLI verb, two registered drives: the bank mode is the
+    # conditional-GAN drive; walkforward/universe ride the walkforward
+    # spec (universe synthesis is quick and crosses no drain boundary)
+    key = "scenario_bank" if args.mode == "bank" else "walkforward"
+    return run_drive(DRIVE_REGISTRY[key],
+                     lambda: _cmd_scenario_impl(args), obs_dir=obs_dir,
+                     session_meta={"command": "scenario",
+                                   "mode": args.mode})
 
 
 def _scenario_panel(args):

@@ -62,7 +62,7 @@ METRIC_FIELDS = (
 #: first-class history metrics without the store having to know each
 #: probe's vocabulary
 GAUGE_PREFIXES = ("bench/", "serve/", "scenario/", "health/", "attrib/",
-                  "chaos/", "fleet/", "slo/", "timeline/")
+                  "chaos/", "fleet/", "slo/", "timeline/", "drive/")
 BENCH_GAUGE_PREFIX = "bench/"          # back-compat alias
 
 #: deadline-class ladder for the serve shape signature: a 10ms-deadline

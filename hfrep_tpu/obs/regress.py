@@ -303,6 +303,18 @@ DEFAULT_THRESHOLDS: Dict[str, dict] = {
                                      "abs_tol": 0.10, "mad_mult": 5.0},
     "timeline/wall_ms":             {"direction": "down", "rel_tol": 0.50,
                                      "mad_mult": 5.0},
+    # Drive runtime gauges (hfrep_tpu/resilience/drive.py; ISSUE 20).
+    # ``drive/secs`` is the envelope's whole-drive wall clock — a cost
+    # with the same wide floor as ``timeline/wall_ms`` (host-load noisy;
+    # the per-phase alarms stay primary).  ``drive/boundaries`` is a
+    # counter (never indexed by the history store) but it still needs
+    # the explicit HF001 row for the fold direction: MORE boundary
+    # crossings per drive means finer drain granularity — a run that
+    # silently crosses fewer safe points is the regression.
+    "drive/secs":                   {"direction": "down", "rel_tol": 0.50,
+                                     "mad_mult": 5.0},
+    "drive/boundaries":             {"direction": "up",   "rel_tol": 0.0,
+                                     "abs_tol": 0.5, "mad_mult": 5.0},
 }
 
 #: fallback rule for metrics without an entry above (bench gauges are

@@ -292,29 +292,37 @@ def actor_main(name: str, role: str, payload: dict) -> None:
     # cache every consumer restart re-pays its AE chunk-program compile
     from hfrep_tpu.utils.xla_cache import enable_compilation_cache
     enable_compilation_cache()
-    import hfrep_tpu.obs as obs_pkg
     from hfrep_tpu import resilience
+    from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, run_drive
 
-    with obs_pkg.session(payload.get("obs_dir"), command=f"actor:{role}",
-                         actor=name):
+    def work() -> int:
         try:
-            with resilience.graceful_drain():
-                if role == "generator":
-                    _generator_loop(name, payload)
-                elif role == "consumer":
-                    _consumer_loop(name, payload)
-                else:
-                    raise ValueError(f"unknown actor role {role!r}")
-        except resilience.Preempted as e:
-            from hfrep_tpu.obs import get_obs
-            from hfrep_tpu.obs.crash import bundle_if_enabled
-            get_obs().event("actor_drained", actor=name)
-            bundle_if_enabled(e)   # drain forensics (HF007: every
-            #                        handled-drain exit-75 handler)
-            # the barrier crossing: an injected stall@drain_barrier hangs
-            # HERE, driving the supervisor's timeout/escalation path
-            resilience.tick("drain_barrier")
-            sys.exit(EXIT_DRAINED)
+            if role == "generator":
+                _generator_loop(name, payload)
+            elif role == "consumer":
+                _consumer_loop(name, payload)
+            else:
+                raise ValueError(f"unknown actor role {role!r}")
         except QueueGap as e:
             print(f"{name}: {e}", file=sys.stderr)
-            sys.exit(EXIT_GAP)
+            return EXIT_GAP
+        return 0
+
+    def on_preempt(e) -> None:
+        from hfrep_tpu.obs import get_obs
+        get_obs().event("actor_drained", actor=name)
+        # the barrier crossing: an injected stall@drain_barrier hangs
+        # HERE, driving the supervisor's timeout/escalation path
+        resilience.tick("drain_barrier")
+
+    # run_drive maps Preempted→EXIT_DRAINED(75) for the supervisor; the
+    # member rides the pipeline spec but drains under its own name, and
+    # since ISSUE 20 the session opens INSIDE graceful_drain: a SIGTERM
+    # during the member's session bring-up now drains instead of
+    # killing the fresh interpreter raw (the corpus-003 class).
+    sys.exit(run_drive(DRIVE_REGISTRY["pipeline"], work,
+                       obs_dir=payload.get("obs_dir"),
+                       session_meta={"command": f"actor:{role}",
+                                     "actor": name},
+                       drain_hint="",
+                       watchdog_name=f"actor {name}", on_preempt=on_preempt))

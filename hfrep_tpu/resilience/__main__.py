@@ -14,6 +14,12 @@
     explain-faults  pretty-print a parsed HFREP_FAULTS spec — kind /
                     site / counter-group / occurrence / count / effect —
                     so a shrunk repro line is one paste from readable
+    drives          list every registered DriveSpec and its envelope
+                    capabilities (drive.py); --check runs the registry
+                    completeness gate (fixtures resolve, fault sites
+                    known, all six families covered, registry↔chaos
+                    subjects mirror in both directions) — wired into
+                    tools/check.sh
 """
 
 from __future__ import annotations
@@ -51,6 +57,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     subj_p.add_argument("--fixture-seed", type=int, default=0)
     subj_p.add_argument("--resume", action="store_true")
 
+    drv_p = sub.add_parser(
+        "drives",
+        help="list registered DriveSpecs + envelope capabilities; "
+             "--check gates registry completeness (exit 1 on a hole)")
+    drv_p.add_argument("--format", choices=("human", "json"),
+                       default="human")
+    drv_p.add_argument("--check", action="store_true",
+                       help="run the completeness gate instead of just "
+                            "listing")
+
     exp_p = sub.add_parser(
         "explain-faults",
         help="pretty-print a parsed HFREP_FAULTS spec (unknown sites "
@@ -83,6 +99,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         from hfrep_tpu.resilience.chaos_subjects import subject_main
         return subject_main(args.name, args.out, args.fixture_seed,
                             args.resume)
+
+    if args.cmd == "drives":
+        from hfrep_tpu.resilience.drive import (
+            DRIVE_REGISTRY,
+            check_registry,
+            spec_capabilities,
+        )
+        rows = [spec_capabilities(s) for s in DRIVE_REGISTRY.values()]
+        ok, problems = (check_registry() if args.check else (True, []))
+        if args.format == "json":
+            print(json.dumps({"drives": rows, "ok": ok,
+                              "problems": problems}, indent=2,
+                             sort_keys=True))
+        else:
+            for r in rows:
+                caps = [r["snapshot"] if r["snapshot"] != "none" else "",
+                        "deterministic" if r["deterministic"] else "",
+                        "resumable" if r["resumable"] else "",
+                        "double-buffer" if r["double_buffer"] else ""]
+                print(f"{r['name']:<14} {r['family']:<12} "
+                      f"tier={r['tier']:<5} "
+                      f"sites={','.join(r['boundary_sites']) or '-':<28} "
+                      f"{' '.join(c for c in caps if c)}")
+            for p in problems:
+                print(f"PROBLEM: {p}", file=sys.stderr)
+            if args.check:
+                print(f"drives: {len(rows)} specs, "
+                      f"{'ok' if ok else f'{len(problems)} problem(s)'}",
+                      file=sys.stderr)
+        return 0 if ok else 1
 
     # explain-faults
     from hfrep_tpu.resilience.faults import (

@@ -1,19 +1,30 @@
-"""Chaos subjects: the real drives the fault-schedule search exercises.
+"""Chaos subjects: the registry-derived view the fault-schedule search
+exercises.
 
 A *subject* is one end-to-end drive — chunked AE sweep, GAN
-train→checkpoint→resume, serving load, walk-forward sweep, orchestrate
-pipeline — wrapped so that a run is a **pure function of
-``(fixture_seed, schedule)``**: fixed fixture data derived from the
-seed, fixed configs, every artifact written deterministically.  The
-chaos engine (:mod:`hfrep_tpu.resilience.chaos`) spawns each run as a
-fresh subprocess (``python -m hfrep_tpu.resilience chaos-subject ...``)
+train→checkpoint→resume, serving load, walk-forward sweep, scenario
+bank, orchestrate pipeline — wrapped so that a run is a **pure function
+of ``(fixture_seed, schedule)``**.  Since ISSUE 20 the subject list is
+100% DERIVED from :data:`hfrep_tpu.resilience.drive.DRIVE_REGISTRY`:
+every registered :class:`~hfrep_tpu.resilience.drive.DriveSpec` becomes
+one :class:`Subject` (its ``fixture`` binding is the run function, its
+``timeout``/``tier``/``hint_sites`` carry over), so a new workload
+registered with the Drive runtime is born chaos-covered — there is no
+hand-maintained list to forget to extend, and
+``drive.check_registry()`` + tests/test_drive.py fail if the two views
+ever diverge.  The fixture bodies live in
+:mod:`hfrep_tpu.resilience.drive_fixtures`.
+
+The chaos engine (:mod:`hfrep_tpu.resilience.chaos`) spawns each run as
+a fresh subprocess (``python -m hfrep_tpu.resilience chaos-subject ...``)
 with the schedule's ``HFREP_FAULTS`` spec in the environment, under a
 watchdog, and judges the wreckage with the shared oracles
 (:mod:`hfrep_tpu.resilience.chaos_oracles`).
 
-Subject contract (what :func:`subject_main` enforces):
+Subject contract (what :func:`subject_main` — now a thin shell over
+:func:`hfrep_tpu.resilience.drive.run_drive` — enforces):
 
-* runs under the subject's own :func:`hfrep_tpu.resilience.watchdog`
+* runs under the drive's own :func:`hfrep_tpu.resilience.watchdog`
   and a real obs session at ``<out>/obs`` (stream parseability and
   crash-bundle presence are oracle surfaces);
 * a drain (:class:`~hfrep_tpu.resilience.Preempted`) maps to exit 75
@@ -31,13 +42,6 @@ Subject contract (what :func:`subject_main` enforces):
 subject actually crosses; the full registry stays in scope regardless
 (:func:`hfrep_tpu.resilience.chaos.generate_schedule` mixes in
 registry-wide draws, so a new fault site is automatically explored).
-
-The ``_planted`` subject is the engine's own canary: a deliberately
-buggy drive (non-atomic artifact write that SWALLOWS an injected EIO —
-the silent-drop class every real drive types or retries) that the
-search must find and the shrinker must reduce to its one-directive
-minimal spec.  It is excluded from normal soaks (leading underscore)
-and pinned by ``tests/test_chaos.py``.
 """
 
 from __future__ import annotations
@@ -47,6 +51,9 @@ import json
 import sys
 from pathlib import Path
 from typing import Callable, Dict, Tuple
+
+from hfrep_tpu.resilience.drive import DRIVE_REGISTRY, DriveSpec
+from hfrep_tpu.resilience.drive import EXIT_IO  # re-export: oracle contract
 
 #: serving and stalls: an injected ``stall`` holds its site for
 #: ``faults.STALL_SECS`` (120s) so that supervisor escalation paths win;
@@ -59,7 +66,7 @@ SUBJECT_STALL_SECS = 0.5
 
 @dataclasses.dataclass(frozen=True)
 class Subject:
-    """One registered chaos subject."""
+    """One chaos subject — the engine's view of a registered drive."""
 
     name: str
     run: Callable[[Path, int, bool], dict]
@@ -69,17 +76,22 @@ class Subject:
     hint_sites: Tuple[str, ...] = ()
 
 
-SUBJECTS: Dict[str, Subject] = {}
+def _subject_of(spec: DriveSpec) -> Subject:
+    def run(out: Path, fixture_seed: int, resume: bool,
+            _spec: DriveSpec = spec) -> dict:
+        # lazy: the registry stays listable without importing jax or
+        # the training stacks — the fixture module loads on first run
+        return _spec.load_fixture()(out, fixture_seed, resume)
+
+    return Subject(name=spec.name, run=run, timeout=spec.timeout,
+                   deterministic=spec.deterministic, tier=spec.tier,
+                   hint_sites=tuple(spec.hint_sites))
 
 
-def _register(name: str, *, timeout: float, deterministic: bool = True,
-              tier: str = "fast", hint_sites: Tuple[str, ...] = ()):
-    def deco(fn):
-        SUBJECTS[name] = Subject(name=name, run=fn, timeout=timeout,
-                                 deterministic=deterministic, tier=tier,
-                                 hint_sites=hint_sites)
-        return fn
-    return deco
+#: DERIVED, never hand-edited: one subject per registered DriveSpec, in
+#: registration order.  Register a drive, get a chaos subject.
+SUBJECTS: Dict[str, Subject] = {
+    name: _subject_of(spec) for name, spec in DRIVE_REGISTRY.items()}
 
 
 def fast_subjects() -> Tuple[str, ...]:
@@ -88,445 +100,29 @@ def fast_subjects() -> Tuple[str, ...]:
                  if s.tier == "fast" and not n.startswith("_"))
 
 
-# ------------------------------------------------------------- fixtures
-def _panel(rows: int, feats: int, fixture_seed: int, salt: int):
-    from hfrep_tpu.utils.fixture_data import scaled_panel
-    return scaled_panel(rows, feats, seed=1000 + 31 * fixture_seed + salt)
-
-
-def _write_npz_artifact(out: Path, name: str, arrays: dict) -> None:
-    """Publish ``arrays`` as ``<out>/artifacts/<name>/data.npz`` through
-    the one crash-consistent writer (``result_save``/``result`` fault
-    sites — the artifact-publication boundary of every subject)."""
-    import numpy as np
-
-    from hfrep_tpu.utils import checkpoint as ckpt
-
-    def writer(tmp: Path) -> None:
-        np.savez(tmp / "data.npz", **arrays)
-
-    ckpt.write_atomic(out / "artifacts" / name, writer,
-                      metadata={"subject": name},
-                      io_site="result_save", fault_site="result")
-
-
-def _result_arrays(res) -> dict:
-    """An AEResult (params pytree + traces) as a flat npz-ready dict."""
-    import jax
-    import numpy as np
-
-    arrays = {f"p{i}": np.asarray(leaf) for i, leaf in
-              enumerate(jax.tree_util.tree_leaves(res.params))}
-    arrays["train_loss"] = np.asarray(res.train_loss)
-    arrays["val_loss"] = np.asarray(res.val_loss)
-    arrays["stop_epoch"] = np.asarray(res.stop_epoch)
-    return arrays
-
-
-# ------------------------------------------------------------- subjects
-@_register("ae_sweep", timeout=75.0,
-           hint_sites=("chunk", "snapshot_save", "snapshot", "obs_append",
-                       "result_save", "manifest"))
-def _run_ae_sweep(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The paper's latent sweep at fixture shape, chunked with resume —
-    kill→resume must stay bit-identical (PR-5's core contract)."""
-    import jax
-
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.replication.engine import sweep_autoencoders_chunked
-
-    xs = _panel(32, 4, fixture_seed, salt=1)
-    cfg = AEConfig(n_factors=4, latent_dim=3, epochs=4, batch_size=16,
-                   patience=2, seed=fixture_seed, chunk_epochs=2)
-    res, stats = sweep_autoencoders_chunked(
-        jax.random.PRNGKey(fixture_seed), xs, cfg, [1, 2, 3],
-        resume_dir=str(out / "scratch" / "resume"))
-    _write_npz_artifact(out, "sweep", _result_arrays(res))
-    return {"chunks": int(stats.chunks_dispatched)}
-
-
-@_register("ae_multi", timeout=75.0,
-           hint_sites=("chunk", "snapshot_save", "snapshot", "result_save",
-                       "obs_append"))
-def _run_ae_multi(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The padded multi-dataset fabric (ragged rows via the mask
-    operand) under the same kill→resume contract."""
-    import jax
-
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.replication.engine import (
-        stack_padded,
-        sweep_autoencoders_multi,
-    )
-
-    a = _panel(36, 4, fixture_seed, salt=2)
-    stack, rows = stack_padded([a, a[:28]])
-    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
-                   patience=2, seed=fixture_seed, chunk_epochs=2)
-    res, stats = sweep_autoencoders_multi(
-        jax.random.PRNGKey(fixture_seed + 1), stack, rows, cfg, [1, 2],
-        resume_dir=str(out / "scratch" / "resume"))
-    _write_npz_artifact(out, "multi", _result_arrays(res))
-    return {"chunks": int(stats.chunks_dispatched)}
-
-
-@_register("ae_mesh", timeout=75.0,
-           hint_sites=("chunk", "snapshot_save", "snapshot", "result_save",
-                       "obs_append"))
-def _run_ae_mesh(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The padded multi-dataset fabric dispatched through the unified
-    partition-rule mesh launch (ISSUE 15) on a 1×1 ``('dp',)`` mesh —
-    the pjit dispatch path under the same kill→resume / exit-contract /
-    atomic-artifact oracles as the plain drive.  A 1×1 mesh runs the
-    identical program (pinned), so the oracle reference stays the
-    meshless undisturbed run."""
-    import jax
-
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.parallel.rules import MeshSpec, build_mesh
-    from hfrep_tpu.replication.engine import (
-        stack_padded,
-        sweep_autoencoders_multi,
-    )
-
-    a = _panel(36, 4, fixture_seed, salt=2)
-    stack, rows = stack_padded([a, a[:28]])
-    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
-                   patience=2, seed=fixture_seed, chunk_epochs=2)
-    res, stats = sweep_autoencoders_multi(
-        jax.random.PRNGKey(fixture_seed + 1), stack, rows, cfg, [1, 2],
-        resume_dir=str(out / "scratch" / "resume"),
-        mesh=build_mesh(MeshSpec(dp=1), devices=jax.devices()[:1]))
-    _write_npz_artifact(out, "multi", _result_arrays(res))
-    return {"chunks": int(stats.chunks_dispatched)}
-
-
-@_register("gan_ckpt", timeout=120.0,
-           hint_sites=("block", "ckpt_save", "ckpt", "obs_append",
-                       "manifest", "result_save"))
-def _run_gan_ckpt(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """GAN train→checkpoint→resume: periodic checkpoints, drain at a
-    block boundary, restore walking past torn/corrupt checkpoints —
-    including the all-candidates-corrupt degrade-to-fresh path (which a
-    fresh deterministic retrain makes bit-identical again)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from hfrep_tpu.config import ExperimentConfig, ModelConfig, TrainConfig
-    from hfrep_tpu.train.trainer import GanTrainer
-
-    epochs = 4
-    cfg = ExperimentConfig(
-        model=ModelConfig(features=4, window=8, hidden=8, family="gan"),
-        train=TrainConfig(epochs=epochs, batch_size=4, n_critic=1,
-                          steps_per_call=2, seed=fixture_seed,
-                          checkpoint_dir=str(out / "scratch" / "ckpts"),
-                          checkpoint_every=2))
-    rng = np.random.default_rng(2000 + fixture_seed)
-    ds = jnp.asarray(rng.standard_normal((12, 8, 4)), jnp.float32)
-    tr = GanTrainer(cfg, ds)
-    if resume:
-        try:
-            path = tr.restore_checkpoint()
-        except FileNotFoundError:
-            path = ""           # nothing persisted yet: clean fresh start
-        if not path:
-            print("gan_ckpt: no restorable checkpoint, fresh start",
-                  file=sys.stderr)
-    remaining = epochs - tr.epoch
-    if remaining > 0:
-        tr.train(epochs=remaining)
-    _write_npz_artifact(out, "gan", {
-        f"g{i}": np.asarray(leaf) for i, leaf in
-        enumerate(jax.tree_util.tree_leaves(tr.state.g_params))})
-    return {"epochs": int(tr.epoch)}
-
-
-@_register("serve_load", timeout=90.0, deterministic=False,
-           hint_sites=("serve_worker", "serve_result", "batcher",
-                       "serve_drive", "obs_append"))
-def _run_serve_load(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """Serving chaos load: a real server over a really-trained tiny AE
-    head under whatever the schedule throws at it.  Not bit-identical
-    (thread timing decides sheds/deadlines) — the oracles here are the
-    ledger (terminal == submitted, zero silent drops) and the exit-code
-    contract.  A resumed leg is simply a fresh load run."""
-    import jax
-
-    from hfrep_tpu import resilience
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.replication.engine import train_autoencoder_chunked
-    from hfrep_tpu.serve import AEServeModel, ReplicationServer, ServeConfig
-    from hfrep_tpu.serve.loadgen import make_panels
-
-    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=6, batch_size=16,
-                   patience=2, seed=fixture_seed, chunk_epochs=3)
-    res, _ = train_autoencoder_chunked(
-        jax.random.PRNGKey(fixture_seed), _panel(36, 4, fixture_seed, 3),
-        cfg)
-    model = AEServeModel.create(cfg, res.params)
-    scfg = ServeConfig(max_batch=4, batch_window_ms=5.0,
-                       request_timeout_ms=2000.0, max_queue=16, workers=1,
-                       row_buckets=(16, 32), breaker_failures=2,
-                       breaker_cooldown_s=0.2, compile_storm=64)
-    server = ReplicationServer(scfg, ae_model=model).start()
-    panels = make_panels(fixture_seed + 1, 4, (12, 20), variants=3)
-    from concurrent.futures import wait
-    try:
-        with resilience.graceful_drain():
-            futs = []
-            try:
-                for burst in range(2):
-                    futs += [server.replicate(panels[i % len(panels)],
-                                              timeout_ms=2000.0)
-                             for i in range(8)]
-                    wait(futs, timeout=30)
-                    # the drive boundary: injected sigterm/preempt land
-                    # here and drain the server like the CLI would
-                    resilience.boundary("serve_drive")
-            except resilience.Preempted:
-                server.drain(reason="chaos drain", timeout=30.0)
-                wait(futs, timeout=30)
-                raise
-        wait(futs, timeout=30)
-    finally:
-        ledger = server.outcomes.as_dict()
-        server.stop()
-    return {"submitted": int(ledger["submitted"]),
-            "terminal": int(ledger["terminal"])}
-
-
-@_register("walkforward", timeout=120.0,
-           hint_sites=("chunk", "window", "snapshot_save", "snapshot",
-                       "result_save", "obs_append"))
-def _run_walkforward(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The scenario factory's walk-forward regime sweep at fixture
-    shape: chunk-snapshot training, window-granular scoring, resume
-    byte-identical."""
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.scenario.walkforward import WalkForwardSpec, run_walkforward
-    from hfrep_tpu.utils.fixture_data import universe_arrays
-
-    x, y, rf = universe_arrays(3000 + fixture_seed, funds=6, months=48,
-                               n_factors=4)
-    spec = WalkForwardSpec(start=24, n_windows=2, horizon=10, step=2)
-    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=4, batch_size=16,
-                   patience=2, seed=fixture_seed, chunk_epochs=2,
-                   ols_window=8)
-    doc = run_walkforward(x, y, rf, spec, cfg, [1, 2],
-                          out / "scratch" / "wf", resume=resume)
-    _write_npz_artifact(out, "walkforward", {
-        "surface_post": doc["surface_post"],
-        "surface_ante": doc["surface_ante"]})
-    return {"windows": int(spec.n_windows)}
-
-
-@_register("rollup", timeout=60.0,
-           hint_sites=("item", "rollup_publish", "obs_append"))
-def _run_rollup(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The fleet telemetry plane's retention loop (ISSUE 17) under
-    fire: a compressed-time soak that appends deterministic event
-    batches to a synthetic run dir, rotates the live stream at a byte
-    threshold and compacts every cycle — SIGKILL/EIO landing
-    mid-segment (``rollup_publish`` during the state publish) or
-    mid-compaction (during a pinned/ledger publish) must resume from
-    the durable cursor with zero lost or double-counted events.
-
-    Determinism notes (the oracle digests ``artifacts/`` bit-exactly):
-
-    * events are written as raw JSONL with seed-derived timestamps —
-      never through :class:`Obs`, whose ``perf_counter`` clock is
-      wall-nondeterministic;
-    * rotation is BYTE-driven and happens in the same guarded step as
-      the append (no fault site between them), so chunk numbering and
-      content are a pure function of the bytes appended — identical
-      between a faulted-then-resumed run and the undisturbed reference;
-    * per-batch progress is published atomically AFTER append+rotate
-      and BEFORE compaction, so a kill anywhere in compaction resumes
-      into the idempotent per-chunk ledger protocol, never into a
-      double append.
-
-    Invariants: ``items`` = records the final rollup state folded,
-    ``expected_items`` = records written — any drop or double-count
-    breaks the pair (zero-silent-drop oracle).
-    """
-    import hashlib
-    import json as _json
-
-    from hfrep_tpu import resilience
-    from hfrep_tpu.obs import rollup
-    from hfrep_tpu.utils.checkpoint import atomic_text
-
-    batches, rotate_bytes, bucket_secs = 24, 2048, 60.0
-    run = out / "scratch" / "soak_run"
-    run.mkdir(parents=True, exist_ok=True)
-    live = run / "events.jsonl"
-    progress_path = out / "scratch" / "progress.json"
-
-    def batch_lines(k: int) -> list:
-        base_t = k * 37.0
-        rnd = hashlib.sha256(f"{fixture_seed}:{k}".encode()).digest()
-        recs = []
-        for i in range(10):
-            recs.append({"v": 1, "t": base_t + i * 0.31, "type": "metric",
-                         "kind": "gauge", "name": "soak/depth",
-                         "value": rnd[i] % 17})
-        for i in range(8):
-            recs.append({"v": 1, "t": base_t + 3.1 + i * 0.17,
-                         "type": "metric", "kind": "histogram",
-                         "name": "serve/latency_ms",
-                         "value": 1.0 + (rnd[10 + i] % 50)})
-        for i in range(4):
-            recs.append({"v": 1, "t": base_t + 5.0 + i * 0.13,
-                         "type": "metric", "kind": "counter",
-                         "name": "soak/requests",
-                         "value": k * 4 + i + 1, "delta": 1})
-        for i in range(5):
-            recs.append({"v": 1, "t": base_t + 6.0 + i * 0.11,
-                         "type": "span", "name": "work",
-                         "dur": 0.01 * (1 + rnd[18 + i] % 9), "depth": 0})
-        recs.append({"v": 1, "t": base_t + 9.0, "type": "event",
-                     "name": "batch_end", "batch": k})
-        return [_json.dumps(r, sort_keys=True) for r in recs]
-
-    per_batch = len(batch_lines(0))
-    done = 0
-    if resume:
-        try:
-            done = int(_json.loads(progress_path.read_text())["batches"])
-        except (OSError, ValueError, KeyError):
-            done = 0
-        print(f"rollup: resuming after batch {done}", file=sys.stderr)
-
-    for k in range(done, batches):
-        # kills/preempts land here — between cycles, never mid-append
-        resilience.boundary("item")
-        data = "".join(ln + "\n" for ln in batch_lines(k))
-        with open(live, "a") as fh:
-            fh.write(data)
-        # byte-driven rotation INSIDE the guarded step: deterministic
-        rollup.rotate_live(run, rotate_bytes)
-        atomic_text(progress_path, _json.dumps({"batches": k + 1}))
-        # the consumer under test: one EIO is absorbed by a single
-        # bounded retry against the idempotent ledger; a persistent
-        # burst propagates as the typed storage exit (74)
-        try:
-            rollup.compact(run, bucket_secs=bucket_secs)
-        except OSError:
-            rollup.compact(run, bucket_secs=bucket_secs)
-
-    # drain the tail: rotate whatever is left, compact it, then
-    # normalize the cursor table to the (now empty) live stream
-    rollup.compact(run, bucket_secs=bucket_secs, force_rotate=True)
-    state, _ = rollup.ingest(run, bucket_secs=bucket_secs, persist=True)
-
-    art = out / "artifacts"
-    art.mkdir(parents=True, exist_ok=True)
-    atomic_text(art / "rollup_state.json",
-                _json.dumps(state, indent=2, sort_keys=True))
-    comp = rollup.load_compact(run) or {}
-    atomic_text(art / "rollup_compact.json",
-                _json.dumps(comp, indent=2, sort_keys=True))
-    pinned_digests = {
-        p.name: hashlib.sha256(p.read_bytes()).hexdigest()
-        for p in rollup.pinned_files(run)}
-    atomic_text(art / "pinned_digests.json",
-                _json.dumps(pinned_digests, indent=2, sort_keys=True))
-    return {"items": rollup.n_records(state),
-            "expected_items": batches * per_batch,
-            "chunk_cycles": len((comp.get("chunks") or {})),
-            "disk_bytes": rollup.disk_footprint(run)}
-
-
-@_register("pipeline", timeout=240.0, tier="slow",
-           hint_sites=("item", "idle", "actor", "queue_put", "queue_get",
-                       "queue_item", "result", "result_save",
-                       "snapshot_save", "drain_barrier"))
-def _run_pipeline(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The async actor fabric end to end (spawned members over the spool
-    queue).  Expensive — slow tier, soaked only with a real budget; the
-    artifact digest manifest is the fabric's determinism contract."""
-    from hfrep_tpu.config import AEConfig
-    from hfrep_tpu.orchestrate import PipelinePlan, SourceSpec, run_pipeline
-    from hfrep_tpu.utils.checkpoint import atomic_text
-
-    cfg = AEConfig(n_factors=4, latent_dim=2, epochs=6, batch_size=16,
-                   patience=2, seed=0, chunk_epochs=3)
-    plan = PipelinePlan(
-        out_dir=str(out / "scratch" / "pipe"),
-        sources=[SourceSpec(name="s0", mode="fixture",
-                            params={"rows": 32, "feats": 4})],
-        blocks=2, consumers=1, capacity=1, ae_cfg=cfg, latent_dims=[1, 2],
-        consume_mode="direct", stream_seed=10 + fixture_seed,
-        drain_timeout=60.0, timeout=180.0)
-    doc = run_pipeline(plan, resume=resume)
-    digests = {name: src["items"]
-               for name, src in doc["summary"]["sources"].items()}
-    art = out / "artifacts"
-    art.mkdir(parents=True, exist_ok=True)
-    atomic_text(art / "pipeline_digests.json",
-                json.dumps(digests, indent=2, sort_keys=True))
-    n_items = sum(len(v) for v in digests.values())
-    return {"items": n_items, "expected_items": plan.blocks,
-            "restarts": int(doc["stats"]["restarts"])}
-
-
-@_register("_planted", timeout=15.0, tier="test",
-           hint_sites=("item", "result_save"))
-def _run_planted(out: Path, fixture_seed: int, resume: bool) -> dict:
-    """The engine's canary: a drive with a DELIBERATE silent-drop bug.
-
-    It writes its one artifact with a plain non-atomic write and — the
-    planted violation — swallows an injected EIO at the publication
-    site, so ``io_fail@result_save=1`` makes the artifact silently
-    vanish while the run still exits 0.  The search must catch the
-    digest mismatch against the reference and the shrinker must reduce
-    any schedule containing that directive to the one-fault minimal
-    spec.  Kept out of real soaks; driven by ``tests/test_chaos.py``.
-    """
-    import hashlib
-
-    from hfrep_tpu import resilience
-
-    payload = hashlib.sha256(f"planted:{fixture_seed}".encode()).hexdigest()
-    with resilience.graceful_drain():
-        for _ in range(3):
-            resilience.boundary("item")
-        art = out / "artifacts" / "planted"
-        art.mkdir(parents=True, exist_ok=True)
-        try:
-            resilience.io_point("result_save")
-            (art / "result.json").write_text(
-                json.dumps({"payload": payload}))
-        except OSError:
-            pass    # the planted bug: a swallowed publish EIO = silent drop
-    return {"items": 3}
-
-
 # ------------------------------------------------------------ subprocess
 RESULT_NAME = "chaos_result.json"
-
-#: EX_IOERR — the typed exit for a persistent storage failure (an
-#: injected EIO burst outlasting the bounded retry policy at a write
-#: the drive cannot proceed without)
-EXIT_IO = 74
 
 
 def subject_main(name: str, out_dir: str, fixture_seed: int,
                  resume: bool) -> int:
-    """The ``chaos-subject`` subprocess entry: one subject run under the
-    watchdog, obs session, and the exit-code contract (0 = complete,
-    75 = drained with state persisted, anything else = a bug the
-    oracles will flag)."""
-    import hfrep_tpu.obs as obs_pkg
-    from hfrep_tpu import resilience
+    """The ``chaos-subject`` subprocess entry: one drive fixture run
+    under the full :func:`~hfrep_tpu.resilience.drive.run_drive`
+    envelope (0 = complete, 75 = drained with state persisted, 74 =
+    persistent storage failure, anything else = a bug the oracles will
+    flag).  The envelope structure the corpus pins — graceful_drain
+    OUTERMOST so a SIGTERM during the session's first stream append
+    drains instead of killing the process raw (entry 003), and the
+    session-boundary EIO typed 74 (entry 007) — now lives in
+    ``drive.run_drive``, shared with every production entry point."""
     from hfrep_tpu.resilience import faults
+    from hfrep_tpu.resilience.drive import run_drive
 
-    subject = SUBJECTS.get(name)
-    if subject is None:
+    spec = DRIVE_REGISTRY.get(name)
+    if spec is None:
         print(f"unknown chaos subject {name!r} "
-              f"(registry: {', '.join(sorted(SUBJECTS))})", file=sys.stderr)
+              f"(registry: {', '.join(sorted(DRIVE_REGISTRY))})",
+              file=sys.stderr)
         return 2
     out = Path(out_dir)
     for sub in ("artifacts", "scratch"):
@@ -539,60 +135,26 @@ def subject_main(name: str, out_dir: str, fixture_seed: int,
     # NaN from a bit-verified healthy checkpoint — this engine's own
     # first catch; see utils/xla_cache.py).  Subjects pay their tiny
     # compiles fresh; correctness of the oracle surface over ~1s/run.
-    #
-    # graceful_drain wraps the WHOLE run — the obs session open
-    # included: the soak found that a SIGTERM landing during the
-    # session's first stream append (sigterm@obs_append=1, before any
-    # drive had installed its handler) killed the process raw with
-    # -15.  With the handler up front, a pre-drive SIGTERM just sets
-    # the drain flag and the drive exits 75 at its first boundary
-    # (corpus entry; the drives' own graceful_drain entries nest).
-    with resilience.graceful_drain():
-        code = 0
-        try:
-            with obs_pkg.session(out / "obs", command=f"chaos:{name}",
-                                 chaos={"subject": name,
-                                        "fixture_seed": fixture_seed,
-                                        "resume": resume}):
-                try:
-                    with resilience.watchdog(subject.timeout,
-                                             f"chaos subject {name}"):
-                        invariants = subject.run(out, fixture_seed, resume)
-                except resilience.Preempted as e:
-                    from hfrep_tpu.obs.crash import bundle_if_enabled
-                    bundle_if_enabled(e)   # drain forensics, like every CLI
-                    print(f"chaos subject {name}: {e}", file=sys.stderr)
-                    code = 75
-                except OSError as e:
-                    # persistent storage failure: an I/O error that
-                    # outlasted the bounded retry policy at a REQUIRED
-                    # write (artifacts, checkpoints a drive cannot proceed
-                    # without).  Typed exit 74 (EX_IOERR) — never a
-                    # traceback; the oracle accepts it only on attempts
-                    # whose own schedule armed io_fail
-                    from hfrep_tpu.obs.crash import bundle_if_enabled
-                    bundle_if_enabled(e)
-                    print(f"chaos subject {name}: storage failed "
-                          f"persistently: {e}", file=sys.stderr)
-                    code = EXIT_IO
-        except OSError as e:
-            # the SESSION boundary itself died of storage: enable()'s
-            # initial write_manifest raised through the bounded retry
-            # (an EIO burst at the manifest site before the drive even
-            # started), or the close-path flush did.  Same contract as
-            # a required-write failure in the body — typed 74, never a
-            # traceback.  Found by the seeded soak (corpus entry 007):
-            # the body-level handler above cannot see it because the
-            # `with session` line sits outside its try
-            print(f"chaos subject {name}: telemetry storage failed "
-                  f"persistently at the session boundary: {e}",
-                  file=sys.stderr)
-            code = EXIT_IO
-        if code:
-            return code
+    invariants: dict = {}
+
+    def work() -> int:
+        invariants.update(
+            spec.load_fixture()(out, fixture_seed, resume) or {})
+        return 0
+
+    code = run_drive(
+        spec, work, obs_dir=out / "obs",
+        session_meta={"command": f"chaos:{name}",
+                      "chaos": {"subject": name,
+                                "fixture_seed": fixture_seed,
+                                "resume": resume}},
+        watchdog_secs=spec.timeout,
+        watchdog_name=f"chaos subject {name}")
+    if code:
+        return code
     from hfrep_tpu.utils.checkpoint import atomic_text
     atomic_text(out / RESULT_NAME, json.dumps(
         {"v": 1, "subject": name, "fixture_seed": fixture_seed,
-         "resumed": bool(resume), "invariants": invariants or {}},
+         "resumed": bool(resume), "invariants": invariants},
         indent=2, sort_keys=True))
     return 0

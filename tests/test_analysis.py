@@ -1652,6 +1652,116 @@ class TestBoundarySync:
         assert fs == []
 
 
+# ------------------------------------------------------------------ HF011
+class TestDriveEnvelopeDiscipline:
+    def test_positive_hand_rolled_drain_exit(self):
+        # the pre-ISSUE-20 CLI shape: a compliant HF007 handler is still
+        # a hand-rolled envelope — the exit mapping belongs to run_drive
+        fs = run_hf("""
+            from hfrep_tpu.resilience import Preempted
+            def cmd(args):
+                try:
+                    return impl(args)
+                except Preempted as e:
+                    from hfrep_tpu.obs.crash import bundle_if_enabled
+                    bundle_if_enabled(e)
+                    return 75
+            """, "HF011", relpath="hfrep_tpu/experiments/custom.py")
+        assert codes(fs) == ["HF011"]
+        assert "run_drive" in fs[0].message
+
+    def test_positive_sys_exit_constant(self):
+        fs = run_hf("""
+            import sys
+            from hfrep_tpu import resilience
+            EXIT_DRAINED = 75
+            def loop():
+                try:
+                    drive()
+                except resilience.Preempted:
+                    sys.exit(EXIT_DRAINED)
+            """, "HF011", relpath="hfrep_tpu/orchestrate/custom.py")
+        assert codes(fs) == ["HF011"]
+
+    def test_positive_drain_session_pairing(self):
+        # corpus-003's bug class: one function rebuilding the envelope's
+        # load-bearing nesting by hand (either order is flagged)
+        fs = run_hf("""
+            import hfrep_tpu.obs as obs_pkg
+            from hfrep_tpu import resilience
+            def main(out):
+                with resilience.graceful_drain():
+                    with obs_pkg.session(out, command="x"):
+                        work()
+            """, "HF011", relpath="hfrep_tpu/experiments/custom.py")
+        assert codes(fs) == ["HF011"]
+        assert "corpus 003" in fs[0].message
+
+    def test_negative_bare_drain_point(self):
+        # library-level graceful_drain without a session (engine chunk
+        # loop, trainer block loop, supervisor) is a drain point, not an
+        # envelope; re-raise handlers stay exempt like HF007
+        assert run_hf("""
+            from hfrep_tpu import resilience
+            def drive_chunks(fn, n):
+                with resilience.graceful_drain():
+                    for i in range(n):
+                        fn(i)
+                        resilience.boundary("chunk")
+            def reraise():
+                try:
+                    step()
+                except resilience.Preempted as e:
+                    raise resilience.Preempted(site=e.site, epoch=1) from None
+            """, "HF011", relpath="hfrep_tpu/replication/custom.py") == []
+
+    def test_negative_session_only_and_nested_defs(self):
+        # a session without a drain is a telemetry decision, and a
+        # nested helper's session does not taint the enclosing function
+        assert run_hf("""
+            import hfrep_tpu.obs as obs_pkg
+            from hfrep_tpu import resilience
+            def report(out):
+                with obs_pkg.session(out, command="report"):
+                    render()
+            def outer():
+                def helper(out):
+                    with obs_pkg.session(out):
+                        pass
+                with resilience.graceful_drain():
+                    work()
+            """, "HF011", relpath="hfrep_tpu/obs/custom.py") == []
+
+    def test_sanctioned_runtime_tests_and_noqa(self):
+        src = """
+            import hfrep_tpu.obs as obs_pkg
+            from hfrep_tpu import resilience
+            def run(out):
+                with resilience.graceful_drain():
+                    with obs_pkg.session(out):
+                        try:
+                            work()
+                        except resilience.Preempted:
+                            return 75
+            """
+        assert run_hf(src, "HF011",
+                      relpath="hfrep_tpu/resilience/drive.py") == []
+        assert run_hf(src, "HF011",
+                      relpath="tests/test_x_fixture.py") == []
+        fs = run_hf("""
+            import hfrep_tpu.obs as obs_pkg
+            from hfrep_tpu import resilience
+            def main(out):
+                with resilience.graceful_drain():
+                    with obs_pkg.session(out):  # noqa: HF011
+                        try:
+                            work()
+                        except resilience.Preempted:
+                            return 75  # noqa: HF011
+            """, "HF011", relpath="hfrep_tpu/experiments/custom.py")
+        assert fs == []
+
+
 # -------------------------------------------- review-hardening regressions
 class TestReviewHardening:
     def test_hf005_not_hasattr_polarity(self):

@@ -359,43 +359,13 @@ class TestWalkForwardNumerics:
                             SMALL_LATENTS, out)
 
 
-class TestCliWalkForwardDrainResume:
-    def _run(self, cleaned, out):
-        from hfrep_tpu.experiments.cli import main
-        return main(["scenario", "walkforward", "--cleaned-dir", cleaned,
-                     "--out", out, "--latents", "1,2", "--start", "30",
-                     "--step", "2", "--windows", "6", "--horizon", "10",
-                     "--ols-window", "6", "--epochs", "6",
-                     "--chunk-epochs", "3", "--resume"])
-
-    def test_preempt_exit75_resume_bit_identical(self, tmp_path,
-                                                 monkeypatch):
-        """The drain contract end to end through the real CLI: a REAL
-        SIGTERM (injected at a training chunk boundary, caught by the
-        graceful-drain handler) → exit 75 → re-run resumes → final
-        surface bit-identical to an undisturbed run."""
-        from hfrep_tpu.utils.fixture_data import write_cleaned_fixture
-        cleaned = tmp_path / "cleaned_data"
-        write_cleaned_fixture(cleaned, months=64)
-        base, out = tmp_path / "base", tmp_path / "drained"
-        assert self._run(str(cleaned), str(base)) == 0
-
-        monkeypatch.setenv(res.ENV_FAULTS, "sigterm@chunk=1")
-        monkeypatch.setattr(res, "_plan", None)
-        monkeypatch.setattr(res, "_env_consumed", False)
-        assert self._run(str(cleaned), str(out)) == 75
-        assert (out / "_resume").exists(), \
-            "drained run must leave resumable state"
-
-        monkeypatch.delenv(res.ENV_FAULTS)
-        monkeypatch.setattr(res, "_plan", None)
-        monkeypatch.setattr(res, "_env_consumed", False)
-        assert self._run(str(cleaned), str(out)) == 0
-        assert not (out / "_resume").exists()
-        for f in ("walkforward.json", "walkforward.csv",
-                  "walkforward_ante.csv"):
-            assert (out / f).read_bytes() == (base / f).read_bytes(), \
-                f"{f} differs from the undisturbed run"
+# The CLI drain-75/resume-bit-identity copy that used to live here
+# (TestCliWalkForwardDrainResume) moved into the shared oracle harness:
+# tests/test_drive.py::TestOracleHarness runs the SIGTERM@chunk → 75 →
+# resume → bit-identical-digests leg for the registered ``walkforward``
+# spec (ISSUE 20 — one parametrized suite instead of a hand copy per
+# drive), and the scenario-factory gate in tools/bench_scenario.py
+# keeps the window-boundary preempt drill.
 
 
 # ------------------------------------------------------------------ universe

@@ -43,6 +43,15 @@ env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
 # stderr so `--format json` callers keep stdout pure.
 env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS JAX_PLATFORMS=cpu \
     python -m hfrep_tpu.analysis audit 1>&2
+# drive-registry completeness gate (ISSUE 20): every registered
+# DriveSpec's fixture resolves, its fault sites are registry-known, all
+# six production drive families are covered, and the chaos subject list
+# mirrors DRIVE_REGISTRY in both directions — a new long-running
+# workload without chaos coverage fails HERE, not in review.
+# Env-stripped like the analyzer above (the registry must be judged
+# bare, not under an ambient fault plan).
+env -u HFREP_OBS_DIR -u HFREP_HISTORY -u HFREP_FAULTS \
+    python -m hfrep_tpu.resilience drives --check 1>&2
 # telemetry schema gate: writer (hfrep_tpu.obs) and parser (obs.report)
 # must agree on the committed fixture run directory.  Status goes to
 # stderr so `--format json` keeps stdout pure JSON for machine consumers.
